@@ -1,0 +1,316 @@
+"""Operations and histories.
+
+Equivalent of the external `io.jepsen/history` library as consumed by the
+reference (SURVEY.md §2.4): the `Op` record (fields index, time, type,
+process, f, value — constructed at
+/root/reference/jepsen/src/jepsen/generator.clj:529-536), history
+construction with dense indices, invoke↔completion pairing, predicates
+(invoke?/ok?/fail?/info?/client-op?), and filtered views.
+
+Design notes (TPU-first): a History is an immutable sequence of Op rows
+backed by plain Python objects for host-side ergonomics, with `pair_index`
+computed once in O(n).  The device-facing columnar encoding lives in
+`jepsen_tpu.history.packed` — this module is the friendly host view.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+# Op types (the reference uses keywords :invoke :ok :fail :info).
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+TYPES = (INVOKE, OK, FAIL, INFO)
+
+#: Packed integer codes for op types (BASELINE.json packed tensor layout).
+TYPE_CODES = {INVOKE: 0, OK: 1, FAIL: 2, INFO: 3}
+TYPE_NAMES = {v: k for k, v in TYPE_CODES.items()}
+
+#: The nemesis's logical process (the reference uses the keyword :nemesis,
+#: generator/context.clj:258-286).
+NEMESIS = "nemesis"
+
+#: Packed process code for the nemesis.
+NEMESIS_CODE = -1
+
+
+@dataclass(slots=True)
+class Op:
+    """One history event.
+
+    Mirrors jepsen.history's Op record: `index` is the dense position in the
+    history, `time` is nanoseconds since test start, `type` is one of
+    invoke/ok/fail/info, `process` is an integer worker process or
+    NEMESIS, `f` is the operation function (any hashable), `value` its
+    payload.  Extra keys (e.g. :error) live in `ext`."""
+
+    type: str
+    f: Any = None
+    value: Any = None
+    process: Any = None
+    time: int = -1
+    index: int = -1
+    ext: dict[str, Any] = field(default_factory=dict)
+
+    # -- predicates (jepsen.history predicates; SURVEY.md §2.4) ------------
+
+    @property
+    def is_invoke(self) -> bool:
+        return self.type == INVOKE
+
+    @property
+    def is_ok(self) -> bool:
+        return self.type == OK
+
+    @property
+    def is_fail(self) -> bool:
+        return self.type == FAIL
+
+    @property
+    def is_info(self) -> bool:
+        return self.type == INFO
+
+    @property
+    def is_client_op(self) -> bool:
+        """Client ops have integer processes; the nemesis doesn't."""
+        return isinstance(self.process, int)
+
+    @property
+    def error(self) -> Any:
+        return self.ext.get("error")
+
+    def replace(self, **kw: Any) -> "Op":
+        return dataclasses.replace(self, **kw)
+
+    def with_ext(self, **kw: Any) -> "Op":
+        ext = dict(self.ext)
+        ext.update(kw)
+        return dataclasses.replace(self, ext=ext)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {
+            "index": self.index,
+            "time": self.time,
+            "type": self.type,
+            "process": self.process,
+            "f": self.f,
+            "value": self.value,
+        }
+        d.update(self.ext)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Op":
+        ext = {
+            k: v
+            for k, v in d.items()
+            if k not in ("index", "time", "type", "process", "f", "value")
+        }
+        return cls(
+            type=d["type"],
+            f=d.get("f"),
+            value=d.get("value"),
+            process=d.get("process"),
+            time=d.get("time", -1),
+            index=d.get("index", -1),
+            ext=ext,
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"{self.index}\t{self.process}\t{self.type}\t{self.f}\t{self.value!r}"
+            + (f"\t{self.ext}" if self.ext else "")
+        )
+
+
+def op(type: str, f: Any = None, value: Any = None, process: Any = None, **ext: Any) -> Op:
+    """Terse Op constructor for tests and literal histories."""
+    return Op(type=type, f=f, value=value, process=process, ext=ext)
+
+
+def invoke(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(INVOKE, f, value, process, **ext)
+
+
+def ok(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(OK, f, value, process, **ext)
+
+
+def fail(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(FAIL, f, value, process, **ext)
+
+
+def info(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(INFO, f, value, process, **ext)
+
+
+class History(Sequence[Op]):
+    """An immutable, dense-indexed sequence of Ops with O(1)
+    invoke↔completion pairing.
+
+    Construction mirrors `(h/history ops {:dense-indices? true ...})` at
+    generator/interpreter.clj:284-286: indices are (re)assigned densely
+    unless the ops already carry dense indices, and missing times are filled
+    from indices so literal test histories sort sensibly."""
+
+    __slots__ = ("ops", "_pair_index", "_by_index")
+
+    def __init__(self, ops: Iterable[Op | dict], *, reindex: bool | None = None):
+        rows: list[Op] = [
+            o if isinstance(o, Op) else Op.from_dict(o) for o in ops
+        ]
+        if reindex is None:
+            reindex = not all(o.index == i for i, o in enumerate(rows))
+        if reindex:
+            rows = [
+                dataclasses.replace(o, index=i, time=(o.time if o.time >= 0 else i))
+                for i, o in enumerate(rows)
+            ]
+        self.ops: tuple[Op, ...] = tuple(rows)
+        self._pair_index = self._compute_pairs()
+        self._by_index = None
+
+    # -- pairing ----------------------------------------------------------
+
+    def _compute_pairs(self) -> list[int]:
+        """pair_index[i] = index of the op paired with ops[i], or -1.
+
+        An invocation pairs with the next op on the same process (its
+        completion).  Client processes perform one op at a time; a client
+        :info completion crashes the process, after which the interpreter
+        assigns a fresh pid (interpreter.clj:245-249), so same-process
+        pairing is unambiguous.  Nemesis invokes pair with the following
+        nemesis completion."""
+        pair = [-1] * len(self.ops)
+        pending: dict[Any, int] = {}
+        for i, o in enumerate(self.ops):
+            if o.is_invoke:
+                if o.process in pending:
+                    # Double invoke without completion: malformed, but be
+                    # tolerant like jepsen.history — earlier one stays
+                    # unpaired.
+                    pass
+                pending[o.process] = i
+            else:
+                j = pending.pop(o.process, None)
+                if j is not None:
+                    pair[j] = i
+                    pair[i] = j
+        return pair
+
+    def completion(self, o: Op | int) -> Op | None:
+        """The completion op for an invocation (or None if it never
+        completed)."""
+        i = o if isinstance(o, int) else o.index
+        j = self._pair_index[i]
+        return self.ops[j] if j >= 0 and j > i else None
+
+    def invocation(self, o: Op | int) -> Op | None:
+        """The invocation op for a completion."""
+        i = o if isinstance(o, int) else o.index
+        j = self._pair_index[i]
+        return self.ops[j] if j >= 0 and j < i else None
+
+    def pair_index(self, i: int) -> int:
+        return self._pair_index[i]
+
+    # -- sequence protocol -------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def __getitem__(self, i):  # type: ignore[override]
+        if isinstance(i, slice):
+            return list(self.ops[i])
+        return self.ops[i]
+
+    def __iter__(self) -> Iterator[Op]:
+        return iter(self.ops)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, History):
+            return self.ops == other.ops
+        if isinstance(other, (list, tuple)):
+            return list(self.ops) == list(other)
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"History({len(self.ops)} ops)"
+
+    # -- filtered views ----------------------------------------------------
+
+    def filter(self, pred: Callable[[Op], bool]) -> "History":
+        """A new history of ops matching pred.  Indices are preserved
+        (like jepsen.history filtered views), so pairing against the
+        original remains meaningful via .index."""
+        return History([o for o in self.ops if pred(o)], reindex=False)
+
+    def remove(self, pred: Callable[[Op], bool]) -> "History":
+        return self.filter(lambda o: not pred(o))
+
+    def map(self, f: Callable[[Op], Op]) -> "History":
+        return History([f(o) for o in self.ops], reindex=False)
+
+    def client_ops(self) -> "History":
+        return self.filter(lambda o: o.is_client_op)
+
+    def invokes(self) -> "History":
+        return self.filter(lambda o: o.is_invoke)
+
+    def oks(self) -> "History":
+        return self.filter(lambda o: o.is_ok)
+
+    def fails(self) -> "History":
+        return self.filter(lambda o: o.is_fail)
+
+    def infos(self) -> "History":
+        return self.filter(lambda o: o.is_info)
+
+    def nemesis_ops(self) -> "History":
+        return self.filter(lambda o: o.process == NEMESIS)
+
+    def has_f(self, fs) -> "History":
+        fset = set(fs) if not callable(fs) else None
+        if fset is None:
+            return self.filter(lambda o: fs(o.f))
+        return self.filter(lambda o: o.f in fset)
+
+    def possible(self) -> "History":
+        """Ops that may have happened: everything except :fail completions
+        and their invocations (knossos drops certainly-failed ops)."""
+        failed_invokes = {
+            self._pair_index[o.index]
+            for o in self.ops
+            if o.is_fail and self._pair_index[o.index] >= 0
+        }
+        return self.filter(
+            lambda o: not (o.is_fail or o.index in failed_invokes)
+        )
+
+    def strip_indices(self) -> list[Op]:
+        """Ops with indices removed (generator/test.clj:73)."""
+        return [dataclasses.replace(o, index=-1) for o in self.ops]
+
+    # -- convenience -------------------------------------------------------
+
+    def to_dicts(self) -> list[dict[str, Any]]:
+        return [o.to_dict() for o in self.ops]
+
+
+def history(ops: Iterable[Op | dict], **kw: Any) -> History:
+    return History(ops, **kw)
+
+
+def parse_literal(rows: Iterable[tuple]) -> History:
+    """Builds a history from terse (process, type, f, value) tuples — the
+    shape checker tests use (checker_test.clj feeds literal op vectors)."""
+    ops = []
+    for row in rows:
+        process, type_, f, value = row
+        ops.append(Op(type=type_, f=f, value=value, process=process))
+    return History(ops)

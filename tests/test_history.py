@@ -1,0 +1,198 @@
+"""History core tests: pairing, predicates, filters, packing.
+
+Modeled on the history handling the reference exercises implicitly in
+checker_test.clj (literal op vectors) and generator/interpreter tests."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import (
+    FAIL,
+    INFO,
+    INVOKE,
+    NEMESIS,
+    NO_RET,
+    OK,
+    ST_INFO,
+    ST_OK,
+    History,
+    Op,
+    pack_history,
+    parse_literal,
+)
+
+
+def mk(rows):
+    return parse_literal(rows)
+
+
+class TestPairing:
+    def test_basic_pairing(self):
+        h = mk(
+            [
+                (0, INVOKE, "read", None),
+                (1, INVOKE, "write", 3),
+                (1, OK, "write", 3),
+                (0, OK, "read", 3),
+            ]
+        )
+        assert h.completion(h[0]).index == 3
+        assert h.invocation(h[3]).index == 0
+        assert h.completion(h[1]).index == 2
+        assert h.invocation(h[2]).index == 1
+
+    def test_unpaired_invoke(self):
+        h = mk([(0, INVOKE, "read", None)])
+        assert h.completion(h[0]) is None
+
+    def test_info_completion_pairs(self):
+        h = mk([(0, INVOKE, "write", 1), (0, INFO, "write", 1)])
+        assert h.completion(h[0]).type == INFO
+        assert h.invocation(h[1]).index == 0
+
+    def test_nemesis_pairing(self):
+        h = mk(
+            [
+                (NEMESIS, INVOKE, "start", None),
+                (0, INVOKE, "read", None),
+                (NEMESIS, INFO, "start", "partitioned"),
+                (0, OK, "read", 0),
+            ]
+        )
+        assert h.completion(h[0]).index == 2
+        assert h[0].is_client_op is False
+        assert h[1].is_client_op is True
+
+    def test_dense_reindex_and_times(self):
+        h = mk([(0, INVOKE, "read", None), (0, OK, "read", 1)])
+        assert [o.index for o in h] == [0, 1]
+        assert all(o.time >= 0 for o in h)
+
+
+class TestFilters:
+    def test_filters_preserve_indices(self):
+        h = mk(
+            [
+                (0, INVOKE, "read", None),
+                (NEMESIS, INVOKE, "start", None),
+                (0, OK, "read", 0),
+            ]
+        )
+        client = h.client_ops()
+        assert [o.index for o in client] == [0, 2]
+        assert len(h.oks()) == 1
+        assert len(h.invokes()) == 2
+
+    def test_possible_drops_certain_failures(self):
+        h = mk(
+            [
+                (0, INVOKE, "write", 1),
+                (0, FAIL, "write", 1),
+                (1, INVOKE, "write", 2),
+                (1, OK, "write", 2),
+            ]
+        )
+        p = h.possible()
+        assert [o.value for o in p if o.is_invoke] == [2]
+
+    def test_has_f(self):
+        h = mk([(0, INVOKE, "read", None), (0, OK, "read", 0)])
+        assert len(h.has_f({"read"})) == 2
+        assert len(h.has_f({"write"})) == 0
+
+
+def cas_encode(inv, comp):
+    """Tiny cas-register encoder for packing tests (real one lives in
+    jepsen_tpu.models)."""
+    fcode = {"read": 0, "write": 1, "cas": 2}[inv.f]
+    if inv.f == "read":
+        if comp is None or comp.type != OK:
+            return None  # indeterminate read: no effect, droppable
+        return (fcode, comp.value, 0)
+    if inv.f == "write":
+        return (fcode, inv.value, 0)
+    old, new = inv.value
+    return (fcode, old, new)
+
+
+class TestPacking:
+    def test_pack_shapes_and_order(self):
+        h = mk(
+            [
+                (0, INVOKE, "write", 1),
+                (1, INVOKE, "read", None),
+                (0, OK, "write", 1),
+                (1, OK, "read", 1),
+            ]
+        )
+        p = pack_history(h, cas_encode)
+        assert p.n == 2
+        # invocation order: write then read
+        assert list(p.f) == [1, 0]
+        assert list(p.a0) == [1, 1]
+        assert list(p.status) == [ST_OK, ST_OK]
+
+    def test_pack_drops_fails_and_info_reads(self):
+        h = mk(
+            [
+                (0, INVOKE, "write", 1),
+                (0, FAIL, "write", 1),
+                (1, INVOKE, "read", None),
+                (1, INFO, "read", None),
+                (2, INVOKE, "write", 2),
+                (2, INFO, "write", 2),
+            ]
+        )
+        p = pack_history(h, cas_encode)
+        assert p.n == 1  # only the indeterminate write survives
+        assert p.status[0] == ST_INFO
+        assert p.ret[0] == NO_RET
+
+    def test_preds_and_horizon(self):
+        # A: inv0 ret2(ok). B: inv1 ret3(ok). C: inv4 ret5(ok).
+        h = mk(
+            [
+                (0, INVOKE, "write", 1),  # A inv  (event 0)
+                (1, INVOKE, "write", 2),  # B inv  (event 1)
+                (0, OK, "write", 1),      # A ret  (event 2)
+                (1, OK, "write", 2),      # B ret  (event 3)
+                (2, INVOKE, "write", 3),  # C inv  (event 4)
+                (2, OK, "write", 3),      # C ret  (event 5)
+            ]
+        )
+        p = pack_history(h, cas_encode)
+        assert p.n == 3
+        # A,B concurrent; C after both.
+        assert list(p.preds) == [0, 0, 2]
+        # horizon: #ops invoked before ret, minus self.
+        # A: invs before event 2 = {A,B} → 1. B: before 3 = {A,B} → 1.
+        # C: before 5 = all → 2.
+        assert list(p.horizon) == [1, 1, 2]
+
+    def test_info_horizon_is_open(self):
+        h = mk(
+            [
+                (0, INVOKE, "write", 1),
+                (0, INFO, "write", 1),
+                (1, INVOKE, "write", 2),
+                (1, OK, "write", 2),
+            ]
+        )
+        p = pack_history(h, cas_encode)
+        info_row = list(p.status).index(ST_INFO)
+        assert p.horizon[info_row] == p.n - 1
+        assert p.ret[info_row] == NO_RET
+
+    def test_unfinished_invoke_is_indeterminate(self):
+        h = mk([(0, INVOKE, "write", 7)])
+        p = pack_history(h, cas_encode)
+        assert p.n == 1
+        assert p.status[0] == ST_INFO
+
+
+class TestOpDicts:
+    def test_round_trip(self):
+        o = Op(type=OK, f="read", value=3, process=1, time=5, index=2, ext={"error": "x"})
+        d = o.to_dict()
+        o2 = Op.from_dict(d)
+        assert o2 == o
