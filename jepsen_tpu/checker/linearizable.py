@@ -1,0 +1,146 @@
+"""The linearizable checker — knossos's role in the reference
+(checker.clj:202-233), dispatching to the TPU frontier search or the CPU
+reference by :algorithm:
+
+  "wgl-tpu"     device beam search (ops/wgl.py); CPU fallback on unknown
+                when the history is small enough to afford it
+  "wgl"         exact CPU search over packed ops
+  "competition" device first, exact CPU to settle unknowns (mirrors
+                knossos.competition racing its solvers)
+
+Models with no packed form fall back to the host-model search.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..history.core import History
+from ..history.packed import pack_history
+from ..models.base import Model, PackedModel
+from .core import Checker
+from .wgl_cpu import WGLResult, check_wgl_cpu, check_wgl_host_model
+
+#: Histories at most this many ops get a CPU fallback pass when the device
+#: search returns unknown under "wgl-tpu".
+CPU_FALLBACK_MAX_OPS = 5_000
+
+
+class Linearizable(Checker):
+    def __init__(
+        self,
+        model: Optional[Model] = None,
+        algorithm: str = "wgl-tpu",
+        *,
+        beam: int = 1024,
+        max_beam: int = 65536,
+        block: int = 256,
+        time_limit_s: Optional[float] = None,
+        max_configs: int = 5_000_000,
+    ):
+        self.model = model
+        self.algorithm = algorithm
+        self.beam = beam
+        self.max_beam = max_beam
+        self.block = block
+        self.time_limit_s = time_limit_s
+        self.max_configs = max_configs
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        model = self.model or test.get("model")
+        if model is None:
+            raise ValueError("linearizable checker needs a model")
+        algorithm = self.algorithm
+
+        try:
+            pm = model.packed()
+        except NotImplementedError:
+            pm = None
+
+        if pm is None:
+            res = check_wgl_host_model(
+                history,
+                model,
+                max_configs=self.max_configs,
+                time_limit_s=self.time_limit_s,
+            )
+            return self._render(res, None, "wgl-host", model)
+
+        packed = pack_history(history, pm.encode)
+
+        if algorithm in ("wgl", "linear", "cpu"):
+            res = check_wgl_cpu(
+                packed,
+                pm,
+                max_configs=self.max_configs,
+                time_limit_s=self.time_limit_s,
+            )
+            return self._render(res, packed, "wgl", model, pm)
+
+        # Device-first paths.
+        from ..ops.wgl import check_wgl_device
+
+        res = check_wgl_device(
+            packed,
+            pm,
+            beam=self.beam,
+            max_beam=self.max_beam,
+            block=self.block,
+            time_limit_s=self.time_limit_s,
+        )
+        used = "wgl-tpu"
+        if res.valid == "unknown" and (
+            algorithm == "competition" or packed.n <= CPU_FALLBACK_MAX_OPS
+        ):
+            cpu = check_wgl_cpu(
+                packed,
+                pm,
+                max_configs=self.max_configs,
+                time_limit_s=self.time_limit_s,
+            )
+            if cpu.valid != "unknown":
+                res = cpu
+                used = "wgl-tpu+cpu-fallback"
+        return self._render(res, packed, used, model, pm)
+
+    def _render(
+        self,
+        res: WGLResult,
+        packed,
+        algorithm: str,
+        model,
+        pm: Optional[PackedModel] = None,
+    ) -> dict:
+        out = {
+            "valid": res.valid,
+            "algorithm": algorithm,
+            "configs-explored": res.configs_explored,
+            "elapsed-s": round(res.elapsed_s, 6),
+        }
+        if res.reason:
+            out["unknown-reason"] = res.reason
+        if res.valid is False and res.final_configs:
+            # Truncate like checker.clj:230-233 (10 configs).
+            out["final-configs"] = res.final_configs[:10]
+            if (
+                res.crashed_at is not None
+                and packed is not None
+                and pm is not None
+            ):
+                a = res.crashed_at
+                desc = (
+                    pm.describe_op(
+                        int(packed.f[a]), int(packed.a0[a]), int(packed.a1[a])
+                    )
+                    if pm.describe_op
+                    else None
+                )
+                out["crashed-op"] = {
+                    "history-index": int(packed.src_index[a]),
+                    "op": desc,
+                }
+        return out
+
+
+def linearizable(model=None, algorithm: str = "wgl-tpu", **kw) -> Linearizable:
+    return Linearizable(model, algorithm, **kw)

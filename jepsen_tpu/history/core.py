@@ -172,52 +172,61 @@ class History(Sequence[Op]):
                 for i, o in enumerate(rows)
             ]
         self.ops: tuple[Op, ...] = tuple(rows)
+        #: Op.index -> position in self.ops (they differ on filtered views,
+        #: which preserve original indices).
+        self._by_index: dict[int, int] = {
+            o.index: pos for pos, o in enumerate(self.ops)
+        }
         self._pair_index = self._compute_pairs()
-        self._by_index = None
 
     # -- pairing ----------------------------------------------------------
 
-    def _compute_pairs(self) -> list[int]:
-        """pair_index[i] = index of the op paired with ops[i], or -1.
+    def _compute_pairs(self) -> dict[int, int]:
+        """Maps Op.index -> paired Op.index.
 
         An invocation pairs with the next op on the same process (its
         completion).  Client processes perform one op at a time; a client
         :info completion crashes the process, after which the interpreter
         assigns a fresh pid (interpreter.clj:245-249), so same-process
         pairing is unambiguous.  Nemesis invokes pair with the following
-        nemesis completion."""
-        pair = [-1] * len(self.ops)
+        nemesis completion.  A double invoke without completion is
+        tolerated (earlier op stays unpaired), like jepsen.history."""
+        pair: dict[int, int] = {}
         pending: dict[Any, int] = {}
-        for i, o in enumerate(self.ops):
+        for o in self.ops:
             if o.is_invoke:
-                if o.process in pending:
-                    # Double invoke without completion: malformed, but be
-                    # tolerant like jepsen.history — earlier one stays
-                    # unpaired.
-                    pass
-                pending[o.process] = i
+                pending[o.process] = o.index
             else:
                 j = pending.pop(o.process, None)
                 if j is not None:
-                    pair[j] = i
-                    pair[i] = j
+                    pair[j] = o.index
+                    pair[o.index] = j
         return pair
 
     def completion(self, o: Op | int) -> Op | None:
         """The completion op for an invocation (or None if it never
-        completed)."""
+        completed).  Works on filtered views: lookups key on Op.index."""
         i = o if isinstance(o, int) else o.index
-        j = self._pair_index[i]
-        return self.ops[j] if j >= 0 and j > i else None
+        j = self._pair_index.get(i, -1)
+        if j > i and j in self._by_index:
+            return self.ops[self._by_index[j]]
+        return None
 
     def invocation(self, o: Op | int) -> Op | None:
         """The invocation op for a completion."""
         i = o if isinstance(o, int) else o.index
-        j = self._pair_index[i]
-        return self.ops[j] if j >= 0 and j < i else None
+        j = self._pair_index.get(i, -1)
+        if 0 <= j < i and j in self._by_index:
+            return self.ops[self._by_index[j]]
+        return None
 
     def pair_index(self, i: int) -> int:
-        return self._pair_index[i]
+        return self._pair_index.get(i, -1)
+
+    def get_index(self, i: int) -> Op | None:
+        """The op with Op.index == i, or None (O(1))."""
+        pos = self._by_index.get(i)
+        return self.ops[pos] if pos is not None else None
 
     # -- sequence protocol -------------------------------------------------
 
@@ -275,9 +284,9 @@ class History(Sequence[Op]):
         return self.filter(lambda o: o.process == NEMESIS)
 
     def has_f(self, fs) -> "History":
-        fset = set(fs) if not callable(fs) else None
-        if fset is None:
+        if callable(fs):
             return self.filter(lambda o: fs(o.f))
+        fset = {fs} if isinstance(fs, str) else set(fs)
         return self.filter(lambda o: o.f in fset)
 
     def possible(self) -> "History":
@@ -286,7 +295,7 @@ class History(Sequence[Op]):
         failed_invokes = {
             self._pair_index[o.index]
             for o in self.ops
-            if o.is_fail and self._pair_index[o.index] >= 0
+            if o.is_fail and o.index in self._pair_index
         }
         return self.filter(
             lambda o: not (o.is_fail or o.index in failed_invokes)

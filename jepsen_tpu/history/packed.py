@@ -8,10 +8,13 @@ preprocessing provide to the reference's checkers (SURVEY.md §2.4), but
 columnar from the start.
 
 Shapes: for a history with n live operations (invoke/completion pairs from
-client ops, certain failures dropped), every column is an `(n,)` int32
-numpy array, sorted by invocation order.  Precedence structure is reduced
-to two counters per op (SURVEY.md §7 stage 3; see ops/wgl.py for how the
-search uses them):
+client ops, certain failures dropped), every column is an `(n,)` numpy
+array sorted by invocation order — int32 for op payloads
+(process/status/f/a0/a1), int64 for event bookkeeping (inv/ret/src_index/
+preds/horizon, since ret uses NO_RET = int64 max; the device path clamps
+to int32 INF on transfer).  Precedence structure is reduced to two
+counters per op (SURVEY.md §7 stage 3; see ops/wgl.py for how the search
+uses them):
 
   preds[a] = #{y != a : ret(y) < inv(a)}   ops that must precede a
   horizon[a] = #{y != a : inv(y) < ret(a)} last level at which a may remain
@@ -162,6 +165,11 @@ def pack_history(h: History, encode: OpEncoderFn) -> PackedOps:
 
     for o, e in events:
         if o.type == INVOKE:
+            prev = pending.get(o.process)
+            if prev is not None:
+                # Double invoke without completion (torn history): the
+                # earlier op is indeterminate, like core pairing keeps it.
+                emit(prev[0], prev[1], -1, None)
             pending[o.process] = (e, o)
         else:
             inv = pending.pop(o.process, None)

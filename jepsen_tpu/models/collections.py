@@ -1,0 +1,136 @@
+"""Collection models: set, unordered-queue, FIFO queue.
+
+Host-only knossos.model equivalents (SURVEY.md §2.4).  These back the
+generic `linearizable` checker for collection workloads; the cheap
+specialized checkers (checker.set / checker.queue / checker.total_queue)
+don't need a model at all, mirroring the reference split
+(checker.clj:235-287, 648-708).
+
+These models carry unbounded Python collections, so they have no packed
+int32 form yet; `packed()` raises, and the linearizable checker falls back
+to the CPU search for them.
+"""
+
+from __future__ import annotations
+
+from typing import Any, FrozenSet, Tuple
+
+from ..history.core import Op
+from .base import Model, inconsistent
+
+
+def _freeze(v: Any) -> Any:
+    if isinstance(v, list):
+        return tuple(v)
+    if isinstance(v, set):
+        return frozenset(v)
+    return v
+
+
+class SetModel(Model):
+    """A grow-only set: `add` elements, `read` the full contents."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: FrozenSet[Any] = frozenset()):
+        self.items = frozenset(items)
+
+    def step(self, op: Op):
+        if op.f == "add":
+            return SetModel(self.items | {_freeze(op.value)})
+        if op.f == "read":
+            if op.value is None:
+                return self
+            got = frozenset(_freeze(x) for x in op.value)
+            if got == self.items:
+                return self
+            return inconsistent(
+                f"read {sorted(map(repr, got))} but set contained "
+                f"{sorted(map(repr, self.items))}"
+            )
+        return inconsistent(f"unknown op f {op.f!r}")
+
+    def __eq__(self, other):
+        return type(other) is SetModel and other.items == self.items
+
+    def __hash__(self):
+        return hash(("SetModel", self.items))
+
+    def __repr__(self):
+        return f"SetModel({sorted(map(repr, self.items))})"
+
+
+class UnorderedQueue(Model):
+    """A queue where dequeue may return any enqueued-but-not-dequeued
+    element (knossos.model/unordered-queue)."""
+
+    __slots__ = ("pending",)
+
+    def __init__(self, pending: Tuple[Any, ...] = ()):
+        self.pending = tuple(pending)
+
+    def step(self, op: Op):
+        v = _freeze(op.value)
+        if op.f == "enqueue":
+            return UnorderedQueue(self.pending + (v,))
+        if op.f == "dequeue":
+            if v in self.pending:
+                i = self.pending.index(v)
+                return UnorderedQueue(self.pending[:i] + self.pending[i + 1 :])
+            return inconsistent(f"can't dequeue {v!r}: not in queue")
+        return inconsistent(f"unknown op f {op.f!r}")
+
+    def __eq__(self, other):
+        return type(other) is UnorderedQueue and sorted(
+            map(repr, other.pending)
+        ) == sorted(map(repr, self.pending))
+
+    def __hash__(self):
+        return hash(("UnorderedQueue", tuple(sorted(map(repr, self.pending)))))
+
+    def __repr__(self):
+        return f"UnorderedQueue({list(self.pending)!r})"
+
+
+class FIFOQueue(Model):
+    """A strict FIFO queue: dequeue must return the head."""
+
+    __slots__ = ("items",)
+
+    def __init__(self, items: Tuple[Any, ...] = ()):
+        self.items = tuple(items)
+
+    def step(self, op: Op):
+        v = _freeze(op.value)
+        if op.f == "enqueue":
+            return FIFOQueue(self.items + (v,))
+        if op.f == "dequeue":
+            if not self.items:
+                return inconsistent(f"can't dequeue {v!r} from empty queue")
+            if self.items[0] == v:
+                return FIFOQueue(self.items[1:])
+            return inconsistent(
+                f"dequeued {v!r} but head was {self.items[0]!r}"
+            )
+        return inconsistent(f"unknown op f {op.f!r}")
+
+    def __eq__(self, other):
+        return type(other) is FIFOQueue and other.items == self.items
+
+    def __hash__(self):
+        return hash(("FIFOQueue", self.items))
+
+    def __repr__(self):
+        return f"FIFOQueue({list(self.items)!r})"
+
+
+def set_model() -> SetModel:
+    return SetModel()
+
+
+def unordered_queue() -> UnorderedQueue:
+    return UnorderedQueue()
+
+
+def fifo_queue() -> FIFOQueue:
+    return FIFOQueue()

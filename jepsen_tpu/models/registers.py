@@ -1,0 +1,236 @@
+"""Register-family models: register, cas-register, multi-register.
+
+Semantics mirror knossos.model's registers as used by the reference
+(`knossos.model/cas-register` at tests/linearizable_register.clj:22-53;
+protocol in doc/tutorial/04-checker.md): a read of `nil` is unconstrained
+(unknown return), reads must otherwise match the current value, writes
+always succeed, cas succeeds iff the old value matches.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ..history.core import OK, Op
+from ..history.packed import NIL, Interner
+from .base import Inconsistent, Model, PackedModel, inconsistent, intern_value
+
+F_READ, F_WRITE, F_CAS = 0, 1, 2
+_F_NAMES = {F_READ: "read", F_WRITE: "write", F_CAS: "cas"}
+
+
+class Register(Model):
+    """A single read/write register."""
+
+    __slots__ = ("value",)
+    fs = ("read", "write")
+
+    def __init__(self, value: Any = None):
+        self.value = value
+
+    def step(self, op: Op):
+        if op.f == "read":
+            if op.value is None or op.value == self.value:
+                return self
+            return inconsistent(
+                f"read {op.value!r} but register held {self.value!r}"
+            )
+        if op.f == "write":
+            return type(self)(op.value)
+        return inconsistent(f"unknown op f {op.f!r}")
+
+    def __eq__(self, other):
+        return type(other) is type(self) and other.value == self.value
+
+    def __hash__(self):
+        return hash((type(self).__name__, self.value))
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self.value!r})"
+
+    # -- packed -----------------------------------------------------------
+
+    def packed(self) -> PackedModel:
+        return _register_packed(self, allow_cas=False)
+
+
+class CASRegister(Register):
+    """A register with read/write/compare-and-set — the canonical
+    linearizability workload (BASELINE.json configs 1 and 4)."""
+
+    fs = ("read", "write", "cas")
+
+    def step(self, op: Op):
+        if op.f == "cas":
+            old, new = op.value
+            if self.value == old:
+                return CASRegister(new)
+            return inconsistent(
+                f"cas from {old!r} but register held {self.value!r}"
+            )
+        return super().step(op)
+
+    def packed(self) -> PackedModel:
+        return _register_packed(self, allow_cas=True)
+
+
+def _register_packed(model: Register, allow_cas: bool) -> PackedModel:
+    interner = Interner()
+    nil_code = interner.intern(None)  # id 0
+    init = (intern_value(interner, model.value),)
+
+    def encode(inv: Op, comp: Optional[Op]):
+        f = inv.f
+        if f == "read":
+            if comp is None or comp.type != OK:
+                return None  # indeterminate read: no effect, droppable
+            if comp.value is None:
+                return None  # unknown return: unconstrained, droppable
+            return (F_READ, intern_value(interner, comp.value), NIL)
+        if f == "write":
+            return (F_WRITE, intern_value(interner, inv.value), NIL)
+        if f == "cas" and allow_cas:
+            old, new = inv.value
+            return (
+                F_CAS,
+                intern_value(interner, old),
+                intern_value(interner, new),
+            )
+        raise ValueError(f"register model can't encode op f {f!r}")
+
+    def py_step(state, f, a0, a1):
+        s = state[0]
+        if f == F_READ:
+            return state, s == a0
+        if f == F_WRITE:
+            return (a0,), True
+        # cas
+        return (a1,), s == a0
+
+    def jax_step(state, f, a0, a1):
+        import jax.numpy as jnp
+
+        s = state[0]
+        is_write = f == F_WRITE
+        is_cas = f == F_CAS
+        legal = is_write | (s == a0)
+        new = jnp.where(is_write, a0, jnp.where(is_cas, a1, s))
+        return state.at[0].set(new), legal
+
+    def describe_op(f: int, a0: int, a1: int) -> str:
+        if f == F_READ:
+            return f"read -> {interner.value(a0)!r}"
+        if f == F_WRITE:
+            return f"write {interner.value(a0)!r}"
+        return f"cas {interner.value(a0)!r} -> {interner.value(a1)!r}"
+
+    return PackedModel(
+        name="cas-register" if allow_cas else "register",
+        state_width=1,
+        init_state=init,
+        encode=encode,
+        py_step=py_step,
+        jax_step=jax_step,
+        interner=interner,
+        describe_op=describe_op,
+    )
+
+
+class MultiRegister(Model):
+    """A fixed set of named registers; ops read/write a single (k, v) pair
+    (knossos.model/multi-register restricted to unit txns — the
+    per-key-WGL benchmark config in BASELINE.json uses
+    jepsen.independent to shard keys instead of packing them here)."""
+
+    __slots__ = ("values",)
+
+    def __init__(self, values: dict[Any, Any]):
+        self.values = dict(values)
+
+    def step(self, op: Op):
+        k, v = op.value
+        if k not in self.values:
+            return inconsistent(f"no such register {k!r}")
+        if op.f == "read":
+            if v is None or self.values[k] == v:
+                return self
+            return inconsistent(
+                f"read {v!r} from {k!r} which held {self.values[k]!r}"
+            )
+        if op.f == "write":
+            nv = dict(self.values)
+            nv[k] = v
+            return MultiRegister(nv)
+        return inconsistent(f"unknown op f {op.f!r}")
+
+    def __eq__(self, other):
+        return type(other) is MultiRegister and other.values == self.values
+
+    def __hash__(self):
+        return hash(tuple(sorted(self.values.items(), key=repr)))
+
+    def __repr__(self):
+        return f"MultiRegister({self.values!r})"
+
+    def packed(self) -> PackedModel:
+        interner = Interner()
+        interner.intern(None)
+        keys = list(self.values.keys())
+        key_idx = {k: i for i, k in enumerate(keys)}
+        init = tuple(intern_value(interner, self.values[k]) for k in keys)
+
+        def encode(inv: Op, comp: Optional[Op]):
+            if inv.f == "read":
+                if comp is None or comp.type != OK:
+                    return None
+                k, v = comp.value
+                if v is None:
+                    return None
+                return (F_READ, key_idx[k], intern_value(interner, v))
+            if inv.f == "write":
+                k, v = inv.value
+                return (F_WRITE, key_idx[k], intern_value(interner, v))
+            raise ValueError(f"multi-register can't encode op f {inv.f!r}")
+
+        def py_step(state, f, a0, a1):
+            if f == F_READ:
+                return state, state[a0] == a1
+            s = list(state)
+            s[a0] = a1
+            return tuple(s), True
+
+        def jax_step(state, f, a0, a1):
+            import jax.numpy as jnp
+
+            cur = state[a0]
+            is_write = f == F_WRITE
+            legal = is_write | (cur == a1)
+            new = jnp.where(is_write, a1, cur)
+            return state.at[a0].set(new), legal
+
+        def describe_op(f: int, a0: int, a1: int) -> str:
+            verb = "read" if f == F_READ else "write"
+            return f"{verb} {keys[a0]!r} {interner.value(a1)!r}"
+
+        return PackedModel(
+            name="multi-register",
+            state_width=len(keys),
+            init_state=init,
+            encode=encode,
+            py_step=py_step,
+            jax_step=jax_step,
+            interner=interner,
+            describe_op=describe_op,
+        )
+
+
+def register(value: Any = None) -> Register:
+    return Register(value)
+
+
+def cas_register(value: Any = None) -> CASRegister:
+    return CASRegister(value)
+
+
+def multi_register(values: dict[Any, Any]) -> MultiRegister:
+    return MultiRegister(values)

@@ -197,9 +197,15 @@ def await_fn(
     timeout_ms: float = 60_000.0,
     log_interval_ms: float | None = 10_000.0,
     log_message: str | None = None,
+    log: Callable[[str], None] | None = None,
 ) -> T:
     """Invokes f until it returns without throwing; throws JepsenTimeout when
-    the deadline passes (util.clj:443-485)."""
+    the deadline passes.  Logs progress via `log` every `log_interval_ms`
+    (util.clj:443-485; defaults to the stdlib logger)."""
+    if log is None:
+        import logging
+
+        log = logging.getLogger("jepsen_tpu").info
     deadline = _time.monotonic() + timeout_ms / 1000.0
     last_log = _time.monotonic()
     while True:
@@ -213,6 +219,7 @@ def await_fn(
                 ) from e
             if log_interval_ms and (now - last_log) * 1000 >= log_interval_ms:
                 last_log = now
+                log(log_message or f"waiting for {getattr(f, '__name__', 'fn')}")
             _time.sleep(retry_interval_ms / 1000.0)
 
 

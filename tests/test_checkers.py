@@ -1,0 +1,387 @@
+"""Stock checker tests from literal histories (the checker_test.clj
+style: queue :98, set :120, counter :241, set-full :631, unique-ids,
+log-file-pattern :799)."""
+
+import pytest
+
+from jepsen_tpu.checker import (
+    Compose,
+    CounterChecker,
+    LogFilePattern,
+    Queue,
+    SetChecker,
+    SetFull,
+    Stats,
+    TotalQueue,
+    UnhandledExceptions,
+    UniqueIds,
+    check_safe,
+    checker,
+    compose,
+    linearizable,
+    merge_valid,
+)
+from jepsen_tpu.history import (
+    FAIL,
+    INFO,
+    INVOKE,
+    OK,
+    History,
+    parse_literal,
+)
+from jepsen_tpu.models import cas_register, unordered_queue
+
+
+def h(rows):
+    return parse_literal(rows)
+
+
+class TestMergeValid:
+    def test_ranks(self):
+        assert merge_valid([True, True]) is True
+        assert merge_valid([True, "unknown"]) == "unknown"
+        assert merge_valid([False, "unknown", True]) is False
+        assert merge_valid([]) is True
+
+
+class TestCompose:
+    def test_compose_merges(self):
+        ok = checker(lambda t, hh, o: {"valid": True})
+        bad = checker(lambda t, hh, o: {"valid": False})
+        r = compose({"a": ok, "b": bad}).check({}, h([]), {})
+        assert r["valid"] is False
+        assert r["a"]["valid"] is True
+
+    def test_check_safe_catches(self):
+        def boom(t, hh, o):
+            raise RuntimeError("boom")
+
+        r = check_safe(checker(boom), {}, h([]), {})
+        assert r["valid"] == "unknown"
+        assert "boom" in r["error"]
+
+
+class TestStats:
+    def test_stats(self):
+        r = Stats().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "read", None),
+                    (0, OK, "read", 1),
+                    (1, INVOKE, "write", 1),
+                    (1, FAIL, "write", 1),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False  # write never ok
+        assert r["by-f"]["read"]["valid"] is True
+        assert r["by-f"]["write"]["ok-count"] == 0
+
+
+class TestQueue:
+    def test_queue_valid(self):
+        r = Queue(unordered_queue()).check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "enqueue", 1),
+                    (0, OK, "enqueue", 1),
+                    (1, INVOKE, "dequeue", None),
+                    (1, OK, "dequeue", 1),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+
+    def test_queue_phantom_dequeue(self):
+        r = Queue(unordered_queue()).check(
+            {},
+            h([(1, INVOKE, "dequeue", None), (1, OK, "dequeue", 9)]),
+            {},
+        )
+        assert r["valid"] is False
+
+    def test_queue_info_enqueue_may_happen(self):
+        r = Queue(unordered_queue()).check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "enqueue", 1),
+                    (0, INFO, "enqueue", 1),
+                    (1, INVOKE, "dequeue", None),
+                    (1, OK, "dequeue", 1),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+
+
+class TestTotalQueue:
+    def test_lost_and_unexpected(self):
+        r = TotalQueue().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "enqueue", 1),
+                    (0, OK, "enqueue", 1),
+                    (0, INVOKE, "enqueue", 2),
+                    (0, OK, "enqueue", 2),
+                    (1, INVOKE, "dequeue", None),
+                    (1, OK, "dequeue", 2),
+                    (1, INVOKE, "dequeue", None),
+                    (1, OK, "dequeue", 9),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["lost"] == {1}
+        assert r["unexpected"] == {9}
+
+    def test_recovered(self):
+        r = TotalQueue().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "enqueue", 1),
+                    (0, INFO, "enqueue", 1),
+                    (1, INVOKE, "dequeue", None),
+                    (1, OK, "dequeue", 1),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+        assert r["recovered"] == {1}
+
+
+class TestSet:
+    def test_set_ok(self):
+        r = SetChecker().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 1),
+                    (0, OK, "add", 1),
+                    (0, INVOKE, "add", 2),
+                    (0, INFO, "add", 2),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", [1, 2]),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+        assert r["recovered-count"] == 1
+
+    def test_set_lost(self):
+        r = SetChecker().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 1),
+                    (0, OK, "add", 1),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", []),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["lost"] == [1]
+
+    def test_set_no_read(self):
+        r = SetChecker().check({}, h([(0, INVOKE, "add", 1), (0, OK, "add", 1)]), {})
+        assert r["valid"] == "unknown"
+
+
+class TestSetFull:
+    def test_lost_element(self):
+        r = SetFull().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 1),
+                    (0, OK, "add", 1),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", [1]),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", []),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False
+        assert 1 in r["lost"]
+
+    def test_stale_read_tolerated_by_default(self):
+        rows = [
+            (0, INVOKE, "add", 1),
+            (0, OK, "add", 1),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", []),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", [1]),
+        ]
+        assert SetFull().check({}, h(rows), {})["valid"] is True
+        assert SetFull(linearizable=True).check({}, h(rows), {})["valid"] is False
+
+
+class TestUniqueIds:
+    def test_dups(self):
+        r = UniqueIds().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "generate", None),
+                    (0, OK, "generate", 5),
+                    (1, INVOKE, "generate", None),
+                    (1, OK, "generate", 5),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["duplicated-count"] == 1
+
+
+class TestCounter:
+    def test_valid_reads(self):
+        r = CounterChecker().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 5),
+                    (0, OK, "add", 5),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", 5),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+
+    def test_concurrent_add_widens_bounds(self):
+        r = CounterChecker().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 5),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", 5),  # add may already apply
+                    (0, OK, "add", 5),
+                    (2, INVOKE, "read", None),
+                    (2, OK, "read", 5),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+
+    def test_impossible_read(self):
+        r = CounterChecker().check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 5),
+                    (0, OK, "add", 5),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", 99),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["error-count"] == 1
+
+    def test_info_add_optional(self):
+        rows = [
+            (0, INVOKE, "add", 5),
+            (0, INFO, "add", 5),
+            (1, INVOKE, "read", None),
+            (1, OK, "read", 0),
+            (2, INVOKE, "read", None),
+            (2, OK, "read", 5),
+        ]
+        assert CounterChecker().check({}, h(rows), {})["valid"] is True
+
+
+class TestLogFilePattern:
+    def test_grep(self, tmp_path):
+        node_dir = tmp_path / "n1"
+        node_dir.mkdir()
+        (node_dir / "db.log").write_text("ok\npanic: segfault\nok\n")
+        r = LogFilePattern("panic", "db.log").check(
+            {"nodes": ["n1"], "store_dir": str(tmp_path)}, h([]), {}
+        )
+        assert r["valid"] is False
+        assert r["count"] == 1
+        r2 = LogFilePattern("nope", "db.log").check(
+            {"nodes": ["n1"], "store_dir": str(tmp_path)}, h([]), {}
+        )
+        assert r2["valid"] is True
+
+
+class TestLinearizableChecker:
+    def test_tpu_algorithm(self):
+        r = linearizable(cas_register(0), algorithm="wgl-tpu").check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "write", 1),
+                    (0, OK, "write", 1),
+                    (1, INVOKE, "read", 1),
+                    (1, OK, "read", 1),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+        assert "wgl" in r["algorithm"]
+
+    def test_cpu_algorithm_invalid_with_report(self):
+        r = linearizable(cas_register(0), algorithm="wgl").check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "write", 1),
+                    (0, OK, "write", 1),
+                    (1, INVOKE, "read", 2),
+                    (1, OK, "read", 2),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["final-configs"]
+        assert r["crashed-op"]["op"] == "read -> 2"
+
+    def test_host_model_fallback(self):
+        from jepsen_tpu.models import set_model
+
+        r = linearizable(set_model()).check(
+            {},
+            h(
+                [
+                    (0, INVOKE, "add", 1),
+                    (0, OK, "add", 1),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", [1]),
+                ]
+            ),
+            {},
+        )
+        assert r["valid"] is True
+        assert r["algorithm"] == "wgl-host"
+
+    def test_model_from_test_map(self):
+        r = linearizable(algorithm="wgl").check(
+            {"model": cas_register(0)},
+            h([(0, INVOKE, "read", 0), (0, OK, "read", 0)]),
+            {},
+        )
+        assert r["valid"] is True

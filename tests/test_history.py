@@ -196,3 +196,54 @@ class TestOpDicts:
         d = o.to_dict()
         o2 = Op.from_dict(d)
         assert o2 == o
+
+
+class TestFilteredViewPairing:
+    """Regression: pairing lookups must work on filtered views, which
+    preserve original Op indices."""
+
+    def test_completion_on_filtered_view(self):
+        h = mk(
+            [
+                (NEMESIS, INVOKE, "start", None),
+                (0, INVOKE, "read", None),
+                (NEMESIS, INFO, "start", None),
+                (0, OK, "read", 1),
+            ]
+        )
+        c = h.client_ops()
+        assert c.completion(c[0]).index == 3
+        assert c.invocation(c[1]).index == 1
+
+    def test_possible_on_filtered_view(self):
+        h = mk(
+            [
+                (NEMESIS, INVOKE, "start", None),
+                (0, INVOKE, "write", 1),
+                (0, FAIL, "write", 1),
+            ]
+        )
+        p = h.client_ops().possible()
+        assert len(p) == 0
+
+    def test_has_f_accepts_bare_string(self):
+        h = mk([(0, INVOKE, "read", None), (0, OK, "read", 0)])
+        assert len(h.has_f("read")) == 2
+
+    def test_get_index(self):
+        h = mk([(0, INVOKE, "read", None), (0, OK, "read", 0)])
+        v = h.oks()
+        assert v.get_index(1).type == OK
+        assert v.get_index(0) is None
+
+    def test_double_invoke_packs_as_indeterminate(self):
+        h = mk(
+            [
+                (0, INVOKE, "write", 1),
+                (0, INVOKE, "write", 2),
+                (0, OK, "write", 2),
+            ]
+        )
+        p = pack_history(h, cas_encode)
+        assert p.n == 2
+        assert (p.status == ST_INFO).sum() == 1

@@ -1,0 +1,150 @@
+"""Model tests: host step semantics + packed py/jax step parity."""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history import NIL, OK, Op, invoke, ok
+from jepsen_tpu.models import (
+    CASRegister,
+    FIFOQueue,
+    Mutex,
+    MultiRegister,
+    Register,
+    SetModel,
+    UnorderedQueue,
+    cas_register,
+    mutex,
+)
+
+
+def o(f, value=None):
+    return Op(type=OK, f=f, value=value, process=0)
+
+
+class TestCASRegister:
+    def test_read_write_cas(self):
+        m = cas_register(0)
+        m = m.step(o("write", 5))
+        assert not m.is_inconsistent
+        m2 = m.step(o("read", 5))
+        assert m2 == m
+        bad = m.step(o("read", 6))
+        assert bad.is_inconsistent
+        m3 = m.step(o("cas", [5, 7]))
+        assert m3 == CASRegister(7)
+        assert m.step(o("cas", [9, 1])).is_inconsistent
+
+    def test_nil_read_unconstrained(self):
+        m = cas_register(3)
+        assert m.step(o("read", None)) == m
+
+    def test_model_equality_hash(self):
+        assert cas_register(1) == cas_register(1)
+        assert hash(cas_register(1)) == hash(cas_register(1))
+        assert cas_register(1) != Register(1)
+
+
+class TestMutex:
+    def test_acquire_release(self):
+        m = mutex()
+        m2 = m.step(o("acquire"))
+        assert not m2.is_inconsistent
+        assert m2.step(o("acquire")).is_inconsistent
+        m3 = m2.step(o("release"))
+        assert m3 == mutex()
+        assert m.step(o("release")).is_inconsistent
+
+
+class TestCollections:
+    def test_set(self):
+        m = SetModel()
+        m = m.step(o("add", 1)).step(o("add", 2))
+        assert not m.step(o("read", [1, 2])).is_inconsistent
+        assert m.step(o("read", [1])).is_inconsistent
+
+    def test_unordered_queue(self):
+        m = UnorderedQueue()
+        m = m.step(o("enqueue", 1)).step(o("enqueue", 2))
+        assert not m.step(o("dequeue", 2)).is_inconsistent
+        assert m.step(o("dequeue", 3)).is_inconsistent
+
+    def test_fifo_queue(self):
+        m = FIFOQueue()
+        m = m.step(o("enqueue", 1)).step(o("enqueue", 2))
+        assert m.step(o("dequeue", 2)).is_inconsistent
+        m2 = m.step(o("dequeue", 1))
+        assert not m2.is_inconsistent
+
+
+class TestMultiRegister:
+    def test_step(self):
+        m = MultiRegister({"x": 0, "y": 0})
+        m = m.step(o("write", ["x", 3]))
+        assert not m.step(o("read", ["x", 3])).is_inconsistent
+        assert m.step(o("read", ["y", 3])).is_inconsistent
+        assert m.step(o("read", ["z", 0])).is_inconsistent
+
+
+def _step_parity(pm, cases):
+    """py_step and jax_step must agree on every (state, f, a0, a1) case."""
+    import jax
+    import jax.numpy as jnp
+
+    jstep = jax.jit(pm.jax_step)
+    for state, f, a0, a1 in cases:
+        py_state, py_legal = pm.py_step(state, f, a0, a1)
+        jstate, jlegal = jstep(jnp.array(state, dtype=jnp.int32), f, a0, a1)
+        assert bool(jlegal) == bool(py_legal), (state, f, a0, a1)
+        if py_legal:
+            assert tuple(np.asarray(jstate).tolist()) == tuple(py_state), (
+                state,
+                f,
+                a0,
+                a1,
+            )
+
+
+class TestPackedParity:
+    def test_cas_register_packed(self):
+        pm = cas_register(None).packed()
+        assert pm.state_width == 1
+        # f codes: 0 read, 1 write, 2 cas
+        cases = [
+            ((0,), 0, 0, NIL),  # read nil from nil: legal
+            ((0,), 0, 1, NIL),  # read 1 from nil: illegal
+            ((0,), 1, 2, NIL),  # write
+            ((2,), 2, 2, 3),    # cas ok
+            ((2,), 2, 9, 3),    # cas bad
+        ]
+        _step_parity(pm, cases)
+
+    def test_mutex_packed(self):
+        pm = mutex().packed()
+        cases = [
+            ((0,), 0, NIL, NIL),  # acquire free
+            ((1,), 0, NIL, NIL),  # acquire held
+            ((1,), 1, NIL, NIL),  # release held
+            ((0,), 1, NIL, NIL),  # release free
+        ]
+        _step_parity(pm, cases)
+
+    def test_multi_register_packed(self):
+        pm = MultiRegister({"x": 0, "y": 1}).packed()
+        assert pm.state_width == 2
+        cases = [
+            ((1, 2), 0, 0, 1),  # read x==1 ok
+            ((1, 2), 0, 1, 1),  # read y==1? y holds 2: illegal
+            ((1, 2), 1, 1, 5),  # write y=5
+        ]
+        _step_parity(pm, cases)
+
+    def test_encoder_drops_nil_and_indeterminate_reads(self):
+        pm = cas_register(None).packed()
+        assert pm.encode(invoke("read", None), None) is None
+        assert pm.encode(invoke("read", None), ok("read", None)) is None
+        enc = pm.encode(invoke("read", None), ok("read", 5))
+        assert enc is not None and enc[0] == 0
+
+    def test_host_only_models_raise(self):
+        with pytest.raises(NotImplementedError):
+            SetModel().packed()

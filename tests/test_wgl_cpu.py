@@ -1,0 +1,339 @@
+"""CPU WGL search tests: literal histories + randomized cross-check
+against an independent brute-force oracle (the test style of
+checker_test.clj + generator/test.clj's fixed-seed determinism)."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.history import (
+    FAIL,
+    INFO,
+    INVOKE,
+    OK,
+    History,
+    Op,
+    pack_history,
+    parse_literal,
+)
+from jepsen_tpu.checker.wgl_cpu import check_wgl_cpu
+from jepsen_tpu.models import cas_register, mutex
+
+
+def check(rows, model=None):
+    model = model or cas_register(None)
+    pm = model.packed()
+    packed = pack_history(parse_literal(rows), pm.encode)
+    return check_wgl_cpu(packed, pm)
+
+
+def brute_force_valid(packed, pm) -> bool:
+    """Independent oracle: recursively append any op whose real-time
+    predecessors are all linearized (direct definition, no min-ret trick,
+    no memoization)."""
+    n = packed.n
+    inv = packed.inv.tolist()
+    ret = packed.ret.tolist()
+    ok_mask = 0
+    from jepsen_tpu.history import ST_OK
+
+    for i in range(n):
+        if packed.status[i] == ST_OK:
+            ok_mask |= 1 << i
+
+    seen = set()
+
+    def rec(S, state):
+        if (S & ok_mask) == ok_mask:
+            return True
+        if (S, state) in seen:
+            return False
+        seen.add((S, state))
+        for a in range(n):
+            if (S >> a) & 1:
+                continue
+            # all predecessors of a linearized?
+            if any(
+                ret[y] < inv[a] and not (S >> y) & 1 for y in range(n) if y != a
+            ):
+                continue
+            ns, legal = pm.py_step(state, int(packed.f[a]), int(packed.a0[a]), int(packed.a1[a]))
+            if not legal:
+                continue
+            if rec(S | (1 << a), ns):
+                return True
+        return False
+
+    return rec(0, tuple(pm.init_state))
+
+
+class TestLiteralHistories:
+    def test_empty(self):
+        assert check([]).valid is True
+
+    def test_sequential_valid(self):
+        assert (
+            check(
+                [
+                    (0, INVOKE, "write", 1),
+                    (0, OK, "write", 1),
+                    (0, INVOKE, "read", 1),
+                    (0, OK, "read", 1),
+                ]
+            ).valid
+            is True
+        )
+
+    def test_sequential_invalid_read(self):
+        r = check(
+            [
+                (0, INVOKE, "write", 1),
+                (0, OK, "write", 1),
+                (0, INVOKE, "read", 2),
+                (0, OK, "read", 2),
+            ]
+        )
+        assert r.valid is False
+        assert r.final_configs  # failure report present
+
+    def test_concurrent_reads_both_orders(self):
+        # w1 concurrent with r0 and r1: both readable depending on order.
+        assert (
+            check(
+                [
+                    (0, INVOKE, "write", 1),
+                    (1, INVOKE, "read", None),
+                    (1, OK, "read", 0),  # read initial value... register init None
+                ],
+                model=cas_register(0),
+            ).valid
+            is True
+        )
+
+    def test_precedence_violation(self):
+        # A=w1 ok; then strictly later read of initial value: invalid.
+        r = check(
+            [
+                (0, INVOKE, "write", 1),
+                (0, OK, "write", 1),
+                (1, INVOKE, "read", 0),
+                (1, OK, "read", 0),
+            ],
+            model=cas_register(0),
+        )
+        assert r.valid is False
+
+    def test_real_time_order_with_overlap_valid(self):
+        # B starts before A returns: may linearize before A.
+        assert (
+            check(
+                [
+                    (0, INVOKE, "write", 1),
+                    (1, INVOKE, "read", 0),
+                    (1, OK, "read", 0),
+                    (0, OK, "write", 1),
+                ],
+                model=cas_register(0),
+            ).valid
+            is True
+        )
+
+    def test_info_write_explains_read(self):
+        # Crashed write may have taken effect; later read sees it: valid.
+        assert (
+            check(
+                [
+                    (0, INVOKE, "write", 7),
+                    (0, INFO, "write", 7),
+                    (1, INVOKE, "read", 7),
+                    (1, OK, "read", 7),
+                ],
+                model=cas_register(0),
+            ).valid
+            is True
+        )
+
+    def test_info_write_optional(self):
+        # Crashed write need not take effect: read of old value also valid.
+        assert (
+            check(
+                [
+                    (0, INVOKE, "write", 7),
+                    (0, INFO, "write", 7),
+                    (1, INVOKE, "read", 0),
+                    (1, OK, "read", 0),
+                ],
+                model=cas_register(0),
+            ).valid
+            is True
+        )
+
+    def test_failed_write_never_happened(self):
+        r = check(
+            [
+                (0, INVOKE, "write", 7),
+                (0, FAIL, "write", 7),
+                (1, INVOKE, "read", 7),
+                (1, OK, "read", 7),
+            ],
+            model=cas_register(0),
+        )
+        assert r.valid is False
+
+    def test_cas_chain(self):
+        assert (
+            check(
+                [
+                    (0, INVOKE, "write", 1),
+                    (0, OK, "write", 1),
+                    (1, INVOKE, "cas", [1, 2]),
+                    (1, OK, "cas", [1, 2]),
+                    (2, INVOKE, "read", 2),
+                    (2, OK, "read", 2),
+                ],
+                model=cas_register(0),
+            ).valid
+            is True
+        )
+
+    def test_mutex_double_acquire_invalid(self):
+        r = check(
+            [
+                (0, INVOKE, "acquire", None),
+                (0, OK, "acquire", None),
+                (1, INVOKE, "acquire", None),
+                (1, OK, "acquire", None),
+            ],
+            model=mutex(),
+        )
+        assert r.valid is False
+
+    def test_mutex_interleaved_valid(self):
+        assert (
+            check(
+                [
+                    (0, INVOKE, "acquire", None),
+                    (0, OK, "acquire", None),
+                    (0, INVOKE, "release", None),
+                    (1, INVOKE, "acquire", None),
+                    (0, OK, "release", None),
+                    (1, OK, "acquire", None),
+                ],
+                model=mutex(),
+            ).valid
+            is True
+        )
+
+    def test_unknown_on_config_limit(self):
+        rows = []
+        # Many concurrent crashed writes: frontier explodes; tiny limit.
+        for p in range(10):
+            rows.append((p, INVOKE, "write", p))
+            rows.append((p, INFO, "write", p))
+        rows.append((20, INVOKE, "read", 3))
+        rows.append((20, OK, "read", 3))
+        pm = cas_register(0).packed()
+        packed = pack_history(parse_literal(rows), pm.encode)
+        r = check_wgl_cpu(packed, pm, max_configs=5)
+        assert r.valid == "unknown"
+        assert r.reason == "config-limit"
+
+
+def gen_history(rng, n_procs=4, n_ops=8, corrupt=False):
+    """Simulates processes against a real sequential register with random
+    interleavings; yields (rows, surely_valid)."""
+    rows = []
+    reg = [0]
+    # Each process: a queue of planned ops.
+    plans = {
+        p: [
+            rng.choice(
+                [
+                    ("read", None),
+                    ("write", rng.randint(1, 3)),
+                    ("cas", [rng.randint(0, 3), rng.randint(1, 3)]),
+                ]
+            )
+            for _ in range(n_ops // n_procs + 1)
+        ]
+        for p in range(n_procs)
+    }
+    # state per process: None=idle, (f, v, applied?) = in-flight
+    inflight = {}
+    emitted = 0
+    while emitted < n_ops:
+        p = rng.randrange(n_procs)
+        if p not in inflight:
+            if not plans[p]:
+                continue
+            func, v = plans[p].pop()
+            rows.append((p, INVOKE, func, v))
+            inflight[p] = [func, v, False, None]
+            emitted += 1
+        else:
+            st = inflight[p]
+            if not st[2]:
+                # apply at linearization point
+                func, v = st[0], st[1]
+                if func == "read":
+                    st[3] = reg[0]
+                elif func == "write":
+                    reg[0] = v
+                    st[3] = v
+                else:
+                    old, new = v
+                    if reg[0] == old:
+                        reg[0] = new
+                        st[3] = "ok"
+                    else:
+                        st[3] = "fail"
+                st[2] = True
+            else:
+                func, v, _, res = st
+                if rng.random() < 0.15:
+                    rows.append((p, INFO, func, v))  # crash after apply
+                elif func == "read":
+                    rows.append((p, OK, func, res))
+                elif func == "write":
+                    rows.append((p, OK, func, v))
+                else:
+                    rows.append((p, OK if res == "ok" else FAIL, func, v))
+                del inflight[p]
+    for p, st in inflight.items():
+        rows.append((p, INFO, st[0], st[1]))
+    if corrupt:
+        # Flip a read result or write value to (maybe) break the history.
+        idxs = [i for i, r in enumerate(rows) if r[1] == OK and r[2] == "read"]
+        if idxs:
+            i = rng.choice(idxs)
+            p, t, f_, v = rows[i]
+            rows[i] = (p, t, f_, (v or 0) + rng.randint(1, 5))
+    return rows
+
+
+class TestRandomizedOracle:
+    def test_valid_histories_pass(self):
+        rng = random.Random(45100)  # the reference's fixed seed
+        for trial in range(60):
+            rows = gen_history(rng, n_procs=3, n_ops=8)
+            pm = cas_register(0).packed()
+            packed = pack_history(parse_literal(rows), pm.encode)
+            r = check_wgl_cpu(packed, pm)
+            assert r.valid is True, f"trial {trial}: {rows}"
+
+    def test_matches_oracle_on_corrupted(self):
+        rng = random.Random(45100)
+        disagreements = []
+        invalid_seen = 0
+        for trial in range(80):
+            rows = gen_history(rng, n_procs=3, n_ops=7, corrupt=True)
+            pm = cas_register(0).packed()
+            packed = pack_history(parse_literal(rows), pm.encode)
+            got = check_wgl_cpu(packed, pm).valid
+            want = brute_force_valid(packed, pm)
+            if got is not want:
+                disagreements.append((trial, rows, got, want))
+            if not want:
+                invalid_seen += 1
+        assert not disagreements, disagreements[:2]
+        assert invalid_seen > 5  # corruption actually produced invalid cases

@@ -1,0 +1,150 @@
+"""Device WGL search: verdict parity vs the exact CPU reference
+(SURVEY.md §4 "JAX-vs-CPU-reference equivalence tests").  Runs on the
+virtual CPU backend (conftest), same code path as TPU."""
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.wgl_cpu import check_wgl_cpu
+from jepsen_tpu.history import FAIL, INFO, INVOKE, OK, pack_history, parse_literal
+from jepsen_tpu.models import MultiRegister, cas_register, mutex
+from jepsen_tpu.ops.wgl import check_wgl_device
+
+from test_wgl_cpu import gen_history
+
+
+def both(rows, model=None, **kw):
+    model = model or cas_register(0)
+    pm = model.packed()
+    packed = pack_history(parse_literal(rows), pm.encode)
+    cpu = check_wgl_cpu(packed, pm)
+    dev = check_wgl_device(packed, pm, beam=256, block=64, **kw)
+    return cpu, dev
+
+
+class TestDeviceParityLiteral:
+    def test_empty(self):
+        cpu, dev = both([])
+        assert dev.valid is True
+
+    def test_valid_sequence(self):
+        cpu, dev = both(
+            [
+                (0, INVOKE, "write", 1),
+                (0, OK, "write", 1),
+                (1, INVOKE, "cas", [1, 2]),
+                (1, OK, "cas", [1, 2]),
+                (2, INVOKE, "read", 2),
+                (2, OK, "read", 2),
+            ]
+        )
+        assert cpu.valid is True and dev.valid is True
+
+    def test_invalid_read(self):
+        cpu, dev = both(
+            [
+                (0, INVOKE, "write", 1),
+                (0, OK, "write", 1),
+                (1, INVOKE, "read", 0),
+                (1, OK, "read", 0),
+            ]
+        )
+        assert cpu.valid is False and dev.valid is False
+
+    def test_info_write_explains_read(self):
+        cpu, dev = both(
+            [
+                (0, INVOKE, "write", 7),
+                (0, INFO, "write", 7),
+                (1, INVOKE, "read", 7),
+                (1, OK, "read", 7),
+            ]
+        )
+        assert cpu.valid is True and dev.valid is True
+
+    def test_mutex(self):
+        cpu, dev = both(
+            [
+                (0, INVOKE, "acquire", None),
+                (0, OK, "acquire", None),
+                (1, INVOKE, "acquire", None),
+                (1, OK, "acquire", None),
+            ],
+            model=mutex(),
+        )
+        assert cpu.valid is False and dev.valid is False
+
+    def test_multi_register(self):
+        cpu, dev = both(
+            [
+                (0, INVOKE, "write", ["x", 1]),
+                (0, OK, "write", ["x", 1]),
+                (1, INVOKE, "read", ["y", 1]),
+                (1, OK, "read", ["y", 1]),
+            ],
+            model=MultiRegister({"x": 0, "y": 0}),
+        )
+        assert cpu.valid is False and dev.valid is False
+
+
+class TestDeviceParityRandom:
+    def test_valid_histories(self):
+        rng = random.Random(45100)
+        pm = cas_register(0).packed()
+        for trial in range(15):
+            rows = gen_history(rng, n_procs=4, n_ops=20)
+            packed = pack_history(parse_literal(rows), pm.encode)
+            dev = check_wgl_device(packed, pm, beam=256, block=32)
+            assert dev.valid is True, f"trial {trial}"
+
+    def test_corrupted_match_cpu(self):
+        rng = random.Random(45100)
+        pm = cas_register(0).packed()
+        mismatches = []
+        invalids = 0
+        for trial in range(30):
+            rows = gen_history(rng, n_procs=3, n_ops=12, corrupt=True)
+            packed = pack_history(parse_literal(rows), pm.encode)
+            cpu = check_wgl_cpu(packed, pm)
+            dev = check_wgl_device(packed, pm, beam=256, block=32)
+            if cpu.valid is not dev.valid:
+                mismatches.append((trial, cpu.valid, dev.valid))
+            if cpu.valid is False:
+                invalids += 1
+        assert not mismatches, mismatches
+        assert invalids > 3
+
+    def test_longer_history_multiple_blocks(self):
+        # Forces several re-window boundaries (block=16 over ~60 ops).
+        rng = random.Random(12345)
+        pm = cas_register(0).packed()
+        for trial in range(5):
+            rows = gen_history(rng, n_procs=5, n_ops=60)
+            packed = pack_history(parse_literal(rows), pm.encode)
+            cpu = check_wgl_cpu(packed, pm)
+            dev = check_wgl_device(packed, pm, beam=256, block=16)
+            assert dev.valid is cpu.valid, f"trial {trial}"
+
+    def test_beam_growth_on_info_burst(self):
+        # Many concurrent crashed writes force frontier growth; the beam
+        # retry machinery must keep the search exact (tiny starting beam).
+        rows = []
+        for p in range(8):
+            rows.append((p, INVOKE, "write", p + 1))
+            rows.append((p, INFO, "write", p + 1))
+        rows.append((30, INVOKE, "read", 5))
+        rows.append((30, OK, "read", 5))
+        pm = cas_register(0).packed()
+        packed = pack_history(parse_literal(rows), pm.encode)
+        cpu = check_wgl_cpu(packed, pm)
+        dev = check_wgl_device(packed, pm, beam=256, block=8)
+        assert cpu.valid is True and dev.valid is True
+
+        # And an invalid variant: read a value nobody wrote.
+        rows[-2] = (30, INVOKE, "read", 77)
+        rows[-1] = (30, OK, "read", 77)
+        packed = pack_history(parse_literal(rows), pm.encode)
+        cpu = check_wgl_cpu(packed, pm)
+        dev = check_wgl_device(packed, pm, beam=256, block=8)
+        assert cpu.valid is False and dev.valid is False
