@@ -1,0 +1,87 @@
+#!/usr/bin/env python
+"""Headline benchmark: TPU-offloaded linearizability checking throughput.
+
+Generates the BASELINE.json north-star workload — a 100k-op concurrent
+cas-register history with a high indeterminate-op ratio — and measures
+how fast the device WGL search (ops/wgl.py) decides it.  The reference's
+checker (knossos's CPU WGL, checker.clj:214-233) is the baseline: the
+driver-defined target is a verdict in <60 s on this history
+(BASELINE.md), i.e. ~1,667 ops checked/sec; knossos itself times out.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N}
+vs_baseline > 1.0 means faster than the 60-s north-star floor.
+
+Flags (env):
+  JEPSEN_BENCH_OPS     history length        (default 100000)
+  JEPSEN_BENCH_INFO    indeterminate-op rate (default 0.05)
+  JEPSEN_BENCH_PROCS   worker concurrency    (default 16)
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    n_ops = int(os.environ.get("JEPSEN_BENCH_OPS", "100000"))
+    info_rate = float(os.environ.get("JEPSEN_BENCH_INFO", "0.05"))
+    procs = int(os.environ.get("JEPSEN_BENCH_PROCS", "16"))
+
+    from jepsen_tpu.checker.linearizable import Linearizable
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops.wgl import check_wgl_device
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    model = cas_register()
+    pm = model.packed()
+    h = random_register_history(
+        n_ops, procs=procs, info_rate=info_rate, seed=45100
+    )
+    packed = pack_history(h, pm.encode)
+
+    # Warm-up on a short prefix so JIT compilation of the block kernels is
+    # excluded from the measured run (first TPU compile is tens of seconds;
+    # the cache is keyed on static shapes, which the prefix shares).
+    warm = random_register_history(
+        2048, procs=procs, info_rate=info_rate, seed=7
+    )
+    check_wgl_device(pack_history(warm, pm.encode), pm)
+
+    t0 = time.monotonic()
+    res = check_wgl_device(packed, pm)
+    elapsed = time.monotonic() - t0
+
+    if res.valid is not True:
+        print(
+            json.dumps(
+                {
+                    "metric": "wgl_linearizability_throughput",
+                    "value": 0.0,
+                    "unit": "ops/s",
+                    "vs_baseline": 0.0,
+                    "error": f"expected valid verdict, got {res.valid} ({res.reason})",
+                }
+            )
+        )
+        return 1
+
+    ops_per_s = packed.n / elapsed
+    baseline_floor = 100_000 / 60.0  # north-star: 100k ops decided in 60 s
+    print(
+        json.dumps(
+            {
+                "metric": "wgl_linearizability_throughput",
+                "value": round(ops_per_s, 1),
+                "unit": "ops/s",
+                "vs_baseline": round(ops_per_s / baseline_floor, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

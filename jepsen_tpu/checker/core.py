@@ -190,7 +190,9 @@ class UnhandledExceptions(Checker):
             err = o.ext.get("exception") or o.ext.get("error")
             if err is None:
                 continue
-            cls = o.ext.get("exception_class") or type(err).__name__ if not isinstance(err, str) else "error"
+            cls = o.ext.get("exception_class") or (
+                type(err).__name__ if not isinstance(err, str) else "error"
+            )
             by_class[cls].append(o.to_dict())
         return {
             "valid": True,
@@ -291,26 +293,33 @@ class TotalQueue(Checker):
                     enqueues[v] += 1
             elif o.f == "dequeue" and o.is_ok:
                 dequeues[v] += 1
+        # ok: dequeues we attempted; unexpected: dequeues never attempted
+        # at all; duplicated: attempted values dequeued more times than
+        # attempted; lost: acknowledged enqueues never dequeued; recovered:
+        # indeterminate enqueues that came out (checker.clj:671-695).
+        ok = dequeues & attempts
+        unexpected = MultiSet(
+            {k: c for k, c in dequeues.items() if k not in attempts}
+        )
+        duplicated = (dequeues - attempts) - unexpected
         lost = enqueues - dequeues
-        unexpected = dequeues - attempts
-        duplicated = MultiSet(
-            {k: c for k, c in (dequeues - attempts).items() if attempts[k]}
-        )
-        recovered = MultiSet(
-            {
-                k: c
-                for k, c in dequeues.items()
-                if attempts[k] and not enqueues[k]
-            }
-        )
+        recovered = ok - enqueues
+        total = sum(attempts.values())
         return {
             "valid": not lost and not unexpected,
+            "attempt-count": total,
+            "acknowledged-count": sum(enqueues.values()),
+            "ok-count": sum(ok.values()),
             "lost": set(lost),
+            "lost-count": sum(lost.values()),
             "unexpected": set(unexpected),
+            "unexpected-count": sum(unexpected.values()),
             "duplicated": set(duplicated),
+            "duplicated-count": sum(duplicated.values()),
             "recovered": set(recovered),
-            "ok-frac": fraction(len(dequeues), len(attempts)),
-            "lost-frac": fraction(len(lost), len(attempts)),
+            "recovered-count": sum(recovered.values()),
+            "ok-frac": fraction(sum(ok.values()), total),
+            "lost-frac": fraction(sum(lost.values()), total),
         }
 
 

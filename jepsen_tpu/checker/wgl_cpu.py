@@ -70,10 +70,6 @@ def check_wgl_cpu(
     n = packed.n
     if n == 0:
         return WGLResult(valid=True, configs_explored=1, elapsed_s=0.0)
-    if n > 62:
-        # Python ints handle arbitrary widths; 62 is just where we stop
-        # pretending a dense bitmask int is cheap. Still correct.
-        pass
 
     inv = packed.inv.tolist()
     ret = packed.ret.tolist()
@@ -133,18 +129,15 @@ def check_wgl_cpu(
         elif cnt == deepest_count and len(deepest) < report_configs:
             deepest.append((S, state))
 
-        # min1/min2 of ret over non-members.
+        # The argmin-ret non-member bounds the candidate rule; min2 is
+        # unneeded because m1 itself is always order-legal.
         m1 = -1
         m1_ret = None
-        m2_ret = None
         for i in ret_order:
             if not (S >> i) & 1:
-                if m1 < 0:
-                    m1 = i
-                    m1_ret = ret[i]
-                else:
-                    m2_ret = ret[i]
-                    break
+                m1 = i
+                m1_ret = ret[i]
+                break
         if m1 < 0:
             continue  # everything linearized (ok_mask covered earlier)
 

@@ -64,8 +64,23 @@ class Model:
     # -- packed / device compilation --------------------------------------
 
     def packed(self) -> "PackedModel":
-        """Compiles this model to its packed int32 form.  Raises
-        NotImplementedError for host-only models (e.g. unbounded sets)."""
+        """The packed int32 form of this model, memoized per instance —
+        device kernel caches key on the identity of the PackedModel's
+        jax_step, so repeated checks with one model must reuse one
+        compilation.  Raises NotImplementedError for host-only models
+        (e.g. unbounded sets)."""
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = self._compile_packed()
+            try:
+                object.__setattr__(self, "_packed_cache", cached)
+            except AttributeError:
+                pass  # __slots__ without cache slot: recompile each call
+        return cached
+
+    def _compile_packed(self) -> "PackedModel":
+        """Builds the packed form.  Subclasses override this, not
+        packed()."""
         raise NotImplementedError(
             f"{type(self).__name__} has no packed/device form"
         )

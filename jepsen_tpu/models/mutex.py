@@ -13,7 +13,7 @@ F_ACQUIRE, F_RELEASE = 0, 1
 
 
 class Mutex(Model):
-    __slots__ = ("locked",)
+    __slots__ = ("locked", "_packed_cache")
 
     def __init__(self, locked: bool = False):
         self.locked = locked
@@ -38,7 +38,7 @@ class Mutex(Model):
     def __repr__(self):
         return f"Mutex(locked={self.locked})"
 
-    def packed(self) -> PackedModel:
+    def _compile_packed(self) -> PackedModel:
         interner = Interner()
         interner.intern(None)
         init = (1 if self.locked else 0,)

@@ -22,7 +22,7 @@ _F_NAMES = {F_READ: "read", F_WRITE: "write", F_CAS: "cas"}
 class Register(Model):
     """A single read/write register."""
 
-    __slots__ = ("value",)
+    __slots__ = ("value", "_packed_cache")
     fs = ("read", "write")
 
     def __init__(self, value: Any = None):
@@ -50,7 +50,7 @@ class Register(Model):
 
     # -- packed -----------------------------------------------------------
 
-    def packed(self) -> PackedModel:
+    def _compile_packed(self) -> PackedModel:
         return _register_packed(self, allow_cas=False)
 
 
@@ -70,7 +70,7 @@ class CASRegister(Register):
             )
         return super().step(op)
 
-    def packed(self) -> PackedModel:
+    def _compile_packed(self) -> PackedModel:
         return _register_packed(self, allow_cas=True)
 
 
@@ -142,7 +142,7 @@ class MultiRegister(Model):
     per-key-WGL benchmark config in BASELINE.json uses
     jepsen.independent to shard keys instead of packing them here)."""
 
-    __slots__ = ("values",)
+    __slots__ = ("values", "_packed_cache")
 
     def __init__(self, values: dict[Any, Any]):
         self.values = dict(values)
@@ -172,7 +172,7 @@ class MultiRegister(Model):
     def __repr__(self):
         return f"MultiRegister({self.values!r})"
 
-    def packed(self) -> PackedModel:
+    def _compile_packed(self) -> PackedModel:
         interner = Interner()
         interner.intern(None)
         keys = list(self.values.keys())

@@ -1,0 +1,92 @@
+"""Synthetic concurrent histories for benchmarks and tests.
+
+The reference benchmarks its stack on generated workloads
+(/root/reference/jepsen/test/jepsen/core_test.clj:127-132 runs 1e6
+list-append ops; interpreter_test.clj:43-88 asserts >10k ops/s) — this
+module provides the checker-side analog: concurrent register histories
+that are linearizable *by construction* (every op takes effect at one
+instant between its invocation and completion), with controllable
+concurrency and indeterminate-op rate, plus optional injected
+violations.  These drive bench.py and the BASELINE.json 100k-op config.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from ..history.core import History, Op, history
+
+
+def random_register_history(
+    n_ops: int,
+    *,
+    procs: int = 16,
+    info_rate: float = 0.02,
+    cas: bool = True,
+    n_values: int = 5,
+    seed: int = 45100,
+    bad: bool = False,
+) -> History:
+    """A concurrent cas-register history of ~n_ops operations.
+
+    Each op's effect is applied atomically at completion time, so the
+    history is linearizable unless `bad` injects a read of a
+    never-written value.  `info_rate` of ops complete as :info
+    (indeterminate) — these stay concurrent with everything after them,
+    the width driver for WGL search (SURVEY.md §7 "hard parts").  The
+    default seed matches the reference's fixed generator-test seed
+    (generator/test.clj:48-52)."""
+    rng = random.Random(seed)
+    value: Optional[int] = None
+    ops: list[Op] = []
+    # process -> (f, payload, effect_applies) for in-flight ops
+    pending: dict[int, tuple] = {}
+    started = 0
+
+    def complete(p: int) -> None:
+        nonlocal value
+        f, payload, as_info = pending.pop(p)
+        if as_info:
+            # Indeterminate: maybe the effect happened.
+            if f == "write" and rng.random() < 0.5:
+                value = payload
+            elif f == "cas" and rng.random() < 0.5 and value == payload[0]:
+                value = payload[1]
+            ops.append(Op(type="info", f=f, value=payload, process=p))
+            return
+        if f == "read":
+            ops.append(Op(type="ok", f="read", value=value, process=p))
+        elif f == "write":
+            value = payload
+            ops.append(Op(type="ok", f="write", value=payload, process=p))
+        else:  # cas
+            if value == payload[0]:
+                value = payload[1]
+                ops.append(Op(type="ok", f="cas", value=payload, process=p))
+            else:
+                ops.append(Op(type="fail", f="cas", value=payload, process=p))
+
+    while started < n_ops or pending:
+        p = rng.randrange(procs)
+        if p in pending:
+            complete(p)
+        elif started < n_ops:
+            fs = ["read", "write", "cas"] if cas else ["read", "write"]
+            f = rng.choice(fs)
+            if f == "read":
+                payload = None
+            elif f == "write":
+                payload = rng.randrange(n_values)
+            else:
+                payload = (rng.randrange(n_values), rng.randrange(n_values))
+            as_info = f != "read" and rng.random() < info_rate
+            pending[p] = (f, payload, as_info)
+            ops.append(Op(type="invoke", f=f, value=payload, process=p))
+            started += 1
+        # else: only pending ops remain; loop drains them.
+
+    if bad:
+        ops.append(Op(type="invoke", f="read", value=None, process=0))
+        ops.append(Op(type="ok", f="read", value=n_values + 94, process=0))
+    return history(ops)

@@ -1,15 +1,24 @@
 """Test configuration.
 
-Forces JAX onto a virtual 8-device CPU platform *before* jax is imported
-anywhere, so multi-chip sharding (mesh over keys × beam) is exercised
-without TPU hardware — the same trick the driver's dryrun uses."""
+Forces JAX onto a virtual 8-device CPU platform *before* any test touches
+a device, so multi-chip sharding (mesh over per-key searches) is
+exercised without TPU hardware — the same trick the driver's
+dryrun_multichip uses.  Site configuration may pin JAX_PLATFORMS to the
+real accelerator, so we override through jax.config rather than env
+vars.  Set JEPSEN_TPU_TEST_PLATFORM=tpu to run the suite on real
+hardware instead (single chip; mesh tests skip themselves).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+if os.environ.get("JEPSEN_TPU_TEST_PLATFORM", "cpu") != "tpu":
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

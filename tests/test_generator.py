@@ -1,0 +1,433 @@
+"""Generator combinator tests, via the deterministic simulation kit.
+
+Ports the structure of /root/reference/jepsen/test/jepsen/generator_test.clj
+(SURVEY.md §4.2): every combinator is exercised through simulate/quick/
+perfect with a fixed seed.  Where the reference asserts exact schedules
+that depend on its RNG tie-breaking, we assert the schedule's semantic
+invariants (counts, times, process sets, per-thread orderings) instead —
+the tie-break sequence is implementation-specific.
+"""
+
+import pytest
+
+from jepsen_tpu import generator as gen
+from jepsen_tpu.generator import testkit as gt
+from jepsen_tpu.generator.independent import (
+    concurrent_generator,
+    sequential_generator,
+)
+from jepsen_tpu.parallel import KV
+
+
+def fvals(ops, *keys):
+    out = []
+    for o in ops:
+        row = []
+        for k in keys:
+            row.append(getattr(o, k))
+        out.append(tuple(row) if len(row) > 1 else row[0])
+    return out
+
+
+class TestDefaults:
+    def test_nil(self):
+        assert gt.perfect(None) == []
+
+    def test_map_once(self):
+        ops = gt.perfect({"f": "write"})
+        assert len(ops) == 1
+        assert (ops[0].f, ops[0].type, ops[0].time, ops[0].process) == (
+            "write",
+            "invoke",
+            0,
+            0,
+        )
+
+    def test_map_concurrent(self):
+        # 6 ops over 3 threads: 3 invoke at t=0, 3 at t=10; every thread used.
+        ops = gt.perfect([{"f": "write"}] * 6)
+        assert len(ops) == 6
+        assert [o.time for o in ops] == [0, 0, 0, 10, 10, 10]
+        assert {o.process for o in ops[:3]} == {0, 1, "nemesis"}
+
+    def test_map_pending_when_busy(self):
+        ctx = gt.default_context()
+        for t in ctx.all_threads():
+            ctx = ctx.busy_thread(0, t)
+        r = gen.gen_op({"f": "write"}, {}, ctx)
+        assert r[0] is gen.PENDING
+
+    def test_seq_nested(self):
+        ops = gt.quick(
+            [
+                [{"value": 1}, {"value": 2}],
+                [[{"value": 3}], {"value": 4}],
+                {"value": 5},
+            ]
+        )
+        assert fvals(ops, "value") == [1, 2, 3, 4, 5]
+
+    def test_fn_returning_map(self):
+        import random
+
+        ops = gt.perfect(gen.limit(5, lambda: {"f": "write", "value": random.randint(0, 10)}))
+        assert len(ops) == 5
+        assert all(0 <= o.value <= 10 for o in ops)
+        assert {o.process for o in ops} == {0, 1, "nemesis"}
+
+    def test_fn_arity2_receives_ctx(self):
+        seen = []
+
+        def f(test, ctx):
+            seen.append(ctx.time)
+            return {"f": "x"}
+
+        ops = gt.perfect(gen.limit(2, f))
+        assert len(ops) == 2
+        assert seen[0] == 0
+
+
+class TestBounding:
+    def test_limit(self):
+        ops = gt.quick(gen.limit(2, gen.repeat({"f": "write", "value": 1})))
+        assert fvals(ops, "value") == [1, 1]
+
+    def test_repeat_holds_state(self):
+        # repeat does not advance the underlying generator.
+        source = [{"value": v} for v in range(10)]
+        ops = gt.perfect(gen.repeat(source, 3))
+        assert fvals(ops, "value") == [0, 0, 0]
+
+    def test_once(self):
+        assert len(gt.quick(gen.once(gen.repeat({"f": "r"})))) == 1
+
+    def test_cycle(self):
+        ops = gt.quick(gen.cycle(gen.limit(2, gen.repeat({"f": "a"})), 3))
+        assert len(ops) == 6
+
+    def test_process_limit(self):
+        ops = gt.perfect_info(
+            gen.clients(
+                gen.process_limit(5, [{"value": x} for x in range(100)])
+            )
+        )
+        # Every completion crashes, so processes churn; only 5 distinct
+        # processes may ever appear (generator.clj:1272-1296).
+        assert len({o.process for o in ops}) <= 5
+        assert len(ops) == 5
+
+    def test_time_limit(self):
+        ops = gt.perfect(
+            [
+                gen.time_limit(20e-9, gen.repeat({"value": "a"})),
+                gen.time_limit(10e-9, gen.repeat({"value": "b"})),
+            ]
+        )
+        assert fvals(ops, "time", "value") == [
+            (0, "a"), (0, "a"), (0, "a"),
+            (10, "a"), (10, "a"), (10, "a"),
+            (20, "b"), (20, "b"), (20, "b"),
+        ]
+
+
+class TestWrappers:
+    def test_f_map(self):
+        ops = gt.perfect(gen.f_map({"a": "b"}, {"f": "a", "value": 2}))
+        assert fvals(ops, "f", "value") == [("b", 2)]
+
+    def test_filter(self):
+        ops = gt.perfect(
+            gen.op_filter(
+                lambda op: op.value % 2 == 0,
+                gen.limit(10, [{"value": x} for x in range(10)]),
+            )
+        )
+        assert fvals(ops, "value") == [0, 2, 4, 6, 8]
+
+    def test_log_ops_excluded_from_fs(self):
+        ops = gt.perfect_ops(
+            gen.phases(gen.log("first"), {"f": "a"}, gen.log("second"), {"f": "b"})
+        )
+        assert [o.f for o in ops if o.type == "invoke"] == ["a", "b"]
+        assert [o.value for o in ops if o.type == "log"] == ["first", "second"]
+
+    def test_validate_rejects_bad_type(self):
+        class Bad(gen.Generator):
+            def op(self, test, ctx):
+                from jepsen_tpu.history.core import Op
+
+                return (Op(type="bogus", process=0, time=0), None)
+
+        with pytest.raises(gen.InvalidOp):
+            gt.quick(Bad())
+
+    def test_on_update_promise(self):
+        p = gen.promise()
+        seen = []
+
+        def watch(this, test, ctx, event):
+            if event.type == "ok" and event.f == "write":
+                p.deliver({"f": "confirm", "value": event.value})
+            return this
+
+        ops = gt.quick(
+            gen.on_threads(
+                {0, 1},
+                gen.limit(
+                    5,
+                    gen.on_update(
+                        watch,
+                        gen.any_gen(
+                            p,
+                            [
+                                {"f": "read"},
+                                {"f": "write", "value": "x"},
+                                gen.repeat({"f": "hold"}),
+                            ],
+                        ),
+                    ),
+                ),
+            )
+        )
+        fs = [o.f for o in ops]
+        assert "confirm" in fs
+        assert fs.index("confirm") > fs.index("write")
+
+
+class TestRouting:
+    def test_clients(self):
+        ops = gt.perfect(gen.clients(gen.limit(5, gen.repeat({}))))
+        assert {o.process for o in ops} == {0, 1}
+
+    def test_nemesis_route(self):
+        ops = gt.perfect(gen.nemesis(gen.limit(3, gen.repeat({"f": "kill"}))))
+        assert {o.process for o in ops} == {"nemesis"}
+
+    def test_two_arity_clients(self):
+        ops = gt.perfect(
+            gen.limit(
+                8,
+                gen.clients(
+                    gen.repeat({"f": "read"}), gen.repeat({"f": "kill"})
+                ),
+            )
+        )
+        by_f = {o.f: set() for o in ops}
+        for o in ops:
+            by_f[o.f].add(o.process)
+        assert by_f["kill"] == {"nemesis"}
+        assert by_f["read"] <= {0, 1}
+
+    def test_each_thread(self):
+        ops = gt.perfect(gen.each_thread([{"f": "a"}, {"f": "b"}]))
+        assert len(ops) == 6
+        # Each thread does a then b.
+        per_thread = {}
+        for o in ops:
+            per_thread.setdefault(o.process, []).append(o.f)
+        assert per_thread == {
+            0: ["a", "b"],
+            1: ["a", "b"],
+            "nemesis": ["a", "b"],
+        }
+
+    def test_each_thread_exhausted(self):
+        r = gen.gen_op(
+            gen.each_thread(gen.limit(0, {"f": "read"})), {}, gt.default_context()
+        )
+        assert r is None
+
+    def test_reserve(self):
+        def integers(f):
+            return [{"f": f, "value": x} for x in range(100)]
+
+        ops = gt.perfect(
+            gen.limit(15, gen.reserve(2, integers("a"), 3, integers("b"), integers("c"))),
+            ctx=gt.n_plus_nemesis_context(5),
+        )
+        by_f = {}
+        for o in ops:
+            by_f.setdefault(o.f, set()).add(o.process)
+        assert by_f["a"] <= {0, 1}
+        assert by_f["b"] <= {2, 3, 4}
+        assert by_f["c"] == {"nemesis"}
+
+    def test_any_interleaves(self):
+        ops = gt.perfect(
+            gen.limit(
+                4,
+                gen.any_gen(
+                    gen.on_threads({0}, gen.delay(20e-9, gen.repeat({"f": "a"}))),
+                    gen.on_threads({1}, gen.delay(20e-9, gen.repeat({"f": "b"}))),
+                ),
+            )
+        )
+        assert sorted(fvals(ops, "f")) == ["a", "a", "b", "b"]
+        assert [o.time for o in ops] == [0, 0, 20, 20]
+
+
+class TestTiming:
+    def test_delay(self):
+        ops = gt.perfect(gen.limit(5, gen.delay(3e-9, gen.repeat({"f": "w"}))))
+        assert [o.time for o in ops] == [0, 3, 6, 10, 13]
+
+    def test_stagger_rate(self):
+        n = 1000
+        dt = 20e-9
+        ops = gt.perfect(
+            gen.stagger(dt, gen.limit(n, [{"f": "w", "value": x} for x in range(n)]))
+        )
+        max_time = ops[-1].time
+        rate = n / max_time
+        assert 0.9 <= rate / (1 / 20) <= 1.1
+
+    def test_mix(self):
+        ops = gt.perfect(
+            gen.mix([gen.repeat({"f": "a"}, 5), gen.repeat({"f": "b"}, 10)])
+        )
+        from collections import Counter
+
+        c = Counter(o.f for o in ops)
+        assert c == {"a": 5, "b": 10}
+        # Actually mixed, not five as then ten bs.
+        assert fvals(ops, "f") != ["a"] * 5 + ["b"] * 10
+
+    def test_flip_flop(self):
+        ops = gt.perfect(
+            gen.clients(
+                gen.limit(
+                    5,
+                    gen.flip_flop(
+                        [{"f": "write", "value": x} for x in range(10)],
+                        [{"f": "read"}, {"f": "finalize"}],
+                    ),
+                )
+            )
+        )
+        assert fvals(ops, "f") == ["write", "read", "write", "finalize", "write"]
+
+    def test_cycle_times(self):
+        ops = gt.perfect(
+            gen.clients(
+                gen.limit(
+                    6,
+                    gen.cycle_times(
+                        20e-9, gen.repeat({"f": "a"}),
+                        20e-9, gen.repeat({"f": "b"}),
+                    ),
+                )
+            )
+        )
+        for o in ops:
+            window = (o.time // 20) % 2
+            assert o.f == ("a" if window == 0 else "b"), (o.time, o.f)
+
+
+class TestPhasing:
+    def test_phases(self):
+        ops = gt.perfect(
+            gen.clients(
+                gen.phases(
+                    [{"f": "a"}] * 2, [{"f": "b"}] * 1, [{"f": "c"}] * 3
+                )
+            )
+        )
+        assert fvals(ops, "f", "time") == [
+            ("a", 0), ("a", 0), ("b", 10), ("c", 20), ("c", 20), ("c", 30)
+        ]
+
+    def test_synchronize_waits_for_all(self):
+        ops = gt.perfect_ops(
+            gen.clients([
+                gen.limit(2, gen.repeat({"f": "a"})),
+                gen.synchronize(gen.limit(2, gen.repeat({"f": "b"}))),
+            ])
+        )
+        invs = [o for o in ops if o.type == "invoke"]
+        a_done = max(o.time for o in ops if o.f == "a" and o.type == "ok")
+        b_start = min(o.time for o in invs if o.f == "b")
+        assert b_start >= a_done
+
+    def test_until_ok(self):
+        ops = gt.imperfect(
+            gen.clients(gen.limit(10, gen.until_ok(gen.repeat({"f": "read"}))))
+        )
+        oks = [o for o in ops if o.type == "ok"]
+        assert oks  # at least one op succeeded
+        # After the first ok completes, no later invocations occur.
+        first_ok = min(o.time for o in oks)
+        assert all(
+            o.time <= first_ok for o in ops if o.type == "invoke"
+        )
+
+    def test_then(self):
+        ops = gt.perfect(
+            gen.clients(gen.then(gen.once({"f": "read"}), gen.limit(3, gen.repeat({"f": "write"}))))
+        )
+        assert fvals(ops, "f") == ["write", "write", "write", "read"]
+
+
+class TestIndependentGenerators:
+    def test_sequential(self):
+        ops = gt.perfect(
+            gen.clients(
+                sequential_generator(
+                    ["x", "y"],
+                    lambda k: gen.limit(3, [{"value": v} for v in range(3)]),
+                )
+            )
+        )
+        assert [o.value for o in ops] == [
+            KV("x", 0), KV("x", 1), KV("x", 2),
+            KV("y", 0), KV("y", 1), KV("y", 2),
+        ]
+
+    def test_concurrent(self):
+        ops = gt.perfect(
+            concurrent_generator(
+                2,
+                ["k0", "k1", "k2", "k3", "k4"],
+                lambda k: [{"value": v} for v in ("v0", "v1", "v2")],
+            ),
+            ctx=gt.n_plus_nemesis_context(6),
+        )
+        assert len(ops) == 15
+        # Every key's values appear in order.
+        per_key = {}
+        for o in ops:
+            assert isinstance(o.value, KV)
+            per_key.setdefault(o.value.key, []).append(o.value.value)
+        assert per_key == {
+            f"k{i}": ["v0", "v1", "v2"] for i in range(5)
+        }
+        # Keys are processed by fixed 2-thread groups: each key's ops use
+        # at most 2 distinct threads, all from the same group.
+        groups = {0: 0, 1: 0, 2: 1, 3: 1, 4: 2, 5: 2}
+        for k, _ in per_key.items():
+            procs = {o.process for o in ops if o.value.key == k}
+            assert len({groups[p] for p in procs}) == 1, (k, procs)
+        # The first three keys run concurrently at t=0.
+        t0_keys = {o.value.key for o in ops if o.time == 0}
+        assert len(t0_keys) == 3
+
+    def test_concurrent_deadlock_case(self):
+        # each_thread inside concurrent groups (independent-deadlock-case).
+        ops = gt.perfect(
+            gen.limit(
+                5,
+                concurrent_generator(
+                    2,
+                    list(range(100)),
+                    lambda k: gen.each_thread({"f": "meow"}),
+                ),
+            )
+        )
+        assert len(ops) == 5
+        assert all(o.f == "meow" for o in ops)
+
+    def test_concurrent_rejects_bad_group_size(self):
+        with pytest.raises(ValueError):
+            gt.perfect(
+                concurrent_generator(4, ["a"], lambda k: [{"f": "x"}]),
+                ctx=gt.default_context(),  # only 2 client threads
+            )
