@@ -61,6 +61,12 @@ def set_rng_seed(seed: Optional[int]) -> None:
     _rng = random.Random(seed)
 
 
+def get_rng() -> random.Random:
+    """The module RNG; nemesis partition choices draw from it too, so a
+    single set_rng_seed reproduces the whole run."""
+    return _rng
+
+
 class Generator:
     """Base class for explicit generators.  Subclasses are immutable:
     op/update return fresh instances."""

@@ -38,6 +38,9 @@ NEMESIS = "nemesis"
 #: Packed process code for the nemesis.
 NEMESIS_CODE = -1
 
+#: Sentinel for Op.complete: keep the invocation's value.
+_KEEP = object()
+
 
 @dataclass(slots=True)
 class Op:
@@ -86,6 +89,21 @@ class Op:
 
     def replace(self, **kw: Any) -> "Op":
         return dataclasses.replace(self, **kw)
+
+    def complete(self, type: str, value: Any = _KEEP, **ext: Any) -> "Op":
+        """The completion of this invocation: same process/f, new type,
+        optionally a new value and extra keys (e.g. error=...); time and
+        index are left for the interpreter to fill."""
+        new_ext = dict(self.ext)
+        new_ext.update(ext)
+        return dataclasses.replace(
+            self,
+            type=type,
+            value=self.value if value is _KEEP else value,
+            time=-1,
+            index=-1,
+            ext=new_ext,
+        )
 
     def with_ext(self, **kw: Any) -> "Op":
         ext = dict(self.ext)

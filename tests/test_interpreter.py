@@ -1,0 +1,335 @@
+"""Interpreter tests: whole-stack in-process runs against in-memory
+clients, mirroring the reference's dummy-remote + atom-client strategy
+(SURVEY.md §4; core_test.clj:68-132, interpreter_test.clj)."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jc
+from jepsen_tpu import generator as gen
+from jepsen_tpu import interpreter
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.history import FAIL, INFO, INVOKE, NEMESIS, OK, Op
+
+
+class AtomRegister(jc.Client):
+    """In-memory linearizable register (tests.clj:26-66 atom-client)."""
+
+    def __init__(self, state=None, lock=None):
+        self.state = state if state is not None else {"v": None}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return AtomRegister(self.state, self.lock)
+
+    def invoke(self, test, op):
+        with self.lock:
+            if op.f in ("w", "write"):
+                self.state["v"] = op.value
+                return op.complete(OK)
+            if op.f in ("r", "read"):
+                return op.complete(OK, value=self.state["v"])
+            if op.f == "cas":
+                old, new = op.value
+                if self.state["v"] == old:
+                    self.state["v"] = new
+                    return op.complete(OK)
+                return op.complete(FAIL)
+            raise ValueError(f"unknown f {op.f}")
+
+
+class CrashyClient(jc.Client):
+    """Raises on every nth invocation."""
+
+    def __init__(self, every=3, counter=None):
+        self.every = every
+        self.counter = counter if counter is not None else [0]
+
+    def open(self, test, node):
+        return CrashyClient(self.every, self.counter)
+
+    def invoke(self, test, op):
+        self.counter[0] += 1
+        if self.counter[0] % self.every == 0:
+            raise RuntimeError("boom")
+        return op.complete(OK, value=1)
+
+
+def run_test(
+    generator,
+    client=None,
+    nemesis=None,
+    concurrency=4,
+    nodes=None,
+    wrap_clients=True,
+):
+    # Bare generators may schedule onto the free nemesis thread, exactly
+    # like the reference; client-only workloads route through
+    # gen/clients (generator.clj:1125-1136).
+    if wrap_clients and generator is not None:
+        generator = gen.clients(generator)
+    test = {
+        "concurrency": concurrency,
+        "nodes": nodes or ["n1", "n2", "n3"],
+        "client": client or AtomRegister(),
+        "nemesis": nemesis or nem.noop,
+        "generator": generator,
+    }
+    return interpreter.run(test)
+
+
+def test_empty_generator():
+    h = run_test(None)
+    assert len(h) == 0
+
+
+def test_single_op():
+    h = run_test(gen.limit(1, {"f": "w", "value": 5}))
+    assert len(h) == 2
+    inv, comp = h[0], h[1]
+    assert inv.type == INVOKE and inv.f == "w" and inv.value == 5
+    assert comp.type == OK
+    assert comp.process == inv.process
+    assert h.completion(inv) == comp
+
+
+def test_history_well_formed():
+    n = 100
+    h = run_test(gen.limit(n, gen.repeat({"f": "w", "value": 1})), concurrency=8)
+    assert len(h) == 2 * n
+    # Dense indices in emission order.
+    assert [o.index for o in h] == list(range(2 * n))
+    # Times monotonic.
+    times = [o.time for o in h]
+    assert times == sorted(times)
+    # Every invocation has a completion on the same process.
+    for o in h:
+        if o.is_invoke:
+            c = h.completion(o)
+            assert c is not None and c.process == o.process
+
+
+def test_read_write_semantics():
+    """Sequential writes then a read observe the last value."""
+    g = [
+        gen.once({"f": "w", "value": 1}),
+        gen.once({"f": "w", "value": 2}),
+        gen.once({"f": "r"}),
+    ]
+    h = run_test(g, concurrency=1)
+    reads = [o for o in h if o.f == "r" and o.is_ok]
+    assert reads and reads[-1].value == 2
+
+
+def test_crash_rotates_process():
+    """A client exception becomes an :info op and the process id is
+    rotated by int-thread-count (interpreter.clj:245-249)."""
+    n = 9
+    h = run_test(
+        gen.limit(n, gen.repeat({"f": "w", "value": 0})),
+        client=CrashyClient(every=3),
+        concurrency=1,
+    )
+    infos = [o for o in h if o.is_info]
+    assert len(infos) == 3
+    procs = {o.process for o in h if o.is_invoke}
+    # concurrency 1: processes 0, 1, 2, 3 as the worker crashes 3 times
+    # (the last crash may be the final op).
+    assert 0 in procs and 1 in procs
+    for o in infos:
+        assert "boom" in (o.error or "")
+
+
+def test_nemesis_routing():
+    """Nemesis ops go to the nemesis; client ops to clients."""
+
+    class RecordingNemesis(nem.Nemesis):
+        def __init__(self):
+            self.seen = []
+
+        def invoke(self, test, op):
+            self.seen.append(op.f)
+            return op.replace(value="done")
+
+    rn = RecordingNemesis()
+    g = gen.nemesis(
+        gen.limit(2, [{"type": "info", "f": "start"}, {"type": "info", "f": "stop"}]),
+        gen.limit(4, gen.repeat({"f": "w", "value": 1})),
+    )
+    h = run_test(g, nemesis=rn, concurrency=2, wrap_clients=False)
+    assert sorted(rn.seen) == ["start", "stop"]
+    nem_ops = [o for o in h if o.process == NEMESIS]
+    assert len(nem_ops) == 4  # 2 invocations + 2 completions
+    comps = [o for o in nem_ops if o.value == "done"]
+    assert len(comps) == 2
+    # No nemesis op is ever type invoke in completion position: pairing OK.
+    client_ops = [o for o in h if o.process != NEMESIS]
+    assert len(client_ops) == 8
+
+
+def test_no_client_completes_fail():
+    class Unopenable(jc.Client):
+        def open(self, test, node):
+            raise ConnectionError("nope")
+
+        def invoke(self, test, op):  # pragma: no cover
+            raise AssertionError("never invoked")
+
+    h = run_test(gen.limit(2, gen.repeat({"f": "r"})), client=Unopenable(), concurrency=1)
+    fails = [o for o in h if o.is_fail]
+    assert len(fails) == 2
+    assert "no client" in fails[0].error
+
+
+def test_validate_client_contract():
+    class Liar(jc.Client):
+        def invoke(self, test, op):
+            return op.complete(OK).replace(f="other")
+
+    h = run_test(
+        gen.limit(1, {"f": "r"}),
+        client=jc.validate(Liar()),
+        concurrency=1,
+    )
+    # Contract violation surfaces as a crashed (:info) op, not a crash.
+    assert any(o.is_info and "f changed" in (o.error or "") for o in h)
+
+
+def test_client_timeout_wrapper():
+    class Slow(jc.Client):
+        def invoke(self, test, op):
+            import time
+
+            time.sleep(0.5)
+            return op.complete(OK)
+
+    h = run_test(
+        gen.limit(1, {"f": "r"}),
+        client=jc.timeout(50, Slow()),
+        concurrency=1,
+    )
+    infos = [o for o in h if o.is_info]
+    assert len(infos) == 1 and infos[0].error == "timeout"
+
+
+def test_time_limit_ends_run():
+    h = run_test(
+        gen.time_limit(0.2, gen.stagger(0.01, gen.repeat({"f": "r"}))),
+        concurrency=2,
+    )
+    assert len(h) > 0
+    # All invocations completed (drained), times within a sane bound.
+    invs = [o for o in h if o.is_invoke]
+    assert all(h.completion(o) is not None for o in invs)
+
+
+def test_concurrent_cas_history_checkable():
+    """End-to-end: concurrent run against the atom register must be
+    linearizable under the CPU WGL checker."""
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.models import cas_register
+
+    g = gen.time_limit(
+        0.5,
+        gen.mix(
+            [
+                gen.FnGen(lambda: {"f": "read"}),
+                gen.FnGen(lambda: {"f": "write", "value": __import__("random").randrange(5)}),
+                gen.FnGen(
+                    lambda: {
+                        "f": "cas",
+                        "value": [
+                            __import__("random").randrange(5),
+                            __import__("random").randrange(5),
+                        ],
+                    }
+                ),
+            ]
+        ),
+    )
+    h = run_test(g, concurrency=4)
+    assert len(h) > 10
+    res = linearizable(model=cas_register(), algorithm="cpu").check(
+        {}, h.client_ops(), {}
+    )
+    assert res["valid"] is True
+
+
+def test_partitioner_nemesis_with_fake_net():
+    class FakeNet:
+        def __init__(self):
+            self.grudges = []
+            self.healed = 0
+
+        def drop_all(self, test, grudge):
+            self.grudges.append(grudge)
+
+        def heal(self, test):
+            self.healed += 1
+
+    net = FakeNet()
+    test = {
+        "concurrency": 2,
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "net": net,
+        "client": AtomRegister(),
+        "nemesis": nem.partition_halves().setup(
+            {"net": net, "nodes": ["n1", "n2", "n3", "n4", "n5"]}
+        ),
+        "generator": gen.nemesis(
+            [
+                gen.once({"type": "info", "f": "start"}),
+                gen.once({"type": "info", "f": "stop"}),
+            ],
+            gen.limit(4, gen.repeat({"f": "r"})),
+        ),
+    }
+    h = interpreter.run(test)
+    assert len(net.grudges) == 1
+    grudge = net.grudges[0]
+    # 5 nodes: half [n1 n2] cut from [n3 n4 n5] and vice versa.
+    assert grudge["n1"] == {"n3", "n4", "n5"}
+    assert grudge["n3"] == {"n1", "n2"}
+    assert net.healed >= 2  # setup + stop (+ teardown not called here)
+    stops = [o for o in h if o.f == "stop" and o.value == "network healed"]
+    assert len(stops) == 1
+
+
+def test_grudge_math():
+    nodes = ["a", "b", "c", "d", "e"]
+    g = nem.complete_grudge([["a", "b"], ["c", "d", "e"]])
+    assert g["a"] == {"c", "d", "e"} and g["c"] == {"a", "b"}
+
+    b = nem.bridge(nodes)
+    # c is the bridge: sees everyone.
+    assert b["c"] == set()
+    assert b["a"] == {"d", "e"} and b["d"] == {"a", "b"}
+
+    m = nem.majorities_ring(nodes)
+    for node, cut in m.items():
+        # every node sees a majority (3 of 5) including itself
+        assert len(cut) == 2
+        assert node not in cut
+
+    one, rest = nem.split_one(nodes)
+    assert len(one) == 1 and len(rest) == 4 and set(one + rest) == set(nodes)
+
+
+def test_interpreter_throughput_floor():
+    """Perf smoke (interpreter_test.clj:43-88 asserts >10k ops/s on JVM
+    at concurrency 1024; we assert a modest floor at concurrency 64
+    on the in-process noop client)."""
+    import time
+
+    n = 4000
+    t0 = time.monotonic()
+    h = run_test(
+        gen.limit(n, gen.repeat({"f": "w", "value": 0})),
+        client=jc.noop,
+        concurrency=64,
+    )
+    dt = time.monotonic() - t0
+    assert len(h) == 2 * n
+    rate = n / dt
+    assert rate > 1000, f"interpreter too slow: {rate:.0f} ops/s"
