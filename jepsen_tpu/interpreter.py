@@ -164,8 +164,12 @@ class NemesisWorker(Worker):
 
     def transact(self, op: Op) -> Op:
         out = self.nemesis.invoke(self.test, op)
-        # Nemesis completions are indeterminate by convention; never let
-        # a second :invoke into the history.
+        # Contract guard, mirroring the client path's Validate: the
+        # completion must keep the invocation's process and f, or the
+        # hot loop can't route it; and nemesis completions are
+        # indeterminate by convention — never a second :invoke.
+        if out.process != op.process or out.f != op.f:
+            out = out.replace(process=op.process, f=op.f)
         if out.type == INVOKE:
             out = out.replace(type=INFO)
         return out

@@ -112,15 +112,20 @@ def bridge(nodes: Sequence[Any]) -> dict:
 
 def majorities_ring(nodes: Sequence[Any]) -> dict:
     """Grudge in which every node can see a majority including itself,
-    but no node sees the *same* majority: overlapping majorities
-    arranged in a ring (nemesis.clj:203-276).  Node i's component is the
-    majority-size window of the ring starting at i."""
+    but no two nodes see the *same* majority: overlapping majorities
+    arranged in a ring (nemesis.clj:203-276).  Node i's view is the
+    window of the ring *centered* on i — centering makes visibility
+    symmetric, so every node keeps a BIDIRECTIONAL majority (itself
+    plus its k nearest neighbors each way).  A window keyed at i
+    instead of centered on it would isolate every node: i could hear
+    nodes that cannot hear it back.  Even majority sizes round up to
+    the next odd window to stay symmetric."""
     nodes = list(nodes)
     n = len(nodes)
-    maj = majority(n)
+    k = majority(n) // 2
     grudge = {}
     for i, node in enumerate(nodes):
-        visible = {nodes[(i + d) % n] for d in range(maj)}
+        visible = {nodes[(i + d) % n] for d in range(-k, k + 1)}
         grudge[node] = set(nodes) - visible
     return grudge
 

@@ -333,3 +333,39 @@ def test_interpreter_throughput_floor():
     assert len(h) == 2 * n
     rate = n / dt
     assert rate > 1000, f"interpreter too slow: {rate:.0f} ops/s"
+
+
+def test_majorities_ring_bidirectional():
+    """Every node must keep a bidirectional majority: i and j can talk
+    iff neither grudges the other."""
+    for n in (3, 4, 5, 6, 7):
+        nodes = [f"n{i}" for i in range(n)]
+        g = nem.majorities_ring(nodes)
+        from jepsen_tpu.utils import majority
+
+        for a in nodes:
+            mutual = {
+                b
+                for b in nodes
+                if b != a and b not in g[a] and a not in g[b]
+            }
+            assert len(mutual) + 1 >= majority(n), (n, a, mutual)
+        # It's still a real partition: nobody sees everyone (n > 3).
+        if n > 3:
+            assert all(g[a] for a in nodes)
+
+
+def test_rogue_nemesis_does_not_crash_run():
+    class Rogue(nem.Nemesis):
+        def invoke(self, test, op):
+            return op.replace(process=999, f="mutated")
+
+    h = run_test(
+        gen.nemesis(gen.limit(1, gen.repeat({"type": "info", "f": "start"}))),
+        nemesis=Rogue(),
+        concurrency=2,
+        wrap_clients=False,
+    )
+    nem_ops = [o for o in h if o.process == NEMESIS]
+    assert len(nem_ops) == 2
+    assert all(o.f == "start" for o in nem_ops)

@@ -1,0 +1,187 @@
+"""Store tests: block format roundtrips, crash recovery, save phases
+(store_test.clj; format spec SURVEY.md §3.5)."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import store
+from jepsen_tpu.history import History, Op, invoke, ok
+from jepsen_tpu.store.format import (
+    BLOCK_CHUNK,
+    BlockWriter,
+    Handle,
+    HistoryWriter,
+    TestFile,
+)
+
+
+def ops(n, f="w"):
+    out = []
+    for i in range(n):
+        out.append(Op(type="invoke", f=f, value=i, process=i % 4, time=2 * i, index=2 * i))
+        out.append(Op(type="ok", f=f, value=i, process=i % 4, time=2 * i + 1, index=2 * i + 1))
+    return out
+
+
+def test_roundtrip_test_history_results(tmp_path):
+    p = str(tmp_path / "t.jtpu")
+    h = Handle(p)
+    h.save_test({"name": "demo", "concurrency": 4})
+    hw = h.open_history_writer(chunk_size=8)
+    rows = ops(20)
+    for o in rows:
+        hw.append(o)
+    h.save_run({"name": "demo", "concurrency": 4, "done": True})
+    h.save_results({"valid": True, "count": 40})
+    h.close()
+
+    with TestFile(p) as tf:
+        assert tf.test["done"] is True
+        assert tf.results == {"valid": True, "count": 40}
+        got = list(tf.iter_ops())
+        assert len(got) == 40
+        assert got[0].f == "w" and got[-1].value == 19
+        assert [o.index for o in got] == list(range(40))
+
+
+def test_crash_recovery_keeps_sealed_chunks(tmp_path):
+    """Torn trailing bytes are ignored; history up to the last
+    checkpoint survives (format.clj:189-199 semantics)."""
+    p = str(tmp_path / "t.jtpu")
+    h = Handle(p)
+    h.save_test({"name": "crashy"})
+    hw = h.open_history_writer(chunk_size=4)
+    rows = ops(6)  # 12 ops -> 3 sealed chunks of 4
+    for o in rows:
+        hw.append(o)
+    # Simulate a crash: garbage partial block at the tail, no final
+    # checkpoint.
+    h.writer.f.write(b"\xde\xad\xbe\xef\x00torn")
+    h.writer.f.flush()
+    h.close()
+
+    with TestFile(p) as tf:
+        assert tf.test["name"] == "crashy"
+        got = list(tf.iter_ops())
+        assert len(got) == 12  # the 3 sealed chunks
+        assert tf.results is None
+
+
+def test_unsealed_buffer_lost_on_crash(tmp_path):
+    p = str(tmp_path / "t.jtpu")
+    h = Handle(p)
+    hw = h.open_history_writer(chunk_size=100)
+    for o in ops(3):  # 6 ops, all buffered, never sealed
+        hw.append(o)
+    h.close()  # close seals nothing: simulate crash by not calling hw.close()
+
+    with TestFile(p) as tf:
+        assert list(tf.iter_ops()) == []
+
+
+def test_store_lifecycle_and_symlinks(tmp_path):
+    root = str(tmp_path / "store")
+    test = {"name": "lifecycle", "store-dir": root, "concurrency": 2}
+    test = store.make_test_dir(test)
+    assert os.path.isdir(store.test_dir(test))
+
+    with store.Store(test) as s:
+        s.save_0(test)
+        hw = s.history_writer(chunk_size=4)
+        rows = ops(5)
+        for o in rows:
+            hw.append(o)
+        hist = History(rows, reindex=False)
+        s.save_1(test, hist)
+        s.save_2({"valid": False})
+
+    # current/latest symlinks point at the run dir.
+    assert os.path.realpath(os.path.join(root, "current")) == os.path.realpath(
+        store.test_dir(test)
+    )
+    assert os.path.realpath(
+        os.path.join(root, "lifecycle", "latest")
+    ) == os.path.realpath(store.test_dir(test))
+
+    # history.txt exists with one line per op.
+    with open(store.path(test, "history.txt")) as f:
+        assert len(f.readlines()) == 10
+
+    tf = store.load(store.test_dir(test))
+    assert tf.results == {"valid": False}
+    assert len(list(tf.iter_ops())) == 10
+    # client/nemesis/... are stripped, serializable keys kept.
+    assert tf.test["concurrency"] == 2
+    tf.close()
+
+    listing = store.tests(root)
+    assert "lifecycle" in listing and len(listing["lifecycle"]) == 1
+    assert store.latest(root) == os.path.realpath(store.test_dir(test))
+
+
+def test_nonserializable_strip():
+    t = {"name": "x", "client": object(), "generator": object(), "concurrency": 3}
+    s = store.serializable_test(t)
+    assert "client" not in s and "generator" not in s
+    assert s["concurrency"] == 3
+
+
+def test_interpreter_streams_to_store(tmp_path):
+    """The interpreter's writer hook streams ops into sealed chunks
+    during the run (interpreter.clj:251-253, 303-308)."""
+    from jepsen_tpu import client as jc
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import interpreter
+    from jepsen_tpu import nemesis as nem
+
+    root = str(tmp_path / "store")
+    test = {
+        "name": "streamed",
+        "store-dir": root,
+        "concurrency": 2,
+        "nodes": ["n1"],
+        "client": jc.noop,
+        "nemesis": nem.noop,
+        "generator": gen.clients(gen.limit(10, gen.repeat({"f": "r"}))),
+    }
+    test = store.make_test_dir(test)
+    with store.Store(test) as s:
+        s.save_0(test)
+        hw = s.history_writer(chunk_size=4)
+        h = interpreter.run(test, writer=hw.append)
+        s.save_1(test, h)
+        s.save_2({"valid": True})
+
+    tf = store.load(store.test_dir(test))
+    stored = list(tf.iter_ops())
+    assert len(stored) == len(h) == 20
+    assert [o.to_dict() for o in stored] == [o.to_dict() for o in h]
+    tf.close()
+
+
+def test_reopen_after_torn_tail_truncates(tmp_path):
+    """A writer reopening a file with torn trailing bytes truncates them
+    so later blocks stay reachable by the sequential scan."""
+    p = str(tmp_path / "t.jtpu")
+    h1 = Handle(p)
+    h1.save_test({"name": "r1"})
+    hw1 = h1.open_history_writer(chunk_size=2)
+    for o in ops(2):
+        hw1.append(o)
+    h1.writer.f.write(b"\x99torn-partial-block")
+    h1.writer.f.flush()
+    h1.close()
+
+    # Retry run appends cleanly to the same file.
+    h2 = Handle(p)
+    h2.save_test({"name": "r2"})
+    hw2 = h2.open_history_writer(chunk_size=2)
+    for o in ops(4):
+        hw2.append(o)
+    hw2.close()
+    h2.close()
+
+    with TestFile(p) as tf:
+        assert tf.test["name"] == "r2"
+        assert len(list(tf.iter_ops())) == 8
