@@ -1,0 +1,195 @@
+"""Network manipulation: partitions and packet shaping.
+
+Equivalent of /root/reference/jepsen/src/jepsen/net.clj (+ net/proto.clj):
+the `Net` protocol (drop!/heal!/slow!/flaky!/fast!/shape!,
+net.clj:15-29), the iptables implementation (:177-233, including the
+bulk `PartitionAll` drop :223-233), and tc/netem shaping with
+delay/loss/corrupt/duplicate/reorder/rate behaviors (:73-164).
+
+All methods act via the control-plane sessions bound in
+``test["sessions"]`` (the reference's dynamic `c/on-nodes` binding).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping, Optional, Sequence
+
+from .control import Session, on_nodes
+
+
+class Net:
+    """net/proto.clj:5-12 + net.clj:15-29."""
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        """Cuts the link src -> dest (dest stops hearing src)."""
+        raise NotImplementedError
+
+    def drop_all(self, test: dict, grudge: Mapping[str, Any]) -> None:
+        """Applies a whole grudge {node: nodes-it-stops-hearing} at
+        once (PartitionAll, net.clj:223-233)."""
+        for node, cut in grudge.items():
+            for src in cut:
+                self.drop(test, src, node)
+
+    def heal(self, test: dict) -> None:
+        raise NotImplementedError
+
+    def slow(self, test: dict, **opts: Any) -> None:
+        """Delays all traffic (mean 50 ms ± 10 ms, net.clj:50-56)."""
+        raise NotImplementedError
+
+    def flaky(self, test: dict) -> None:
+        """Drops packets probabilistically (20%, net.clj:58-61)."""
+        raise NotImplementedError
+
+    def fast(self, test: dict) -> None:
+        """Removes shaping (not partitions)."""
+        raise NotImplementedError
+
+    def shape(self, test: dict, behavior: Optional[dict], nodes: Optional[Sequence[str]] = None) -> None:
+        """Applies a tc/netem behavior dict: keys delay {time,jitter,
+        correlation,distribution}, loss {percent,correlation},
+        corrupt/duplicate/reorder {percent,correlation}, rate
+        (net.clj:73-164).  None removes shaping."""
+        raise NotImplementedError
+
+
+class NoopNet(Net):
+    """For dummy remotes and in-memory tests."""
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        pass
+
+    def drop_all(self, test: dict, grudge: Mapping[str, Any]) -> None:
+        pass
+
+    def heal(self, test: dict) -> None:
+        pass
+
+    def slow(self, test: dict, **opts: Any) -> None:
+        pass
+
+    def flaky(self, test: dict) -> None:
+        pass
+
+    def fast(self, test: dict) -> None:
+        pass
+
+    def shape(self, test: dict, behavior, nodes=None) -> None:
+        pass
+
+
+def _netem_args(behavior: Mapping[str, Any]) -> list[str]:
+    """Renders a behavior map to netem arguments (net.clj:93-146)."""
+    args: list[str] = []
+    delay = behavior.get("delay")
+    if delay:
+        args += ["delay", f"{delay.get('time', 50)}ms"]
+        if "jitter" in delay:
+            args += [f"{delay['jitter']}ms"]
+        if "correlation" in delay:
+            args += [f"{delay['correlation']}%"]
+        if delay.get("distribution"):
+            args += ["distribution", str(delay["distribution"])]
+    for kind in ("loss", "corrupt", "duplicate", "reorder"):
+        spec = behavior.get(kind)
+        if spec:
+            args += [kind, f"{spec.get('percent', 20)}%"]
+            if "correlation" in spec:
+                args += [f"{spec['correlation']}%"]
+    if behavior.get("rate"):
+        args += ["rate", f"{behavior['rate']}kbit"]
+    return args
+
+
+class IptablesNet(Net):
+    """iptables + tc/netem implementation (net.clj:177-233)."""
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "iptables", "-A", "INPUT", "-s", src,
+                    "-j", "DROP", "-w",
+                )
+
+        on_nodes(test, do, [dest])
+
+    def drop_all(self, test: dict, grudge: Mapping[str, Any]) -> None:
+        # One command per node, not per edge: comma-joined sources
+        # (PartitionAll, net.clj:223-233).
+        targets = {n: sorted(cut) for n, cut in grudge.items() if cut}
+
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "iptables", "-A", "INPUT", "-s",
+                    ",".join(targets[node]), "-j", "DROP", "-w",
+                )
+
+        on_nodes(test, do, list(targets.keys()))
+
+    def heal(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec("iptables", "-F", "-w")
+                sess.exec("iptables", "-X", "-w")
+
+        on_nodes(test, do)
+
+    def slow(self, test: dict, **opts: Any) -> None:
+        mean = opts.get("mean", 50)
+        variance = opts.get("variance", 10)
+        dist = opts.get("distribution", "normal")
+
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                    "delay", f"{mean}ms", f"{variance}ms",
+                    "distribution", dist,
+                )
+
+        on_nodes(test, do)
+
+    def flaky(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                    "loss", "20%", "75%",
+                )
+
+        on_nodes(test, do)
+
+    def fast(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                # Deleting a nonexistent qdisc fails; ignore like the
+                # reference (net.clj:69-71).
+                res = sess.exec_star(
+                    "tc", "qdisc", "del", "dev", "eth0", "root"
+                )
+                del res
+
+        on_nodes(test, do)
+
+    def shape(self, test: dict, behavior, nodes=None) -> None:
+        if not behavior:
+            self.fast(test)
+            return
+        args = _netem_args(behavior)
+
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec_star("tc", "qdisc", "del", "dev", "eth0", "root")
+                sess.exec(
+                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
+                    *args,
+                )
+
+        on_nodes(test, do, nodes)
+
+
+iptables = IptablesNet()
+noop = NoopNet()

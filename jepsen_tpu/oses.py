@@ -1,0 +1,86 @@
+"""OS protocol: preparing nodes before the DB goes on.
+
+Equivalent of /root/reference/jepsen/src/jepsen/os.clj (:4-8) and the
+os/{debian,ubuntu,centos}.clj implementations (package install, hostfile
+setup).  Named `oses` to avoid shadowing the stdlib `os` module.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Sequence
+
+from .control import Session, on_nodes
+
+log = logging.getLogger(__name__)
+
+
+class OS:
+    """os.clj:4-8."""
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        pass
+
+    def teardown(self, test: dict, sess: Session, node: str) -> None:
+        pass
+
+
+class NoopOS(OS):
+    pass
+
+
+noop = NoopOS()
+
+
+class DebianOS(OS):
+    """Debian/Ubuntu node prep (os/debian.clj:14-181): hostname in
+    /etc/hosts, apt packages installed on demand."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        self.setup_hostfile(test, sess, node)
+        if self.packages:
+            self.install(sess, self.packages)
+
+    def setup_hostfile(self, test: dict, sess: Session, node: str) -> None:
+        """Ensures every test node resolves (os/debian.clj:14-27)."""
+        nodes = test.get("nodes") or []
+        lines = ["127.0.0.1 localhost"]
+        for n in nodes:
+            try:
+                ip = sess.exec("getent", "hosts", n).split()[0]
+            except Exception:  # noqa: BLE001 - unresolvable: leave to DNS
+                continue
+            lines.append(f"{ip} {n}")
+        with sess.su():
+            sess.exec(
+                "tee", "/etc/hosts", stdin="\n".join(lines) + "\n"
+            )
+
+    def install(self, sess: Session, packages: Sequence[str]) -> None:
+        """apt-get install missing packages (os/debian.clj:62-90)."""
+        with sess.su():
+            sess.exec(
+                "env", "DEBIAN_FRONTEND=noninteractive",
+                "apt-get", "install", "-y", "--no-install-recommends",
+                *packages,
+            )
+
+    def teardown(self, test: dict, sess: Session, node: str) -> None:
+        pass
+
+
+debian = DebianOS()
+
+
+def setup(test: dict) -> None:
+    """OS setup across all nodes (core.clj:92-99 with-os)."""
+    osys = test.get("os") or noop
+    on_nodes(test, lambda s, n: osys.setup(test, s, n))
+
+
+def teardown(test: dict) -> None:
+    osys = test.get("os") or noop
+    on_nodes(test, lambda s, n: osys.teardown(test, s, n))

@@ -35,6 +35,9 @@ NONSERIALIZABLE_KEYS = (
     "sessions",
     "barrier",
     "store",
+    # Run outputs saved in their own blocks, not inside the test map:
+    "history",
+    "results",
 )
 
 TEST_FILE = "test.jtpu"

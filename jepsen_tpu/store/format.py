@@ -264,9 +264,25 @@ class Handle:
 
     def __init__(self, path: str):
         self.path = path
+        existing_index: Optional[dict] = None
+        if os.path.exists(path) and os.path.getsize(path) > len(MAGIC):
+            try:
+                with TestFile(path) as tf:
+                    existing_index = tf.index
+            except ValueError:
+                existing_index = None
         self.writer = BlockWriter(path)
         self.history_writer: Optional[HistoryWriter] = None
         self._test_offset: Optional[int] = None
+        if existing_index:
+            # Reopening (e.g. to append fresh analysis results): carry
+            # the prior index forward so history chunks stay reachable.
+            self._test_offset = existing_index.get("test")
+            hw = HistoryWriter(self.writer, test_offset=self._test_offset)
+            hw.chunk_offsets = list(existing_index.get("chunks", []))
+            hw.n_ops = existing_index.get("n_ops", 0)
+            hw.results_offset = existing_index.get("results")
+            self.history_writer = hw
 
     def save_test(self, test_map: dict) -> None:
         """save-0!: the initial test map, before the run."""

@@ -1,0 +1,308 @@
+"""Control-plane tests: escaping, sessions over dummy/local remotes,
+fan-out, net command construction, db lifecycle
+(control_test.clj; SURVEY.md §4 dummy-remote strategy)."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import control, db as jdb, net as jnet, oses
+from jepsen_tpu.control import (
+    ConnSpec,
+    DummyRemote,
+    LocalRemote,
+    NonzeroExit,
+    RetryRemote,
+    Session,
+    lit,
+    on_nodes,
+    with_sessions,
+)
+from jepsen_tpu.control.core import (
+    RemoteError,
+    escape,
+    env_str,
+    wrap_action,
+)
+from jepsen_tpu.control import util as cutil
+
+
+# -- escaping (control/core.clj:71-114) ---------------------------------
+
+
+def test_escape_plain_words_untouched():
+    assert escape(["echo", "hi"]) == "echo hi"
+    assert escape(["ls", "-la", "/tmp/foo"]) == "ls -la /tmp/foo"
+
+
+def test_escape_quotes_specials():
+    assert escape(["echo", "hello world"]) == "echo 'hello world'"
+    cmd = escape(["echo", "it's"])
+    assert "it" in cmd and cmd != "echo it's"
+    # Shell metacharacters never pass through bare.
+    assert "$" not in escape(["echo", "$HOME"]).replace("'$HOME'", "")
+
+
+def test_lit_passes_raw():
+    assert escape(["echo", "a", lit("| grep b")]) == "echo a | grep b"
+
+
+def test_env_str():
+    assert env_str({"B": 1, "A": "x y"}) == "A='x y' B=1"
+
+
+def test_wrap_action_sudo_cd_env():
+    a = {
+        "cmd": "whoami",
+        "dir": "/tmp",
+        "sudo": "root",
+        "sudo-password": "pw",
+        "env": {"K": "v"},
+        "in": None,
+    }
+    w = wrap_action(a)
+    assert w["cmd"].startswith("sudo -S -u root bash -c ")
+    assert "cd /tmp" in w["cmd"] and "env K=v" in w["cmd"]
+    assert w["in"].startswith("pw\n")
+
+
+# -- dummy remote (the :dummy? CI strategy) ------------------------------
+
+
+def dummy_test(nodes=("n1", "n2", "n3")):
+    return {"nodes": list(nodes), "ssh": {"dummy?": True}}
+
+
+def test_dummy_sessions_and_fanout():
+    test = dummy_test()
+    with with_sessions(test):
+        results = on_nodes(test, lambda s, n: s.exec("hostname"))
+        assert set(results.keys()) == {"n1", "n2", "n3"}
+        assert all(v == "" for v in results.values())
+
+
+def test_on_nodes_subset_and_errors():
+    test = dummy_test()
+    with with_sessions(test):
+        res = on_nodes(test, lambda s, n: n.upper(), ["n2"])
+        assert res == {"n2": "N2"}
+    assert "sessions" not in test
+    with pytest.raises(RuntimeError):
+        on_nodes(test, lambda s, n: None)
+
+
+def test_dummy_records_actions():
+    remote = DummyRemote()
+    sess = Session("n1", remote.connect(ConnSpec("n1")))
+    with sess.su():
+        sess.exec("iptables", "-F")
+    assert remote.actions, "dummy shares its action log across connects"
+    assert "iptables -F" in remote.actions[-1]["cmd"]
+    assert remote.actions[-1]["cmd"].startswith("sudo")
+
+
+# -- local remote --------------------------------------------------------
+
+
+def local_session(node="local"):
+    return Session(node, LocalRemote().connect(ConnSpec(node)))
+
+
+def test_local_exec_roundtrip():
+    sess = local_session()
+    assert sess.exec("echo", "hello world") == "hello world"
+    assert sess.exec("echo", "$HOME") == "$HOME"  # escaping blocks expansion
+
+
+def test_local_exec_nonzero_raises():
+    sess = local_session()
+    with pytest.raises(NonzeroExit) as ei:
+        sess.exec("bash", "-c", "echo oops >&2; exit 3")
+    assert ei.value.exit == 3
+    assert "oops" in ei.value.err
+
+
+def test_local_stdin_and_cd(tmp_path):
+    sess = local_session()
+    with sess.cd(str(tmp_path)):
+        assert sess.exec("pwd") == str(tmp_path)
+        sess.exec("tee", "f.txt", stdin="payload\n")
+    assert (tmp_path / "f.txt").read_text() == "payload\n"
+
+
+def test_local_upload_download(tmp_path):
+    src = tmp_path / "src.txt"
+    src.write_text("data")
+    dest = tmp_path / "dest.txt"
+    sess = local_session()
+    sess.upload(str(src), str(dest))
+    assert dest.read_text() == "data"
+    dl = tmp_path / "dl"
+    dl.mkdir()
+    sess.download(str(dest), str(dl))
+    assert (dl / "dest.txt").read_text() == "data"
+
+
+def test_control_util_on_local(tmp_path):
+    sess = local_session()
+    p = str(tmp_path / "x")
+    assert not cutil.exists(sess, p)
+    cutil.write_file(sess, p, "hi\n")
+    assert cutil.exists(sess, p)
+    assert cutil.ls(sess, str(tmp_path)) == ["x"]
+
+
+def test_daemon_lifecycle(tmp_path):
+    sess = local_session()
+    pidfile = str(tmp_path / "d.pid")
+    logfile = str(tmp_path / "d.log")
+    started = cutil.start_daemon(
+        sess, "sleep", "30", pidfile=pidfile, logfile=logfile
+    )
+    assert started
+    assert cutil.daemon_running(sess, pidfile)
+    # Idempotent: second start is a no-op while running.
+    assert not cutil.start_daemon(
+        sess, "sleep", "30", pidfile=pidfile, logfile=logfile
+    )
+    cutil.stop_daemon(sess, pidfile)
+    assert not cutil.daemon_running(sess, pidfile)
+
+
+# -- retry wrapper -------------------------------------------------------
+
+
+def test_retry_remote_reconnects():
+    class Flaky(control.Remote):
+        def __init__(self):
+            self.fails = 2
+            self.connects = 0
+
+        def connect(self, spec):
+            self.connects += 1
+            return self
+
+        def execute(self, action):
+            if self.fails > 0:
+                self.fails -= 1
+                raise RemoteError("transient")
+            out = dict(action)
+            out.update({"out": "ok", "err": "", "exit": 0})
+            return out
+
+    inner = Flaky()
+    r = RetryRemote(inner).connect(ConnSpec("n1"))
+    res = r.execute({"cmd": "x"})
+    assert res["out"] == "ok"
+    assert inner.connects >= 2  # reconnected after failures
+
+
+def test_retry_remote_exhausts():
+    class Dead(control.Remote):
+        def connect(self, spec):
+            return self
+
+        def execute(self, action):
+            raise RemoteError("always down")
+
+    r = RetryRemote(Dead()).connect(ConnSpec("n1"))
+    with pytest.raises(RemoteError):
+        r.execute({"cmd": "x"})
+
+
+# -- net over dummy sessions --------------------------------------------
+
+
+def test_iptables_drop_all_commands():
+    test = dummy_test(("n1", "n2", "n3", "n4", "n5"))
+    remote = DummyRemote()
+    test["remote"] = remote
+    test["ssh"] = {}
+    with with_sessions(test):
+        jnet.iptables.drop_all(
+            test, {"n1": {"n3", "n4"}, "n2": {"n3"}}
+        )
+        cmds = [a["cmd"] for a in remote.actions if "iptables" in a["cmd"]]
+        # One bulk command per grudged node (net.clj:223-233).
+        assert len(cmds) == 2
+        joined = "\n".join(cmds)
+        assert "-s n3,n4 -j DROP" in joined
+        assert "-s n3 -j DROP" in joined
+
+        remote.actions.clear()
+        jnet.iptables.heal(test)
+        cmds = [a["cmd"] for a in remote.actions]
+        assert any("iptables -F" in c for c in cmds)
+        assert any("iptables -X" in c for c in cmds)
+
+
+def test_netem_args():
+    from jepsen_tpu.net import _netem_args
+
+    args = _netem_args(
+        {
+            "delay": {"time": 100, "jitter": 5, "distribution": "pareto"},
+            "loss": {"percent": 10},
+            "rate": 1024,
+        }
+    )
+    s = " ".join(args)
+    assert "delay 100ms 5ms distribution pareto" in s
+    assert "loss 10%" in s
+    assert "rate 1024kbit" in s
+
+
+# -- db + os over dummy sessions ----------------------------------------
+
+
+def test_db_lifecycle_and_capabilities():
+    calls = []
+
+    class MyDB(jdb.DB):
+        def setup(self, test, sess, node):
+            calls.append(("setup", node))
+
+        def teardown(self, test, sess, node):
+            calls.append(("teardown", node))
+
+        def setup_primary(self, test, sess, node):
+            calls.append(("primary", node))
+
+        def kill(self, test, sess, node):
+            calls.append(("kill", node))
+
+    test = dummy_test()
+    test["db"] = MyDB()
+    with with_sessions(test):
+        jdb.cycle(test)
+    assert ("teardown", "n1") in calls and ("setup", "n1") in calls
+    assert ("primary", "n1") in calls
+    assert ("primary", "n2") not in calls
+
+    assert test["db"].supports("kill")
+    assert not test["db"].supports("pause")
+    assert not jdb.noop.supports("kill")
+
+
+def test_db_cycle_retries():
+    attempts = []
+
+    class FailsOnce(jdb.DB):
+        def setup(self, test, sess, node):
+            attempts.append(node)
+            if len(attempts) <= 1:
+                raise RuntimeError("flaky setup")
+
+    test = dummy_test(("n1",))
+    test["db"] = FailsOnce()
+    with with_sessions(test):
+        jdb.cycle(test)
+    assert len(attempts) == 2  # failed once, retried
+
+
+def test_os_noop_setup():
+    test = dummy_test()
+    test["os"] = oses.noop
+    with with_sessions(test):
+        oses.setup(test)
+        oses.teardown(test)

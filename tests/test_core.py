@@ -1,0 +1,228 @@
+"""Whole-stack lifecycle tests: dummy remotes + in-memory clients
+through run() -> store -> analyze (core_test.clj:68-132 strategy)."""
+
+import json
+import os
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from jepsen_tpu import checker as chk
+from jepsen_tpu import cli, client as jc, core, db as jdb, net as jnet, store
+from jepsen_tpu import generator as gen
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu.history import FAIL, OK
+from jepsen_tpu.models import cas_register
+
+
+class AtomRegister(jc.Client):
+    def __init__(self, state=None, lock=None):
+        self.state = state if state is not None else {"v": None}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return AtomRegister(self.state, self.lock)
+
+    def invoke(self, test, op):
+        with self.lock:
+            if op.f == "write":
+                self.state["v"] = op.value
+                return op.complete(OK)
+            if op.f == "read":
+                return op.complete(OK, value=self.state["v"])
+            old, new = op.value
+            if self.state["v"] == old:
+                self.state["v"] = new
+                return op.complete(OK)
+            return op.complete(FAIL)
+
+
+def register_test(tmp_path, **overrides):
+    import random
+
+    t = {
+        "name": "register-smoke",
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": "2n",
+        "store-dir": str(tmp_path / "store"),
+        "ssh": {"dummy?": True},
+        "net": jnet.noop,
+        "client": AtomRegister(),
+        "model": cas_register(),
+        "generator": gen.time_limit(
+            0.4,
+            gen.clients(
+                gen.stagger(
+                    0.005,
+                    gen.mix(
+                    [
+                        gen.FnGen(lambda: {"f": "read"}),
+                        gen.FnGen(
+                            lambda: {"f": "write", "value": random.randrange(5)}
+                        ),
+                    ]
+                    ),
+                )
+            ),
+        ),
+        "checker": chk.compose(
+            {
+                "stats": chk.Stats(),
+                "linear": __import__(
+                    "jepsen_tpu.checker.linearizable", fromlist=["linearizable"]
+                ).linearizable(algorithm="cpu"),
+            }
+        ),
+    }
+    t.update(overrides)
+    return t
+
+
+def test_parse_concurrency():
+    assert core.parse_concurrency(10, 5) == 10
+    assert core.parse_concurrency("3n", 5) == 15
+    assert core.parse_concurrency("2", 5) == 2
+
+
+def test_full_lifecycle(tmp_path):
+    test = core.run(register_test(tmp_path))
+    assert test["results"]["valid"] is True
+    assert len(test["history"]) > 0
+    # Everything persisted: test map, history, results.
+    d = store.test_dir(test)
+    tf = store.load(d)
+    assert tf.results["valid"] is True
+    assert len(list(tf.iter_ops())) == len(test["history"])
+    assert tf.test["concurrency"] == 6  # "2n" x 3 nodes, parsed
+    tf.close()
+    assert os.path.exists(os.path.join(d, "history.txt"))
+    assert os.path.exists(os.path.join(d, "jepsen.log"))
+
+
+def test_lifecycle_with_db_and_nemesis(tmp_path):
+    events = []
+
+    class TrackedDB(jdb.DB):
+        def setup(self, test, sess, node):
+            events.append(("db-setup", node))
+
+        def teardown(self, test, sess, node):
+            events.append(("db-teardown", node))
+
+        def log_files(self, test, sess, node):
+            return []
+
+    test = register_test(
+        tmp_path,
+        db=TrackedDB(),
+        nemesis=nem.partition_random_halves(),
+        generator=gen.time_limit(
+            0.3,
+            gen.nemesis(
+                gen.repeat(
+                    [
+                        {"type": "info", "f": "start"},
+                        {"type": "info", "f": "stop"},
+                    ]
+                ),
+                gen.repeat({"f": "read"}),
+            ),
+        ),
+    )
+    out = core.run(test)
+    assert out["results"]["valid"] is True
+    assert ("db-setup", "n1") in events
+    assert ("db-teardown", "n1") in events  # initial cycle + final teardown
+    nem_ops = [o for o in out["history"] if o.process == "nemesis"]
+    assert nem_ops, "nemesis ran"
+
+
+def test_rerun_analysis(tmp_path):
+    test = core.run(register_test(tmp_path))
+    d = store.test_dir(test)
+    merged = core.rerun_analysis(d, register_test(tmp_path))
+    assert merged["results"]["valid"] is True
+    # Results re-saved to the same file.
+    tf = store.load(d)
+    assert tf.results["valid"] is True
+    assert len(list(tf.iter_ops())) == len(test["history"])
+    tf.close()
+
+
+def test_cli_test_and_analyze(tmp_path, capsys):
+    def suite(opts):
+        return register_test(
+            tmp_path,
+            **{"nodes": opts["nodes"], "concurrency": opts["concurrency"]},
+        )
+
+    parser = cli.single_test_cmd(suite, name="register")
+    code = cli.run(
+        parser,
+        [
+            "test",
+            "--nodes", "a,b,c",
+            "--concurrency", "1n",
+            "--dummy-ssh",
+            "--store-dir", str(tmp_path / "store"),
+        ],
+    )
+    assert code == cli.EXIT_VALID
+    out = capsys.readouterr().out
+    assert "valid=True" in out
+
+    code = cli.run(
+        parser,
+        ["analyze", "--store-dir", str(tmp_path / "store"), "--dummy-ssh"],
+    )
+    assert code == cli.EXIT_VALID
+
+
+def test_cli_invalid_exit_code(tmp_path):
+    class AlwaysInvalid(chk.Checker):
+        def check(self, test, history, opts):
+            return {"valid": False, "because": "testing"}
+
+    def suite(opts):
+        return register_test(tmp_path, checker=AlwaysInvalid())
+
+    parser = cli.single_test_cmd(suite)
+    code = cli.run(
+        parser, ["test", "--dummy-ssh", "--store-dir", str(tmp_path / "store")]
+    )
+    assert code == cli.EXIT_INVALID
+
+
+def test_web_index_and_files(tmp_path):
+    from jepsen_tpu import web
+
+    test = core.run(register_test(tmp_path))
+    root = test["store-dir"]
+    srv = web.make_server(root, "127.0.0.1", 0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        idx = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/", timeout=5
+        ).read().decode()
+        assert "register-smoke" in idx and "True" in idx
+
+        rel = os.path.relpath(store.test_dir(test), root)
+        txt = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/files/{rel}/history.txt", timeout=5
+        ).read().decode()
+        assert "invoke" in txt
+
+        # Path traversal is refused.
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/files/..%2F..%2Fetc%2Fpasswd",
+                timeout=5,
+            )
+        assert ei.value.code in (403, 404)
+    finally:
+        srv.shutdown()
+        srv.server_close()
