@@ -1,0 +1,55 @@
+"""Elle-equivalent: transactional anomaly checking via dependency
+graphs and cycle search (SURVEY.md §2.4; reimplemented, not ported —
+the elle library is not vendored in the reference).
+
+`append` and `wr` provide analyses + generators; `graph` the SCC/cycle
+machinery; Checker adapters here plug into the checker protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ...history.core import History
+from ..core import Checker
+from . import append as _append
+from . import graph, wr as _wr
+from .append import AppendGen, analyze as analyze_append
+from .graph import DepGraph, check_cycles
+from .wr import WrGen, analyze as analyze_wr
+
+__all__ = [
+    "AppendChecker",
+    "AppendGen",
+    "DepGraph",
+    "WrChecker",
+    "WrGen",
+    "analyze_append",
+    "analyze_wr",
+    "check_cycles",
+    "graph",
+]
+
+
+class AppendChecker(Checker):
+    """checker for list-append workloads (append.clj:6-27)."""
+
+    def __init__(self, consistency_model: str = "serializable"):
+        self.consistency_model = consistency_model
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        return analyze_append(
+            history.client_ops(), consistency_model=self.consistency_model
+        )
+
+
+class WrChecker(Checker):
+    """checker for rw-register workloads (wr.clj:5-25)."""
+
+    def __init__(self, consistency_model: str = "serializable"):
+        self.consistency_model = consistency_model
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        return analyze_wr(
+            history.client_ops(), consistency_model=self.consistency_model
+        )

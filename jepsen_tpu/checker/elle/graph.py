@@ -1,0 +1,205 @@
+"""Dependency graphs and cycle search for transactional anomaly checking.
+
+Host-side core of the Elle-equivalent (SURVEY.md §2.4: the external
+`elle` 0.1.8 library consumed at tests/cycle/{append,wr}.clj — NOT
+vendored in the reference; reimplemented here from the anomaly
+definitions in Adya's thesis and the Elle paper).
+
+A DepGraph has integer vertices (transaction indices into the history)
+and typed directed edges: "ww" (write-write), "wr" (write-read), "rw"
+(read-write anti-dependency), "realtime", "process".  Cycle search:
+Tarjan SCC, then a shortest cycle inside each nontrivial SCC (BFS),
+classified by the edge types it contains:
+
+    G0        cycle of ww edges only
+    G1c       cycle of ww/wr edges (at least one wr)
+    G2-item   cycle containing an rw edge (exactly one -> G-single)
+
+The batched device path for many small per-key graphs lives in
+jepsen_tpu.ops.scc.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict, deque
+from typing import Any, Iterable, Optional
+
+EDGE_TYPES = ("ww", "wr", "rw", "realtime", "process")
+
+
+class DepGraph:
+    def __init__(self) -> None:
+        #: {src: {dst: set(edge-types)}}
+        self.adj: dict[int, dict[int, set]] = defaultdict(dict)
+        self.vertices: set[int] = set()
+
+    def add_vertex(self, v: int) -> None:
+        self.vertices.add(v)
+
+    def add_edge(self, src: int, dst: int, etype: str) -> None:
+        if src == dst:
+            return  # self-edges are internal anomalies, handled separately
+        self.vertices.add(src)
+        self.vertices.add(dst)
+        self.adj[src].setdefault(dst, set()).add(etype)
+
+    def edge_types(self, src: int, dst: int) -> set:
+        return self.adj.get(src, {}).get(dst, set())
+
+    def out_edges(self, v: int) -> Iterable[int]:
+        return self.adj.get(v, {}).keys()
+
+    def n_edges(self) -> int:
+        return sum(len(d) for d in self.adj.values())
+
+    def restricted(self, etypes: Iterable[str]) -> "DepGraph":
+        """Subgraph keeping only edges of the given types."""
+        keep = set(etypes)
+        g = DepGraph()
+        g.vertices |= self.vertices
+        for src, dsts in self.adj.items():
+            for dst, types in dsts.items():
+                inter = types & keep
+                for t in inter:
+                    g.add_edge(src, dst, t)
+        return g
+
+    # -- SCC (Tarjan, iterative) ----------------------------------------
+
+    def sccs(self) -> list[list[int]]:
+        """Strongly-connected components, nontrivial ones only (size > 1;
+        self-loops are excluded by construction)."""
+        index_of: dict[int, int] = {}
+        low: dict[int, int] = {}
+        on_stack: set[int] = set()
+        stack: list[int] = []
+        out: list[list[int]] = []
+        counter = [0]
+
+        for root in self.vertices:
+            if root in index_of:
+                continue
+            # Iterative Tarjan: (vertex, iterator over successors).
+            work = [(root, iter(self.out_edges(root)))]
+            index_of[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                v, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index_of:
+                        index_of[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on_stack.add(w)
+                        work.append((w, iter(self.out_edges(w))))
+                        advanced = True
+                        break
+                    elif w in on_stack:
+                        low[v] = min(low[v], index_of[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    pv = work[-1][0]
+                    low[pv] = min(low[pv], low[v])
+                if low[v] == index_of[v]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on_stack.discard(w)
+                        comp.append(w)
+                        if w == v:
+                            break
+                    if len(comp) > 1:
+                        out.append(comp)
+        return out
+
+    # -- cycle recovery --------------------------------------------------
+
+    def find_cycle_in(self, component: Iterable[int]) -> Optional[list[int]]:
+        """A shortest cycle within a component: BFS from each vertex back
+        to itself, restricted to the component."""
+        comp = set(component)
+        best: Optional[list[int]] = None
+        for start in comp:
+            # BFS over comp edges from start; stop when we return.
+            parent: dict[int, int] = {}
+            q = deque([start])
+            seen = {start}
+            found = None
+            while q and found is None:
+                v = q.popleft()
+                for w in self.out_edges(v):
+                    if w == start:
+                        found = v
+                        break
+                    if w in comp and w not in seen:
+                        seen.add(w)
+                        parent[w] = v
+                        q.append(w)
+            if found is not None:
+                path = [found]
+                while path[-1] != start:
+                    path.append(parent[path[-1]])
+                path.reverse()
+                cycle = path + [start]  # start ... found, start
+                if best is None or len(cycle) < len(best):
+                    best = cycle
+        return best
+
+    def cycle_edge_types(self, cycle: list[int]) -> set:
+        types: set = set()
+        for a, b in zip(cycle, cycle[1:]):
+            types |= self.edge_types(a, b)
+        return types
+
+
+def classify_cycle(graph: DepGraph, cycle: list[int]) -> str:
+    """Adya-style classification by participating dependency types:
+    G-single = exactly one anti-dependency edge, G2-item = several."""
+    rw_edges = 0
+    types: set = set()
+    for a, b in zip(cycle, cycle[1:]):
+        ts = graph.edge_types(a, b)
+        types |= ts
+        # An edge that can ONLY be explained as rw counts as one.
+        if ts and not (ts - {"rw", "realtime", "process"}) and "rw" in ts:
+            rw_edges += 1
+    data = types & {"ww", "wr", "rw"}
+    if "rw" in data:
+        return "G-single" if rw_edges == 1 else "G2-item"
+    if "wr" in data:
+        return "G1c"
+    if data == {"ww"}:
+        return "G0"
+    return "cycle"  # realtime/process-only: should not happen alone
+
+
+def cycle_explanation(graph: DepGraph, cycle: list[int]) -> list[dict]:
+    """[{from, to, types}] steps for reporting."""
+    return [
+        {"from": a, "to": b, "types": sorted(graph.edge_types(a, b))}
+        for a, b in zip(cycle, cycle[1:])
+    ]
+
+
+def check_cycles(graph: DepGraph) -> list[dict]:
+    """All anomaly cycles: one shortest representative per nontrivial
+    SCC, classified.  Mirrors elle's cycle-search driver."""
+    out = []
+    for comp in graph.sccs():
+        cycle = graph.find_cycle_in(comp)
+        if cycle is None:
+            continue
+        out.append(
+            {
+                "type": classify_cycle(graph, cycle),
+                "cycle": cycle,
+                "steps": cycle_explanation(graph, cycle),
+                "scc-size": len(comp),
+            }
+        )
+    return out

@@ -1,0 +1,186 @@
+"""Read-write register transactional anomaly checking.
+
+Equivalent of elle.rw-register as consumed at
+/root/reference/jepsen/src/jepsen/tests/cycle/wr.clj:5-25 (elle not
+vendored; reimplemented from the Elle paper's write-read register
+inference).
+
+Transactions are ops with f="txn" and value = micro-ops ["w", k, v]
+(writes, globally unique per key) and ["r", k, v] (reads; None = the
+unwritten initial state).  Unlike list-append, a register read exposes
+only the *latest* value, so version orders are recovered from weaker
+evidence.  This implementation infers, per key:
+
+  * initial-state: None precedes every written value;
+  * intra-txn sequencing: a txn that reads or writes v and then writes
+    v' orders v << v' directly;
+
+and builds wr edges (writer of v -> any txn whose external read of k
+saw v), ww edges along inferred v << v' pairs, and rw anti-dependency
+edges (external reader of v -> writer of any v' with v << v').
+Non-cycle anomalies: G1a (aborted read), G1b (intermediate read),
+unwritten reads.  Cycles classify as in graph.classify_cycle.
+"""
+
+from __future__ import annotations
+
+import random
+from collections import defaultdict
+from typing import Any, Optional
+
+from ...history.core import History, Op
+from .graph import DepGraph, check_cycles
+from .append import FORBIDDEN, DIRTY
+
+
+def analyze(history: History, *, consistency_model: str = "serializable") -> dict:
+    oks = [o for o in history if o.is_ok and o.f in ("txn", None)]
+    infos = [o for o in history if o.is_info and o.f in ("txn", None)]
+    fails = [o for o in history if o.is_fail and o.f in ("txn", None)]
+
+    anomalies: dict[str, list] = defaultdict(list)
+
+    # (k, v) -> writer op index; committed or indeterminate writes count.
+    writer: dict[tuple, int] = {}
+    failed_writes: set = set()
+    intermediate: set = set()
+
+    def index_writes(op: Op, known: bool, failed: bool) -> None:
+        last: dict = {}
+        for f, k, v in op.value or []:
+            if f == "w":
+                kv = (k, v)
+                if failed:
+                    failed_writes.add(kv)
+                elif kv in writer:
+                    anomalies["duplicate-writes"].append(
+                        {"key": k, "value": v, "ops": [writer[kv], op.index]}
+                    )
+                else:
+                    writer[kv] = op.index
+                if k in last:
+                    intermediate.add(last[k])
+                last[k] = kv
+
+    for op in oks:
+        index_writes(op, True, False)
+    for op in infos:
+        index_writes(op, True, False)
+    for op in fails:
+        index_writes(op, False, True)
+
+    # Per-key successor constraints v << v' (v may be None = initial).
+    succ: dict[Any, dict[Any, set]] = defaultdict(lambda: defaultdict(set))
+    for op in oks:
+        last_seen: dict = {}  # k -> last value this txn read or wrote
+        for f, k, v in op.value or []:
+            if f == "w":
+                if k in last_seen and last_seen[k] != v:
+                    succ[k][last_seen[k]].add(v)
+                last_seen[k] = v
+            elif f == "r":
+                last_seen.setdefault(k, v)
+
+    g = DepGraph()
+    for op in oks:
+        g.add_vertex(op.index)
+
+    # External reads -> wr edges and read anomalies.
+    ext_reader: dict[tuple, list[int]] = defaultdict(list)
+    for op in oks:
+        written: set = set()
+        for f, k, v in op.value or []:
+            if f == "w":
+                written.add(k)
+            elif f == "r" and k not in written:
+                kv = (k, v)
+                ext_reader[kv].append(op.index)
+                if v is None:
+                    continue
+                if kv in failed_writes:
+                    anomalies["G1a"].append(
+                        {"op": op.index, "key": k, "value": v}
+                    )
+                # Intermediate reads are anomalous only across txns; a
+                # txn may see its own in-progress writes.  (External
+                # reads can't see own writes by construction, but keep
+                # the guard parallel to append.py.)
+                if kv in intermediate and writer.get(kv) != op.index:
+                    anomalies["G1b"].append(
+                        {"op": op.index, "key": k, "value": v}
+                    )
+                w = writer.get(kv)
+                if w is None:
+                    anomalies["unwritten-read"].append(
+                        {"op": op.index, "key": k, "value": v}
+                    )
+                elif w != op.index:
+                    g.add_edge(w, op.index, "wr")
+
+    # ww and rw edges along inferred successor pairs.
+    for k, pairs in succ.items():
+        for v, nexts in pairs.items():
+            wv = writer.get((k, v)) if v is not None else None
+            for v2 in nexts:
+                wv2 = writer.get((k, v2))
+                if wv2 is None:
+                    continue
+                if wv is not None and wv != wv2:
+                    g.add_edge(wv, wv2, "ww")
+                for rd in ext_reader.get((k, v), []):
+                    if rd != wv2:
+                        g.add_edge(rd, wv2, "rw")
+
+    cycles = check_cycles(g)
+    for c in cycles:
+        anomalies[c["type"]].append(c)
+
+    forbidden = set(FORBIDDEN.get(consistency_model, FORBIDDEN["serializable"]))
+    forbidden |= {"duplicate-writes"}
+    if consistency_model != "read-uncommitted":
+        forbidden |= DIRTY | {"unwritten-read"}
+    found = {t for t in anomalies if anomalies[t]}
+    bad = found & forbidden
+    valid: Any = True
+    if bad:
+        valid = False
+    elif found:
+        valid = "unknown"
+    return {
+        "valid": valid,
+        "anomaly-types": sorted(found),
+        "anomalies": {t: v for t, v in anomalies.items() if v},
+        "edges": g.n_edges(),
+    }
+
+
+class WrGen:
+    """Random read/write-register transactions with globally unique
+    writes per key (elle.rw-register/gen)."""
+
+    def __init__(
+        self,
+        *,
+        key_count: int = 10,
+        min_txn_length: int = 1,
+        max_txn_length: int = 4,
+        rng: Optional[random.Random] = None,
+    ):
+        self.key_count = key_count
+        self.min_len = min_txn_length
+        self.max_len = max_txn_length
+        self.rng = rng or random.Random()
+        self.next_value: dict[int, int] = defaultdict(int)
+
+    def __call__(self) -> dict:
+        n = self.rng.randint(self.min_len, self.max_len)
+        txn = []
+        for _ in range(n):
+            k = self.rng.randrange(self.key_count)
+            if self.rng.random() < 0.5:
+                txn.append(["r", k, None])
+            else:
+                v = self.next_value[k]
+                self.next_value[k] = v + 1
+                txn.append(["w", k, v])
+        return {"f": "txn", "value": txn}

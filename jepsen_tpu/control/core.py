@@ -195,7 +195,9 @@ def wrap_sudo(action: dict) -> dict:
 
 
 def wrap_action(action: dict) -> dict:
-    return wrap_sudo(wrap_env(wrap_cd(action)))
+    # env innermost (prefixes the command), then cd, then sudo — cd
+    # outside env, or `env K=V cd d; cmd` drops both the cwd and vars.
+    return wrap_sudo(wrap_cd(wrap_env(action)))
 
 
 def throw_on_nonzero_exit(action: dict) -> dict:

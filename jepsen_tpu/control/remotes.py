@@ -162,11 +162,19 @@ class SshCliRemote(Remote):
             )
         except subprocess.TimeoutExpired as e:
             raise RemoteError(f"ssh timed out: {action['cmd']!r}") from e
-        if proc.returncode == 255:  # ssh's own failure code
-            raise RemoteError(
-                f"ssh to {self.spec.host} failed: "
-                f"{proc.stderr.decode(errors='replace')}"
-            )
+        err_text = proc.stderr.decode(errors="replace")
+        # ssh reports its own failures as 255, but so could the remote
+        # command; only treat it as a transport error (retryable!) when
+        # stderr looks like ssh's, so non-idempotent commands aren't
+        # blindly re-run.
+        if proc.returncode == 255 and (
+            "ssh:" in err_text
+            or "Connection" in err_text
+            or "Permission denied" in err_text
+            or "Host key" in err_text
+            or "not resolve" in err_text
+        ):
+            raise RemoteError(f"ssh to {self.spec.host} failed: {err_text}")
         out = dict(action)
         out.update(
             {

@@ -137,13 +137,20 @@ def run(test: dict) -> dict:
             st.save_0(test)
             hw = st.history_writer()
             with with_sessions(test):
-                oses.setup(test)
-                jdb.cycle(test)
                 try:
+                    oses.setup(test)
+                    jdb.cycle(test)
                     history = run_case(test, history_writer=hw.append)
                     test["history"] = history
                     st.save_1(test, history)
                 finally:
+                    # Whatever happened — OS/DB setup crash, client bug
+                    # mid-run — seal any partial history so the file
+                    # stays readable for `analyze`.
+                    try:
+                        hw.close()
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("history seal failed: %r", e)
                     # Snarf logs even when the run throws — failing runs
                     # are exactly the ones whose logs matter
                     # (core.clj:142-158 with-log-snarfing).
@@ -177,7 +184,14 @@ def rerun_analysis(test_dir: str, test: dict) -> dict:
     tf = store.load(test_dir)
     try:
         stored = tf.test or {}
-        merged = {**stored, **test}
+        # The stored map is the record of the run; the caller's map only
+        # contributes live objects (checker/model/client...) and keys the
+        # stored run never had — CLI defaults must not clobber the
+        # recorded nodes/concurrency/etc.
+        merged = {**test, **stored}
+        for k in store.NONSERIALIZABLE_KEYS:
+            if k in test:
+                merged[k] = test[k]
         history = tf.history()
         # Artifacts go next to the file actually being analyzed, not a
         # path recomputed from CLI options.

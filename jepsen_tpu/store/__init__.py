@@ -194,10 +194,21 @@ def tests(root: str = "store") -> dict[str, dict[str, str]]:
 
 
 def latest(root: str = "store") -> Optional[str]:
+    """The most recent run dir: the `current` symlink when it resolves,
+    else the newest run found by scanning (symlink-less filesystems,
+    deleted runs)."""
     link = os.path.join(root, "current")
     if os.path.islink(link):
-        return os.path.realpath(link)
-    return None
+        target = os.path.realpath(link)
+        if os.path.isdir(target):
+            return target
+    newest: Optional[str] = None
+    newest_time = ""
+    for runs in tests(root).values():
+        for t, d in runs.items():
+            if t > newest_time:
+                newest_time, newest = t, d
+    return newest
 
 
 def delete(root: str = "store", name: Optional[str] = None) -> None:

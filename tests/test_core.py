@@ -226,3 +226,56 @@ def test_web_index_and_files(tmp_path):
     finally:
         srv.shutdown()
         srv.server_close()
+
+
+def test_crashed_run_leaves_readable_file(tmp_path):
+    """A client bug mid-run must still leave test map + partial history
+    readable for `analyze`."""
+
+    class Bomb(jc.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            return op.complete(OK)
+
+        def setup(self, test):
+            raise RuntimeError("setup exploded")
+
+    t = register_test(tmp_path, client=Bomb())
+    with pytest.raises(RuntimeError):
+        core.run(t)
+    d = store.latest(str(tmp_path / "store"))
+    tf = store.load(d)
+    assert tf.test is not None and tf.test["name"] == "register-smoke"
+    tf.close()
+
+
+def test_rerun_analysis_keeps_stored_shape(tmp_path):
+    """CLI defaults must not clobber the recorded nodes/concurrency."""
+    t = register_test(tmp_path)
+    t["nodes"] = ["a", "b", "c", "d", "e", "f", "g"]
+    out = core.run(t)
+    d = store.test_dir(out)
+    caller = register_test(tmp_path)  # default 3 nodes
+    merged = core.rerun_analysis(d, caller)
+    assert len(merged["nodes"]) == 7
+    assert merged["concurrency"] == 14  # recorded parsed value, "2n" x 7
+
+
+def test_latest_falls_back_to_scan(tmp_path):
+    root = str(tmp_path / "store")
+    out = core.run(register_test(tmp_path))
+    cur = os.path.join(root, "current")
+    if os.path.islink(cur):
+        os.unlink(cur)
+    assert store.latest(root) == store.test_dir(out)
+
+
+def test_wrap_action_env_inside_cd():
+    from jepsen_tpu.control import LocalRemote, ConnSpec, Session
+
+    sess = Session("x", LocalRemote().connect(ConnSpec("x")))
+    with sess.cd("/tmp"):
+        out = sess.exec("bash", "-c", "echo $FOO $(pwd)", env={"FOO": "bar"})
+    assert out == "bar /tmp"

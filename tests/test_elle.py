@@ -1,0 +1,369 @@
+"""Elle-equivalent tests: literal-history anomaly cases for list-append
+and rw-register, SCC/cycle machinery, and graph classification —
+the checker_test.clj strategy applied to the elle surface."""
+
+import pytest
+
+from jepsen_tpu.checker.elle import (
+    analyze_append,
+    analyze_wr,
+    check_cycles,
+    DepGraph,
+)
+from jepsen_tpu.history import history, Op
+from jepsen_tpu import txn as jtxn
+
+
+def t(index, typ, value, process=0):
+    return Op(type=typ, f="txn", value=value, process=process, index=index, time=index)
+
+
+def h(*ops):
+    return history(list(ops), reindex=False)
+
+
+# -- txn helpers ---------------------------------------------------------
+
+
+def test_ext_reads_writes():
+    txn = [["r", "x", 1], ["w", "x", 2], ["r", "x", 2], ["r", "y", 9]]
+    assert jtxn.ext_reads(txn) == {"x": 1, "y": 9}
+    assert jtxn.ext_writes(txn) == {"x": 2}
+
+
+# -- graph machinery -----------------------------------------------------
+
+
+def test_scc_and_cycle():
+    g = DepGraph()
+    g.add_edge(1, 2, "ww")
+    g.add_edge(2, 3, "ww")
+    g.add_edge(3, 1, "ww")
+    g.add_edge(3, 4, "ww")  # 4 not in the cycle
+    sccs = g.sccs()
+    assert len(sccs) == 1 and set(sccs[0]) == {1, 2, 3}
+    cycles = check_cycles(g)
+    assert len(cycles) == 1
+    assert cycles[0]["type"] == "G0"
+    assert set(cycles[0]["cycle"][:-1]) == {1, 2, 3}
+
+
+def test_cycle_classification():
+    g = DepGraph()
+    g.add_edge(1, 2, "wr")
+    g.add_edge(2, 1, "ww")
+    assert check_cycles(g)[0]["type"] == "G1c"
+
+    g2 = DepGraph()
+    g2.add_edge(1, 2, "rw")
+    g2.add_edge(2, 1, "ww")
+    assert check_cycles(g2)[0]["type"] == "G-single"
+
+    g3 = DepGraph()
+    g3.add_edge(1, 2, "rw")
+    g3.add_edge(2, 1, "rw")
+    assert check_cycles(g3)[0]["type"] == "G2-item"
+
+
+# -- list-append ---------------------------------------------------------
+
+
+def test_append_valid_history():
+    res = analyze_append(h(
+        t(0, "ok", [["append", "x", 0]]),
+        t(1, "ok", [["append", "x", 1]]),
+        t(2, "ok", [["r", "x", [0, 1]]]),
+    ))
+    assert res["valid"] is True
+
+
+def test_append_g1a_aborted_read():
+    res = analyze_append(h(
+        t(0, "fail", [["append", "x", 0]]),
+        t(1, "ok", [["r", "x", [0]]]),
+    ))
+    assert res["valid"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_append_g1b_intermediate_read():
+    # txn 0 appends 0 then 1 to x; a read ending at the intermediate 0
+    # observes an intermediate state.
+    res = analyze_append(h(
+        t(0, "ok", [["append", "x", 0], ["append", "x", 1]]),
+        t(1, "ok", [["r", "x", [0]]]),
+    ))
+    assert res["valid"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_append_incompatible_order():
+    res = analyze_append(h(
+        t(0, "ok", [["r", "x", [0, 1]]]),
+        t(1, "ok", [["r", "x", [1, 0]]]),
+    ))
+    assert res["valid"] is False
+    assert "incompatible-order" in res["anomaly-types"]
+
+
+def test_append_g0_write_cycle():
+    # x order: a=0 then b=1; y order: b=0 then a=1 -> ww cycle a <-> b.
+    res = analyze_append(h(
+        t(0, "ok", [["append", "x", 0], ["append", "y", 1]]),   # a
+        t(1, "ok", [["append", "y", 0], ["append", "x", 1]]),   # b
+        t(2, "ok", [["r", "x", [0, 1]], ["r", "y", [0, 1]]]),
+    ))
+    assert res["valid"] is False
+    assert "G0" in res["anomaly-types"]
+
+
+def test_append_g1c_wr_cycle():
+    # a appends x0, reads y seeing b's append; b appends y0, reads x
+    # seeing a's append: wr in both directions.
+    res = analyze_append(h(
+        t(0, "ok", [["append", "x", 0], ["r", "y", [0]]]),
+        t(1, "ok", [["append", "y", 0], ["r", "x", [0]]]),
+    ))
+    assert res["valid"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_append_g_single_rw():
+    # Classic read-skew G-single: a misses b's append to x (rw a->b)
+    # while reading b's append to y (wr b->a).
+    res = analyze_append(h(
+        t(0, "ok", [["r", "x", []], ["r", "y", [0]]]),
+        t(1, "ok", [["append", "x", 0], ["append", "y", 0]]),
+        t(2, "ok", [["r", "x", [0]]]),
+    ))
+    assert res["valid"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_append_internal_anomaly():
+    res = analyze_append(h(
+        t(0, "ok", [["append", "x", 5], ["r", "x", [1, 2]]]),
+    ))
+    assert res["valid"] is False
+    assert "internal" in res["anomaly-types"]
+
+
+def test_append_info_writes_tolerated():
+    # Indeterminate appends may or may not appear; seeing one is fine.
+    res = analyze_append(h(
+        t(0, "info", [["append", "x", 0]]),
+        t(1, "ok", [["r", "x", [0]]]),
+    ))
+    assert res["valid"] is True
+
+
+# -- rw-register ---------------------------------------------------------
+
+
+def test_wr_valid():
+    res = analyze_wr(h(
+        t(0, "ok", [["w", "x", 1]]),
+        t(1, "ok", [["r", "x", 1]]),
+    ))
+    assert res["valid"] is True
+
+
+def test_wr_g1a():
+    res = analyze_wr(h(
+        t(0, "fail", [["w", "x", 1]]),
+        t(1, "ok", [["r", "x", 1]]),
+    ))
+    assert res["valid"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_wr_g1b_intermediate():
+    res = analyze_wr(h(
+        t(0, "ok", [["w", "x", 1], ["w", "x", 2]]),
+        t(1, "ok", [["r", "x", 1]]),
+    ))
+    assert res["valid"] is False
+    assert "G1b" in res["anomaly-types"]
+
+
+def test_wr_unwritten_read():
+    res = analyze_wr(h(
+        t(0, "ok", [["r", "x", 99]]),
+    ))
+    assert res["valid"] is False
+    assert "unwritten-read" in res["anomaly-types"]
+
+
+def test_wr_g1c_cycle():
+    # a writes x=1 and reads y=1 (written by b); b writes y=1, reads x=1.
+    res = analyze_wr(h(
+        t(0, "ok", [["w", "x", 1], ["r", "y", 1]]),
+        t(1, "ok", [["w", "y", 1], ["r", "x", 1]]),
+    ))
+    assert res["valid"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+def test_wr_ww_cycle_from_intra_txn_order():
+    # txn a: reads x=1 writes x=2 ... wait, need two txns whose inferred
+    # ww orders conflict across two keys.
+    # a: r x=1, w x=2 ; also w y=1 after r y=2  -> y: 2 << 1
+    # b: r y=1, w y=2 ; also ... simpler: use reads to chain.
+    res = analyze_wr(h(
+        t(0, "ok", [["w", "x", 1], ["w", "y", 1]]),
+        t(1, "ok", [["r", "x", 1], ["w", "x", 2], ["r", "y", 2], ["w", "y", 3]]),
+        t(2, "ok", [["r", "y", 1], ["w", "y", 2], ["r", "x", 2], ["w", "x", 3]]),
+    ))
+    # txn1: x 1<<2, y 2<<3; txn2: y 1<<2, x 2<<3
+    # ww: t0->t1 (x), t1->t2 (x 2<<3 means t1 wrote 2, t2 wrote 3)...
+    # and y: t2 wrote 2, t1 wrote 3 -> t2->t1. Cycle t1 <-> t2.
+    assert res["valid"] is False
+    types = set(res["anomaly-types"])
+    assert types & {"G0", "G1c", "G2-item", "G-single"}
+
+
+# -- whole-stack workload runs ------------------------------------------
+
+
+def run_workload(wl, time_s=0.4, concurrency=6):
+    from jepsen_tpu import interpreter
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import nemesis as nem
+
+    test = {
+        "concurrency": concurrency,
+        "nodes": ["n1"],
+        "client": wl["client"],
+        "nemesis": nem.noop,
+        "generator": gen.time_limit(
+            time_s, gen.clients(gen.stagger(0.002, wl["generator"]))
+        ),
+    }
+    h2 = interpreter.run(test)
+    res = wl["checker"].check(test, h2, {})
+    return h2, res
+
+
+def test_append_workload_end_to_end():
+    from jepsen_tpu.workloads import append as wa
+
+    wl = wa.workload({"seed": 7})
+    hist, res = run_workload(wl)
+    assert len(hist) > 10
+    assert res["valid"] is True
+
+
+def test_wr_workload_end_to_end():
+    from jepsen_tpu.workloads import wr as ww
+
+    wl = ww.workload({"seed": 7})
+    hist, res = run_workload(wl)
+    assert res["valid"] in (True, "unknown")
+
+
+def test_bank_workload_end_to_end():
+    from jepsen_tpu.workloads import bank as wb
+
+    wl = wb.workload({"seed": 7})
+    hist, res = run_workload(wl)
+    assert res["valid"] is True
+    assert res["read-count"] > 0
+
+
+def test_bank_checker_catches_bad_total():
+    from jepsen_tpu.workloads.bank import BankChecker
+
+    bad = history(
+        [
+            Op(type="invoke", f="read", value=None, process=0, index=0, time=0),
+            Op(
+                type="ok", f="read",
+                value={a: (100 if a == 0 else 1) for a in range(8)},
+                process=0, index=1, time=1,
+            ),
+        ],
+        reindex=False,
+    )
+    res = BankChecker().check({}, bad, {})
+    assert res["valid"] is False
+    assert "wrong-total 107" in str(res["bad-reads"])
+
+
+def test_long_fork_checker():
+    from jepsen_tpu.workloads.long_fork import LongForkChecker
+
+    fork = history(
+        [
+            Op(type="ok", f="txn", value=[["r", 0, 1], ["r", 1, None]],
+               process=0, index=0, time=0),
+            Op(type="ok", f="txn", value=[["r", 0, None], ["r", 1, 1]],
+               process=1, index=1, time=1),
+        ],
+        reindex=False,
+    )
+    res = LongForkChecker().check({}, fork, {})
+    assert res["valid"] is False and res["fork-count"] == 1
+
+    ok = history(
+        [
+            Op(type="ok", f="txn", value=[["r", 0, 1], ["r", 1, None]],
+               process=0, index=0, time=0),
+            Op(type="ok", f="txn", value=[["r", 0, 1], ["r", 1, 1]],
+               process=1, index=1, time=1),
+        ],
+        reindex=False,
+    )
+    assert LongForkChecker().check({}, ok, {})["valid"] is True
+
+
+def test_long_fork_workload_end_to_end():
+    from jepsen_tpu.workloads import long_fork as lf
+
+    wl = lf.workload({"seed": 3})
+    hist, res = run_workload(wl)
+    assert res["valid"] is True
+
+
+def test_set_workload_end_to_end():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import interpreter
+    from jepsen_tpu import nemesis as nem
+    from jepsen_tpu.workloads import register_set as rs
+
+    wl = rs.workload()
+    test = {
+        "concurrency": 4,
+        "nodes": ["n1"],
+        "client": wl["client"],
+        "nemesis": nem.noop,
+        "generator": gen.phases(
+            gen.time_limit(0.2, gen.clients(wl["generator"])),
+            gen.clients(wl["final-generator"]),
+        ),
+    }
+    h2 = interpreter.run(test)
+    res = wl["checker"].check(test, h2, {})
+    assert res["valid"] is True
+    assert res["ok-count"] > 0
+
+
+def test_linearizable_register_workload_end_to_end():
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import interpreter
+    from jepsen_tpu import nemesis as nem
+    from jepsen_tpu.workloads import linearizable_register as lr
+
+    wl = lr.workload({"seed": 5, "key-count": 4, "per-key-limit": 24,
+                      "algorithm": "cpu"})
+    test = {
+        "concurrency": 8,
+        "nodes": ["n1"],
+        "client": wl["client"],
+        "nemesis": nem.noop,
+        "generator": gen.time_limit(2.0, gen.clients(wl["generator"])),
+        "model": wl["model"],
+    }
+    h2 = interpreter.run(test)
+    res = wl["checker"].check(test, h2, {})
+    assert res["valid"] is True
+    assert res.get("key-count", res.get("count", 1)) >= 1
