@@ -63,11 +63,12 @@ def analyze(
     anomalies: dict[str, list] = defaultdict(list)
 
     # -- index writes ---------------------------------------------------
-    # writer[(k, v)] = op index that appended v to k.
+    # writer[(k, v)] = op index that appended v to k (committed and
+    # indeterminate appends both count: an info append may well have
+    # taken effect).
     writer: dict[tuple, int] = {}
-    # Appends whose fate is known-failed / indeterminate.
+    # Appends from known-failed txns.
     failed_appends: set[tuple] = set()
-    info_appends: set[tuple] = set()
     # (k, v) -> True when v is NOT the final append to k in its txn.
     intermediate: set[tuple] = set()
 
@@ -93,7 +94,7 @@ def analyze(
     for op in oks:
         note_appends(op, target=writer)
     for op in infos:
-        note_appends(op, target=writer, fate=info_appends)
+        note_appends(op, target=writer)
     for op in fails:
         note_appends(op, fate=failed_appends)
 
@@ -216,7 +217,9 @@ def analyze(
     forbidden |= {"incompatible-order", "duplicate-elements",
                   "duplicate-appends", "internal"}
     if consistency_model != "read-uncommitted":
-        forbidden |= DIRTY
+        # Reads of elements nobody wrote are data corruption, same as
+        # wr.py's unwritten-read.
+        forbidden |= DIRTY | {"unobserved-writer"}
     found = {t for t in anomalies if anomalies[t]}
     bad = found & forbidden
     valid: Any = True
@@ -249,19 +252,25 @@ def _add_realtime_edges(history: History, g: DepGraph) -> None:
             if inv is not None:
                 pairs.append((inv.index, o.index, o.index))
     pairs.sort()
-    # Sweep in invocation order; `done` holds (comp, inv, op) of
-    # completed txns sorted by comp, with a running prefix-max of inv.
-    done: list[tuple[int, int, int]] = []  # sorted by comp
+    # Sweep in invocation order.  `done` holds (comp, inv, op) of
+    # completed txns sorted by comp.  Since inv(B) is nondecreasing, S
+    # only grows, so any entry with comp < M (the running max-inv over
+    # everything that has entered S) is covered transitively for every
+    # future B too — prune it once, keeping the sweep near-linear.
     import bisect
 
+    done: list[tuple[int, int, int]] = []  # sorted by comp
+    m = -1  # running max inv over pruned-or-current S
     for inv_idx, comp_idx, op_idx in pairs:
-        # All entries with comp < inv_idx are realtime predecessors.
         cut = bisect.bisect_left(done, (inv_idx, -1, -1))
         if cut:
-            m = max(e[1] for e in done[:cut])
-            for comp, inv2, pred in done[:cut]:
-                if comp >= m and pred != op_idx:
+            m = max(m, max(e[1] for e in done[:cut]))
+            survivors = [e for e in done[:cut] if e[0] >= m]
+            for comp, inv2, pred in survivors:
+                if pred != op_idx:
                     g.add_edge(pred, op_idx, "realtime")
+            # Entries below the max-inv bar are done forever.
+            done = survivors + done[cut:]
         bisect.insort(done, (comp_idx, inv_idx, op_idx))
 
 

@@ -186,20 +186,88 @@ def cycle_explanation(graph: DepGraph, cycle: list[int]) -> list[dict]:
     ]
 
 
+def _cycle_record(graph: DepGraph, cycle: list[int], comp: Iterable[int],
+                  forced_type: Optional[str] = None) -> dict:
+    return {
+        "type": forced_type or classify_cycle(graph, cycle),
+        "cycle": cycle,
+        "steps": cycle_explanation(graph, cycle),
+        "scc-size": len(list(comp)),
+    }
+
+
+def find_cycle_with_edge(
+    graph: DepGraph, src: int, dst: int, component: Iterable[int]
+) -> Optional[list[int]]:
+    """A cycle through the specific edge src->dst: shortest path
+    dst ~> src inside the component, closed with the edge."""
+    comp = set(component)
+    if dst == src:
+        return None
+    parent: dict[int, int] = {}
+    q = deque([dst])
+    seen = {dst}
+    while q:
+        v = q.popleft()
+        for w in graph.out_edges(v):
+            if w not in comp or w in seen:
+                continue
+            parent[w] = v
+            if w == src:
+                path = [src]
+                while path[-1] != dst:
+                    path.append(parent[path[-1]])
+                path.reverse()  # dst ... src
+                return [src] + path  # src -> dst -> ... -> src
+            seen.add(w)
+            q.append(w)
+    return None
+
+
 def check_cycles(graph: DepGraph) -> list[dict]:
-    """All anomaly cycles: one shortest representative per nontrivial
-    SCC, classified.  Mirrors elle's cycle-search driver."""
+    """Anomaly cycles found the way elle finds them: layered searches
+    over restricted subgraphs, so a strong-anomaly cycle can't mask a
+    weaker one (G0 is searched in the ww-only subgraph, G1c in ww+wr,
+    G-single/G2-item in the full graph through an rw edge).  One
+    representative cycle per SCC per layer."""
     out = []
+
+    # Layer 1: G0 — pure write cycles.
+    g0 = graph.restricted(["ww", "realtime", "process"])
+    for comp in g0.sccs():
+        cycle = g0.find_cycle_in(comp)
+        if cycle is not None:
+            out.append(_cycle_record(g0, cycle, comp, "G0"))
+
+    # Layer 2: G1c — cycles of ww+wr containing at least one wr.
+    g1 = graph.restricted(["ww", "wr", "realtime", "process"])
+    for comp in g1.sccs():
+        comp_set = set(comp)
+        found = None
+        for src in comp_set:
+            for dst, types in g1.adj.get(src, {}).items():
+                if dst in comp_set and "wr" in types:
+                    found = find_cycle_with_edge(g1, src, dst, comp_set)
+                    if found is not None:
+                        break
+            if found is not None:
+                break
+        if found is not None:
+            out.append(_cycle_record(g1, found, comp, "G1c"))
+
+    # Layer 3: G-single / G2-item — cycles through an rw edge in the
+    # full graph.
     for comp in graph.sccs():
-        cycle = graph.find_cycle_in(comp)
-        if cycle is None:
-            continue
-        out.append(
-            {
-                "type": classify_cycle(graph, cycle),
-                "cycle": cycle,
-                "steps": cycle_explanation(graph, cycle),
-                "scc-size": len(comp),
-            }
-        )
+        comp_set = set(comp)
+        found = None
+        for src in comp_set:
+            for dst, types in graph.adj.get(src, {}).items():
+                if dst in comp_set and "rw" in types:
+                    found = find_cycle_with_edge(graph, src, dst, comp_set)
+                    if found is not None:
+                        break
+            if found is not None:
+                break
+        if found is not None:
+            out.append(_cycle_record(graph, found, comp))
     return out

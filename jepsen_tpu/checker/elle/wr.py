@@ -45,7 +45,7 @@ def analyze(history: History, *, consistency_model: str = "serializable") -> dic
     failed_writes: set = set()
     intermediate: set = set()
 
-    def index_writes(op: Op, known: bool, failed: bool) -> None:
+    def index_writes(op: Op, failed: bool = False) -> None:
         last: dict = {}
         for f, k, v in op.value or []:
             if f == "w":
@@ -63,11 +63,11 @@ def analyze(history: History, *, consistency_model: str = "serializable") -> dic
                 last[k] = kv
 
     for op in oks:
-        index_writes(op, True, False)
+        index_writes(op)
     for op in infos:
-        index_writes(op, True, False)
+        index_writes(op)
     for op in fails:
-        index_writes(op, False, True)
+        index_writes(op, failed=True)
 
     # Per-key successor constraints v << v' (v may be None = initial).
     succ: dict[Any, dict[Any, set]] = defaultdict(lambda: defaultdict(set))

@@ -151,8 +151,18 @@ class SshCliRemote(Remote):
         r.spec = spec
         return r
 
+    #: Marker separating the remote command's real exit status from
+    #: ssh's own: the wrapped remote shell always exits 0, so any
+    #: nonzero ssh status (or a missing marker) IS a transport failure —
+    #: no stderr guessing, and non-idempotent commands are never
+    #: re-run by the retry wrapper for their own failures.
+    STATUS_MARKER = "\x01JTPU_STATUS:"
+
     def execute(self, action: dict) -> dict:
-        cmd = ["ssh", *self._ssh_opts(), self.spec.host, action["cmd"]]
+        wrapped = (
+            f"{action['cmd']}\nprintf '{self.STATUS_MARKER}%d' \"$?\""
+        )
+        cmd = ["ssh", *self._ssh_opts(), self.spec.host, wrapped]
         try:
             proc = subprocess.run(
                 cmd,
@@ -162,26 +172,21 @@ class SshCliRemote(Remote):
             )
         except subprocess.TimeoutExpired as e:
             raise RemoteError(f"ssh timed out: {action['cmd']!r}") from e
-        err_text = proc.stderr.decode(errors="replace")
-        # ssh reports its own failures as 255, but so could the remote
-        # command; only treat it as a transport error (retryable!) when
-        # stderr looks like ssh's, so non-idempotent commands aren't
-        # blindly re-run.
-        if proc.returncode == 255 and (
-            "ssh:" in err_text
-            or "Connection" in err_text
-            or "Permission denied" in err_text
-            or "Host key" in err_text
-            or "not resolve" in err_text
-        ):
-            raise RemoteError(f"ssh to {self.spec.host} failed: {err_text}")
+        stdout = proc.stdout.decode(errors="replace")
+        marker_at = stdout.rfind(self.STATUS_MARKER)
+        if proc.returncode != 0 or marker_at < 0:
+            raise RemoteError(
+                f"ssh to {self.spec.host} failed (status {proc.returncode}): "
+                f"{proc.stderr.decode(errors='replace')}"
+            )
+        status = int(stdout[marker_at + len(self.STATUS_MARKER):] or -1)
         out = dict(action)
         out.update(
             {
                 "host": self.spec.host,
-                "out": proc.stdout.decode(errors="replace"),
+                "out": stdout[:marker_at],
                 "err": proc.stderr.decode(errors="replace"),
-                "exit": proc.returncode,
+                "exit": status,
             }
         )
         return out

@@ -45,7 +45,10 @@ def parse_concurrency(spec: Any, n_nodes: int) -> int:
 
 def prepare_test(test: dict) -> dict:
     """Fills defaults: start-time, parsed concurrency, noop nemesis
-    (core.clj:302-320)."""
+    (core.clj:302-320).  A workload-supplied "final-generator" (e.g. a
+    set workload's final read) is phased onto client threads after the
+    main generator — reference suites wire this by hand with
+    gen/phases; here the test map carries it."""
     test = dict(test)
     test.setdefault("name", "noname")
     test.setdefault("nodes", ["n1", "n2", "n3", "n4", "n5"])
@@ -53,6 +56,13 @@ def prepare_test(test: dict) -> dict:
         test.get("concurrency", "1n"), len(test["nodes"])
     )
     test.setdefault("nemesis", noop_nemesis)
+    fg = test.pop("final-generator", None)
+    if fg is not None and test.get("generator") is not None:
+        from .generator import clients as gen_clients, phases as gen_phases
+
+        test["generator"] = gen_phases(
+            test["generator"], gen_clients(fg)
+        )
     return test
 
 

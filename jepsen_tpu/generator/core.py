@@ -1021,8 +1021,10 @@ def delay(dt_secs: float, gen: Any) -> Delay:
 
 
 def sleep(dt_secs: float) -> dict:
-    """An op making its process do nothing for dt seconds
-    (generator.clj:1428-1432)."""
+    """Exactly one special op making its receiving process do nothing
+    for dt seconds; the worker sleeps and the op is excluded from the
+    journal (generator.clj:1428-1432, interpreter.clj:129-131,
+    :176-181).  Use repeat(sleep(10)) to sleep repeatedly."""
     return {"type": "sleep", "value": dt_secs}
 
 

@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time as time_mod
 from typing import Any, Callable, Optional
 
 from . import client as jepsen_client
@@ -49,6 +50,12 @@ MAX_PENDING_INTERVAL = 0.001
 
 #: Poison pill telling a worker to exit.
 _EXIT = object()
+
+
+def _journal(op: Op) -> bool:
+    """Should this op be recorded?  :sleep and :log are scheduling
+    artifacts, not history events (interpreter.clj:176-181)."""
+    return op.type not in ("sleep", "log")
 
 
 class Worker:
@@ -82,7 +89,16 @@ class Worker:
                 self._cleanup()
                 return
             try:
-                completion = self.transact(op)
+                # Special op types the worker handles itself
+                # (interpreter.clj:126-136).
+                if op.type == "sleep":
+                    time_mod.sleep(op.value or 0)
+                    completion = op
+                elif op.type == "log":
+                    log.info("%s", op.value)
+                    completion = op
+                else:
+                    completion = self.transact(op)
             except Exception as e:  # noqa: BLE001 — worker must not die
                 log.debug("worker %s: %s crashed: %r", self.id, op.f, e)
                 completion = op.complete(
@@ -229,15 +245,20 @@ def run(
                 if completion is not None:
                     now = relative_time_nanos()
                     thread = ctx.process_to_thread(completion.process)
-                    completion = completion.replace(index=op_index, time=now)
-                    op_index += 1
+                    journal = _journal(completion)
+                    if journal:
+                        completion = completion.replace(
+                            index=op_index, time=now
+                        )
+                        op_index += 1
                     ctx = ctx.free_thread(now, thread)
                     gen = gen_update(gen, test, ctx, completion)
                     # A crashed client process is gone forever; rotate in a
                     # fresh process id (interpreter.clj:245-249).
                     if completion.is_info and thread != NEMESIS:
                         ctx = ctx.with_next_process(thread)
-                    record(completion)
+                    if journal:
+                        record(completion)
                     outstanding -= 1
                     poll_timeout = 0.0
                     continue
@@ -267,13 +288,18 @@ def run(
                     )
                     continue
 
-                # Due: record the invocation and dispatch it.
-                op = op.replace(index=op_index, time=now)
-                op_index += 1
+                # Due: journal the invocation (sleep/log ops occupy their
+                # worker but stay out of the history,
+                # interpreter.clj:176-181) and dispatch it.
+                if _journal(op):
+                    op = op.replace(index=op_index, time=now)
+                    op_index += 1
+                    record(op)
+                else:
+                    op = op.replace(time=now)
                 gen = gen_update(gen2, test, ctx, op)
                 thread = ctx.process_to_thread(op.process)
                 ctx = ctx.busy_thread(now, thread)
-                record(op)
                 workers[thread].submit(op)
                 outstanding += 1
                 poll_timeout = 0.0

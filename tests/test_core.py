@@ -279,3 +279,21 @@ def test_wrap_action_env_inside_cd():
     with sess.cd("/tmp"):
         out = sess.exec("bash", "-c", "echo $FOO $(pwd)", env={"FOO": "bar"})
     assert out == "bar /tmp"
+
+
+def test_final_generator_phased_in(tmp_path):
+    """A workload final-generator runs on clients after the main
+    generator (prepare_test wiring)."""
+    from jepsen_tpu.workloads import register_set as rs
+
+    wl = rs.workload()
+    t = register_test(
+        tmp_path,
+        client=wl["client"],
+        checker=wl["checker"],
+        generator=gen.time_limit(0.2, gen.clients(wl["generator"])),
+        **{"final-generator": wl["final-generator"]},
+    )
+    out = core.run(t)
+    assert out["results"]["valid"] is True
+    assert out["results"]["ok-count"] > 0  # the final read happened

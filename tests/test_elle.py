@@ -367,3 +367,22 @@ def test_linearizable_register_workload_end_to_end():
     res = wl["checker"].check(test, h2, {})
     assert res["valid"] is True
     assert res.get("key-count", res.get("count", 1)) >= 1
+
+
+def test_layered_cycle_search_no_masking():
+    """A G1c ww+wr cycle must be reported even when the same SCC also
+    contains a shorter rw cycle (restricted-subgraph layering)."""
+    g = DepGraph()
+    g.add_edge(1, 2, "wr")
+    g.add_edge(2, 1, "ww")
+    g.add_edge(1, 3, "rw")
+    g.add_edge(3, 1, "ww")
+    types = {c["type"] for c in check_cycles(g)}
+    assert "G1c" in types
+    assert types & {"G-single", "G2-item"}
+
+
+def test_append_unobserved_writer_invalid():
+    res = analyze_append(h(t(0, "ok", [["r", "x", [99]]])))
+    assert res["valid"] is False
+    assert "unobserved-writer" in res["anomaly-types"]

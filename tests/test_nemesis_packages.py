@@ -1,0 +1,257 @@
+"""Fault packages, control-plane faults (over dummy remotes), and the
+perf/timeline/clock artifact checkers."""
+
+import os
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jc
+from jepsen_tpu import generator as gen
+from jepsen_tpu import interpreter
+from jepsen_tpu import net as jnet
+from jepsen_tpu.control import DummyRemote, with_sessions
+from jepsen_tpu.history import NEMESIS, OK, History, Op
+from jepsen_tpu.nemesis import combined, faults
+from jepsen_tpu import db as jdb
+
+
+def dummy_test(**kw):
+    t = {
+        "nodes": ["n1", "n2", "n3", "n4", "n5"],
+        "ssh": {"dummy?": True},
+        "concurrency": 2,
+        "client": jc.noop,
+    }
+    t.update(kw)
+    return t
+
+
+# -- node targeting ------------------------------------------------------
+
+
+def test_pick_nodes():
+    test = dummy_test()
+    assert faults._pick_nodes(test, None) == test["nodes"]
+    assert len(faults._pick_nodes(test, 2)) == 2
+    assert faults._pick_nodes(test, ["n2", "nope"]) == ["n2"]
+    assert faults._pick_nodes(test, lambda n: n.endswith("1")) == ["n1"]
+
+
+# -- db nemesis over dummy sessions -------------------------------------
+
+
+def test_db_nemesis_kill_start():
+    killed, started = [], []
+
+    class KillableDB(jdb.DB):
+        def kill(self, test, sess, node):
+            killed.append(node)
+
+        def start(self, test, sess, node):
+            started.append(node)
+
+    test = dummy_test(db=KillableDB())
+    with with_sessions(test):
+        nem = faults.DBNemesis()
+        op = Op(type="info", f="kill", value=["n1", "n3"], process=NEMESIS)
+        out = nem.invoke(test, op)
+        assert sorted(killed) == ["n1", "n3"]
+        assert out.value == {"n1": "done", "n3": "done"}
+        nem.invoke(test, Op(type="info", f="start", value=None, process=NEMESIS))
+        assert sorted(started) == ["n1", "n2", "n3", "n4", "n5"]
+
+
+def test_clock_nemesis_compiles_and_bumps():
+    remote = DummyRemote()
+    test = dummy_test(remote=remote, ssh={})
+    with with_sessions(test):
+        nem = faults.ClockNemesis().setup(test)
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("gcc" in c and "bump-time" in c for c in cmds)
+        assert any("strobe-time" in c for c in cmds)
+        uploads = [a for a in remote.actions if "upload" in a]
+        assert len(uploads) == 10  # 2 files x 5 nodes
+
+        remote.actions.clear()
+        out = nem.invoke(
+            test, Op(type="info", f="bump", value=500, process=NEMESIS)
+        )
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("bump-time -- 500" in c for c in cmds)
+        assert out.value == {n: 500 for n in test["nodes"]}
+
+
+def test_bitflip_and_truncate_command_shape():
+    remote = DummyRemote()
+    test = dummy_test(remote=remote, ssh={})
+    with with_sessions(test):
+        tr = faults.TruncateFile()
+        tr.invoke(
+            test,
+            Op(type="info", f="truncate",
+               value={"n1": {"file": "/data/db", "drop": 100}},
+               process=NEMESIS),
+        )
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("truncate -c -s -100 /data/db" in c for c in cmds)
+
+
+# -- the C sources compile ----------------------------------------------
+
+
+def test_clock_c_sources_compile(tmp_path):
+    import shutil
+    import subprocess
+
+    if shutil.which("gcc") is None:
+        pytest.skip("no gcc")
+    for src in ("bump-time.c", "strobe-time.c"):
+        path = os.path.join(faults.RESOURCE_DIR, src)
+        out = str(tmp_path / src[:-2])
+        r = subprocess.run(
+            ["gcc", "-O2", "-o", out, path], capture_output=True
+        )
+        assert r.returncode == 0, r.stderr.decode()
+        # Running without args prints usage and exits 2.
+        r2 = subprocess.run([out], capture_output=True)
+        assert r2.returncode == 2
+
+
+# -- packages ------------------------------------------------------------
+
+
+def test_nemesis_package_composition():
+    pkg = combined.nemesis_package(
+        {"faults": {"partition", "kill", "packet"}, "interval": 0.01}
+    )
+    fs = pkg["nemesis"].fs()
+    assert {"start-partition", "stop-partition", "kill", "start",
+            "start-packet", "stop-packet"} <= fs
+    assert pkg["generator"] is not None
+    assert pkg["final-generator"]
+    names = {p["name"] for p in pkg["perf"]}
+    assert {"partition", "kill", "packet"} <= names
+
+
+def test_partition_package_runs_through_interpreter():
+    class FakeNet:
+        def __init__(self):
+            self.dropped = 0
+            self.healed = 0
+
+        def drop_all(self, test, grudge):
+            self.dropped += 1
+
+        def heal(self, test):
+            self.healed += 1
+
+    net = FakeNet()
+    pkg = combined.nemesis_package({"faults": {"partition"}, "interval": 0.03})
+    test = dummy_test(
+        net=net,
+        nemesis=pkg["nemesis"].setup(
+            dummy_test(net=net)
+        ),
+        generator=gen.time_limit(
+            0.25,
+            gen.nemesis(
+                pkg["generator"],
+                gen.stagger(0.01, gen.repeat({"f": "r"})),
+            ),
+        ),
+    )
+    h = interpreter.run(test)
+    assert net.dropped >= 1, "at least one partition started"
+    assert net.healed >= 1
+    nem_fs = {o.f for o in h if o.process == NEMESIS}
+    assert "start-partition" in nem_fs
+
+
+# -- artifact checkers ---------------------------------------------------
+
+
+def make_history(n=60):
+    ops = []
+    idx = 0
+    for i in range(n):
+        t_inv = i * 10_000_000
+        ops.append(Op(type="invoke", f="read", value=None, process=i % 3,
+                      time=t_inv, index=idx)); idx += 1
+        typ = OK if i % 5 else "info"
+        ops.append(Op(type=typ, f="read", value=i, process=i % 3,
+                      time=t_inv + 3_000_000, index=idx)); idx += 1
+    # nemesis start/stop pair
+    ops.append(Op(type="info", f="start", value=None, process=NEMESIS,
+                  time=100_000_000, index=idx)); idx += 1
+    ops.append(Op(type="info", f="stop", value=None, process=NEMESIS,
+                  time=400_000_000, index=idx)); idx += 1
+    return History(ops, reindex=False)
+
+
+def test_perf_checkers_render(tmp_path):
+    from jepsen_tpu.checker.perf import LatencyGraph, RateGraph, perf
+
+    h = make_history()
+    test = {"name": "perf-test"}
+    opts = {"dir": str(tmp_path)}
+    r1 = LatencyGraph().check(test, h, opts)
+    r2 = RateGraph().check(test, h, opts)
+    assert r1["valid"] and os.path.getsize(r1["file"]) > 1000
+    assert r2["valid"] and os.path.getsize(r2["file"]) > 1000
+    res = perf().check(test, h, opts)
+    assert res["valid"] is True
+
+
+def test_timeline_renders(tmp_path):
+    from jepsen_tpu.checker.timeline import Timeline, render
+
+    h = make_history(20)
+    res = Timeline().check({"name": "tl"}, h, {"dir": str(tmp_path)})
+    assert res["valid"]
+    html = open(res["file"]).read()
+    assert html.count("class='op'") == 20
+    assert "read" in html
+
+
+def test_clock_plot(tmp_path):
+    from jepsen_tpu.checker.clock import ClockPlot, datasets
+
+    ops = [
+        Op(type="info", f="check-offsets",
+           value={"clock-offsets": {"n1": 0.5, "n2": -0.25}},
+           process=NEMESIS, time=1_000_000_000, index=0),
+        Op(type="info", f="check-offsets",
+           value={"clock-offsets": {"n1": 1.5, "n2": 0.0}},
+           process=NEMESIS, time=2_000_000_000, index=1),
+    ]
+    h = History(ops, reindex=False)
+    assert datasets(h) == {
+        "n1": [(1.0, 0.5), (2.0, 1.5)],
+        "n2": [(1.0, -0.25), (2.0, 0.0)],
+    }
+    res = ClockPlot().check({}, h, {"dir": str(tmp_path)})
+    assert res["valid"] and os.path.exists(res["file"])
+
+
+def test_sleep_generator_timing():
+    """gen.sleep emits nothing and delays sequence successors."""
+    test = {
+        "concurrency": 1,
+        "nodes": ["n1"],
+        "client": jc.noop,
+        "nemesis": __import__("jepsen_tpu.nemesis", fromlist=["noop"]).noop,
+        "generator": gen.clients([
+            gen.once({"f": "a"}),
+            gen.sleep(0.15),
+            gen.once({"f": "b"}),
+        ]),
+    }
+    import time
+
+    t0 = time.monotonic()
+    h = interpreter.run(test)
+    dt = time.monotonic() - t0
+    fs = [o.f for o in h if o.is_invoke]
+    assert fs == ["a", "b"]
+    assert dt >= 0.14, f"sleep was skipped: {dt}"
