@@ -325,7 +325,13 @@ class TotalQueue(Checker):
 
 class SetChecker(Checker):
     """Grow-only set via a final read: everything acknowledged must be
-    present; nothing unexpected (checker.clj:257-287)."""
+    present; nothing unexpected (checker.clj:257-287).  `add_f`/`read_f`
+    let wire protocols with different op names (e.g. kvdb's "members")
+    reuse it."""
+
+    def __init__(self, add_f: Any = "add", read_f: Any = "read"):
+        self.add_f = add_f
+        self.read_f = read_f
 
     def check(self, test, history, opts):
         attempts: set = set()
@@ -334,12 +340,12 @@ class SetChecker(Checker):
         for o in history:
             if not o.is_client_op:
                 continue
-            if o.f == "add":
+            if o.f == self.add_f:
                 if o.is_invoke:
                     attempts.add(_hashable(o.value))
                 elif o.is_ok:
                     adds.add(_hashable(o.value))
-            elif o.f == "read" and o.is_ok:
+            elif o.f == self.read_f and o.is_ok:
                 final_read = set(_hashable(x) for x in (o.value or []))
         if final_read is None:
             return {"valid": UNKNOWN, "error": "no read completed"}

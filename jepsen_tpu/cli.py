@@ -89,7 +89,21 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
             nodes += [l.strip() for l in f if l.strip()]
     if not nodes:
         nodes = ["n1", "n2", "n3", "n4", "n5"]  # cli.clj:18 default
+    # Suite-specific flags (registered via extra_opts) ride along with
+    # dashes for keys, after the standard set.
+    consumed = {
+        "nodes", "nodes_csv", "nodes_file", "concurrency", "time_limit",
+        "test_count", "username", "password", "private_key_path",
+        "ssh_port", "dummy_ssh", "leave_db_running", "store_dir", "seed",
+        "command", "test_dir",
+    }
+    extra = {
+        k.replace("_", "-"): v
+        for k, v in vars(opts).items()
+        if k not in consumed and not k.startswith("_")
+    }
     return {
+        **extra,
         "nodes": nodes,
         "concurrency": opts.concurrency,
         "time-limit": opts.time_limit,

@@ -88,7 +88,18 @@ def _with_clients(test: dict, method: str) -> None:
             except Exception:  # noqa: BLE001
                 pass
 
-    real_pmap(one, test.get("nodes") or [])
+    if method == "teardown":
+        # Best-effort: a node the nemesis left dead must not turn a
+        # finished run into an error.
+        def one_safe(node: str) -> None:
+            try:
+                one(node)
+            except Exception as e:  # noqa: BLE001
+                log.warning("client teardown on %s failed: %r", node, e)
+
+        real_pmap(one_safe, test.get("nodes") or [])
+    else:
+        real_pmap(one, test.get("nodes") or [])
 
 
 def run_case(test: dict, history_writer=None) -> History:

@@ -1,0 +1,11 @@
+"""End-to-end test suites against real systems.
+
+Equivalent of the reference's per-database projects (SURVEY.md §2.5 —
+zookeeper/, etcd/, ...): each suite module provides a DB
+implementation, a network client, workload assembly, and a CLI `main`,
+following the zookeeper/src/jepsen/zookeeper.clj shape.
+"""
+
+from . import kvdb
+
+__all__ = ["kvdb"]

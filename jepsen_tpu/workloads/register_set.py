@@ -55,7 +55,9 @@ def generator(full: bool = False):
 
 
 def final_generator():
-    return until_ok({"f": "read"})
+    # repeat: dicts are one-shot, and the read must retry until it lands
+    # (until-ok over repeat, the zookeeper.clj:120-127 shape).
+    return until_ok(repeat({"f": "read"}))
 
 
 def workload(opts: Optional[dict] = None) -> dict:

@@ -1,0 +1,62 @@
+"""Whole-framework integration: the kvdb demo suite — compile a real
+C++ server through the control plane, daemonize it, break it with
+kill -9, and check the history (the reference's zookeeper-suite role,
+run against the local-cluster harness)."""
+
+import shutil
+
+import pytest
+
+from jepsen_tpu import cli, core, store
+from jepsen_tpu.suites import kvdb
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="needs g++"
+)
+
+
+def run_suite(tmp_path, *extra):
+    argv = [
+        "test",
+        "--concurrency", "4",
+        "--time-limit", "4",
+        "--store-dir", str(tmp_path / "store"),
+        "--seed", "11",
+        *extra,
+    ]
+    return kvdb.main(argv)
+
+
+def test_register_workload_linearizable(tmp_path):
+    code = run_suite(tmp_path, "--interval", "1.5")
+    assert code == cli.EXIT_VALID
+    d = store.latest(str(tmp_path / "store"))
+    tf = store.load(d)
+    h = tf.history()
+    assert len(h) > 50
+    # The nemesis actually killed the DB at least once.
+    assert any(o.f == "kill" for o in h)
+    tf.close()
+
+
+def test_set_workload_detects_lost_writes(tmp_path):
+    """kvdb --buffer holds acked writes in process memory; kill -9 must
+    surface them as lost."""
+    code = run_suite(
+        tmp_path, "--workload", "set", "--no-fsync",
+        "--buffer", "65536", "--interval", "1.5",
+    )
+    assert code == cli.EXIT_INVALID
+    d = store.latest(str(tmp_path / "store"))
+    tf = store.load(d)
+    res = tf.results
+    assert res["valid"] is False
+    assert res["lost-count"] > 0
+    tf.close()
+
+
+def test_set_workload_fsync_safe(tmp_path):
+    code = run_suite(
+        tmp_path, "--workload", "set", "--interval", "1.5"
+    )
+    assert code == cli.EXIT_VALID
