@@ -165,8 +165,10 @@ def classify_cycle(graph: DepGraph, cycle: list[int]) -> str:
     for a, b in zip(cycle, cycle[1:]):
         ts = graph.edge_types(a, b)
         types |= ts
-        # An edge that can ONLY be explained as rw counts as one.
-        if ts and not (ts - {"rw", "realtime", "process"}) and "rw" in ts:
+        # Any edge carrying an anti-dependency counts: a cycle whose
+        # single rw edge also happens to be ww/wr is still G-single
+        # (Elle's minimal-explanation rule).
+        if "rw" in ts:
             rw_edges += 1
     data = types & {"ww", "wr", "rw"}
     if "rw" in data:

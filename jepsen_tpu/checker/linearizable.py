@@ -33,7 +33,7 @@ class Linearizable(Checker):
         algorithm: str = "wgl-tpu",
         *,
         beam: int = 1024,
-        max_beam: int = 65536,
+        max_beam: int = 4096,
         block: int = 256,
         time_limit_s: Optional[float] = None,
         max_configs: int = 5_000_000,

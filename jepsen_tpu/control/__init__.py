@@ -26,6 +26,7 @@ from .core import (
     Lit,
     NonzeroExit,
     Remote,
+    RemoteDisconnected,
     RemoteError,
     escape,
     escape_arg,
@@ -52,6 +53,7 @@ __all__ = [
     "LocalRemote",
     "NonzeroExit",
     "Remote",
+    "RemoteDisconnected",
     "RemoteError",
     "RetryRemote",
     "Session",

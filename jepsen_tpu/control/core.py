@@ -26,6 +26,16 @@ class RemoteError(Exception):
     """Connection-level failure (the reference's :ssh-failed)."""
 
 
+class RemoteDisconnected(RemoteError):
+    """The remote shell ended cleanly before reporting a status — the
+    command itself likely ended the session (`exit`, a clean shutdown).
+    The command may have executed, so the retry wrapper must NOT replay
+    it (unlike plain RemoteError transport failures).  Commands that
+    drop the link abruptly surface as transport failures instead and are
+    retried — make them report-then-disconnect (nohup + sleep) if they
+    are not idempotent."""
+
+
 class NonzeroExit(Exception):
     """A remote command exited nonzero (control/core.clj:159-175)."""
 

@@ -20,7 +20,7 @@ import threading
 import time
 from typing import Any, Optional, Sequence
 
-from .core import ConnSpec, Remote, RemoteError
+from .core import ConnSpec, Remote, RemoteDisconnected, RemoteError
 
 log = logging.getLogger(__name__)
 
@@ -174,10 +174,24 @@ class SshCliRemote(Remote):
             raise RemoteError(f"ssh timed out: {action['cmd']!r}") from e
         stdout = proc.stdout.decode(errors="replace")
         marker_at = stdout.rfind(self.STATUS_MARKER)
-        if proc.returncode != 0 or marker_at < 0:
+        if proc.returncode != 0:
             raise RemoteError(
                 f"ssh to {self.spec.host} failed (status {proc.returncode}): "
                 f"{proc.stderr.decode(errors='replace')}"
+            )
+        if marker_at < 0:
+            # ssh exited 0 but the status line never printed: the remote
+            # shell ended cleanly without reporting (e.g. the command ran
+            # `exit`).  It may well have run — distinct type so
+            # RetryRemote won't replay a possibly-applied non-idempotent
+            # command.  NOTE: a command that tears the connection down
+            # hard (reboot, networking restart) usually makes ssh exit
+            # 255 instead, which is indistinguishable from a transport
+            # failure and IS retried — wrap such commands in nohup/
+            # disown+sleep so the shell reports before the link drops.
+            raise RemoteDisconnected(
+                f"remote shell on {self.spec.host} ended before reporting "
+                f"status for {action['cmd']!r}"
             )
         status = int(stdout[marker_at + len(self.STATUS_MARKER):] or -1)
         out = dict(action)
@@ -297,6 +311,11 @@ class RetryRemote(Remote):
         for attempt in range(self.TRIES):
             try:
                 return f()
+            except RemoteDisconnected:
+                # The command itself ended the session and may have been
+                # applied; replaying a non-idempotent command is worse
+                # than surfacing the disconnect.
+                raise
             except RemoteError as e:
                 last = e
                 log.debug(

@@ -136,13 +136,16 @@ def db_package(opts: dict) -> Optional[dict]:
     final = [{"type": "info", "f": "start", "value": None}] if kills else []
     if pauses:
         final.append({"type": "info", "f": "resume", "value": None})
+    perf = []
+    if kills:
+        perf.append({"name": "kill", "start": {"kill"}, "stop": {"start"}})
+    if pauses:
+        perf.append({"name": "pause", "start": {"pause"}, "stop": {"resume"}})
     return _package(
         DBNemesis(),
         generator,
         final_generator=final or None,
-        perf=[{"name": "kill", "start": {"kill"}, "stop": {"start"}}]
-        if kills
-        else [{"name": "pause", "start": {"pause"}, "stop": {"resume"}}],
+        perf=perf,
     )
 
 

@@ -133,7 +133,11 @@ class ClockNemesis(Nemesis):
     and stops NTP.  fs: bump/strobe/reset/check-offsets.
 
     Op values: bump {node: delta_ms} or delta_ms for all; strobe
-    {"delta": ms, "period": ms, "duration": ms} (+optional "nodes")."""
+    {"delta": ms, "period": ms, "duration": ms} (+optional "nodes").
+
+    Like the reference (nemesis/time.clj:104-167), every bump/strobe/
+    reset completion carries a {"clock-offsets": {node: secs}} map of
+    node-clock-minus-control-clock offsets, which ClockPlot graphs."""
 
     def setup(self, test: dict) -> "ClockNemesis":
         def install(sess: Session, node: str):
@@ -155,6 +159,20 @@ class ClockNemesis(Nemesis):
         on_nodes(test, install)
         return self
 
+    def _offsets(self, test: dict, nodes=None) -> dict:
+        """Node wall-clock minus control wall-clock, in seconds, per node
+        (the reference's current-offset, nemesis/time.clj:104-130)."""
+        import time as _time
+
+        def offset(sess: Session, node: str):
+            remote = sess.exec("date", "+%s.%N")
+            try:
+                return float(remote) - _time.time()
+            except (TypeError, ValueError):
+                return None  # dummy remotes return empty output
+
+        return on_nodes(test, offset, nodes)
+
     def invoke(self, test: dict, op: Op) -> Op:
         if op.f == "bump":
             spec = op.value
@@ -162,12 +180,21 @@ class ClockNemesis(Nemesis):
                 spec = {n: spec for n in test.get("nodes") or []}
 
             def bump(sess: Session, node: str):
+                # Single positional arg: bump-time parses argv[1] with
+                # atoll, so a "--" separator would silently read as 0
+                # (exec() passes argv directly — no option parsing, so
+                # negative deltas are safe without it).
                 delta = spec[node]
                 with sess.su():
-                    sess.exec(f"{BUILD_DIR}/bump-time", "--", str(delta))
+                    sess.exec(f"{BUILD_DIR}/bump-time", str(delta))
                 return delta
 
-            return op.replace(value=on_nodes(test, bump, list(spec.keys())))
+            nodes = list(spec.keys())
+            res = on_nodes(test, bump, nodes)
+            return op.replace(value={
+                "bumped": res,
+                "clock-offsets": self._offsets(test, nodes),
+            })
         if op.f == "strobe":
             v = op.value or {}
             nodes = _pick_nodes(test, v.get("nodes"))
@@ -182,7 +209,11 @@ class ClockNemesis(Nemesis):
                     )
                 return "strobed"
 
-            return op.replace(value=on_nodes(test, strobe, nodes))
+            res = on_nodes(test, strobe, nodes)
+            return op.replace(value={
+                "strobed": res,
+                "clock-offsets": self._offsets(test, nodes),
+            })
         if op.f == "reset":
             nodes = _pick_nodes(test, op.value)
 
@@ -191,13 +222,14 @@ class ClockNemesis(Nemesis):
                     sess.exec("ntpdate", "-b", "pool.ntp.org")
                 return "reset"
 
-            return op.replace(value=on_nodes(test, reset, nodes))
+            res = on_nodes(test, reset, nodes)
+            return op.replace(value={
+                "reset": res,
+                "clock-offsets": self._offsets(test, nodes),
+            })
         if op.f == "check-offsets":
-            def offset(sess: Session, node: str):
-                return sess.exec("date", "+%s.%N")
-
             return op.replace(
-                value={"clock-offsets": on_nodes(test, offset)}
+                value={"clock-offsets": self._offsets(test)}
             )
         raise ValueError(f"unknown clock f {op.f!r}")
 

@@ -1,5 +1,7 @@
 /* Adjust CLOCK_REALTIME by a signed millisecond delta: `bump-time 500`
- * jumps the wall clock half a second forward, `bump-time -- -500` back.
+ * jumps the wall clock half a second forward, `bump-time -500` back.
+ * The delta MUST be argv[1]: there is no option parsing, and a "--"
+ * separator would be atoll'd to 0 — a silent no-op bump.
  * Compiled on the DB node by the clock nemesis, the same strategy the
  * reference uses (jepsen/src/jepsen/nemesis/time.clj:21-40 compiles
  * resources/bump-time.c with gcc at setup time).  Fresh implementation.

@@ -78,8 +78,12 @@ def test_clock_nemesis_compiles_and_bumps():
             test, Op(type="info", f="bump", value=500, process=NEMESIS)
         )
         cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
-        assert any("bump-time -- 500" in c for c in cmds)
-        assert out.value == {n: 500 for n in test["nodes"]}
+        # The delta must be argv[1]: bump-time atoll-parses argv[1], so a
+        # "--" separator would silently bump by 0 (advisor finding r1).
+        bumps = [c for c in cmds if "bump-time" in c and "gcc" not in c]
+        assert bumps and all("bump-time 500" in c for c in bumps)
+        assert out.value["bumped"] == {n: 500 for n in test["nodes"]}
+        assert set(out.value["clock-offsets"]) == set(test["nodes"])
 
 
 def test_bitflip_and_truncate_command_shape():
