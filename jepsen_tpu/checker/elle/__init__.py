@@ -31,25 +31,51 @@ __all__ = [
 ]
 
 
-class AppendChecker(Checker):
-    """checker for list-append workloads (append.clj:6-27)."""
+def _device_cycle_fn(device: str):
+    """None (host Tarjan) or the device-screened search (ops/scc.py):
+    the MXU closure kernel settles acyclic graphs; only flagged graphs
+    get the exact host layered extraction — same records either way."""
+    if device == "off":
+        return None
 
-    def __init__(self, consistency_model: str = "serializable"):
+    def screened(g: DepGraph):
+        from ...ops.scc import check_cycles_device
+
+        return check_cycles_device([g])[0]
+
+    return screened
+
+
+class AppendChecker(Checker):
+    """checker for list-append workloads (append.clj:6-27).  `device`:
+    "auto"/"on" screens cycle search on the accelerator, "off" keeps it
+    on host."""
+
+    def __init__(self, consistency_model: str = "serializable",
+                 device: str = "auto"):
         self.consistency_model = consistency_model
+        self.device = device
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
         return analyze_append(
-            history.client_ops(), consistency_model=self.consistency_model
+            history.client_ops(),
+            consistency_model=self.consistency_model,
+            cycle_fn=_device_cycle_fn(self.device),
         )
 
 
 class WrChecker(Checker):
-    """checker for rw-register workloads (wr.clj:5-25)."""
+    """checker for rw-register workloads (wr.clj:5-25).  `device` as in
+    AppendChecker."""
 
-    def __init__(self, consistency_model: str = "serializable"):
+    def __init__(self, consistency_model: str = "serializable",
+                 device: str = "auto"):
         self.consistency_model = consistency_model
+        self.device = device
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
         return analyze_wr(
-            history.client_ops(), consistency_model=self.consistency_model
+            history.client_ops(),
+            consistency_model=self.consistency_model,
+            cycle_fn=_device_cycle_fn(self.device),
         )

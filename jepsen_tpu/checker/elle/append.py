@@ -53,6 +53,7 @@ def analyze(
     history: History,
     *,
     consistency_model: str = "serializable",
+    cycle_fn=None,
 ) -> dict:
     """Full list-append analysis -> {"valid": ..., "anomaly-types": [...],
     "anomalies": {...}}."""
@@ -208,7 +209,7 @@ def analyze(
     if consistency_model == "strict-serializable":
         _add_realtime_edges(history, g)
 
-    cycles = check_cycles(g)
+    cycles = (cycle_fn or check_cycles)(g)
     for c in cycles:
         anomalies[c["type"]].append(c)
 

@@ -15,8 +15,10 @@ classified by the edge types it contains:
     G1c       cycle of ww/wr edges (at least one wr)
     G2-item   cycle containing an rw edge (exactly one -> G-single)
 
-The batched device path for many small per-key graphs lives in
-jepsen_tpu.ops.scc.
+The batched device screen for many per-key graphs lives in
+jepsen_tpu.ops.scc (check_cycles_device): an MXU transitive-closure
+kernel settles acyclic graphs, and this module's exact search extracts
+and classifies cycles for the flagged ones.
 """
 
 from __future__ import annotations

@@ -33,7 +33,12 @@ from .graph import DepGraph, check_cycles
 from .append import FORBIDDEN, DIRTY
 
 
-def analyze(history: History, *, consistency_model: str = "serializable") -> dict:
+def analyze(
+    history: History,
+    *,
+    consistency_model: str = "serializable",
+    cycle_fn=None,
+) -> dict:
     oks = [o for o in history if o.is_ok and o.f in ("txn", None)]
     infos = [o for o in history if o.is_info and o.f in ("txn", None)]
     fails = [o for o in history if o.is_fail and o.f in ("txn", None)]
@@ -131,7 +136,7 @@ def analyze(history: History, *, consistency_model: str = "serializable") -> dic
                     if rd != wv2:
                         g.add_edge(rd, wv2, "rw")
 
-    cycles = check_cycles(g)
+    cycles = (cycle_fn or check_cycles)(g)
     for c in cycles:
         anomalies[c["type"]].append(c)
 

@@ -64,7 +64,7 @@ class Linearizable(Checker):
                 max_configs=self.max_configs,
                 time_limit_s=self.time_limit_s,
             )
-            return self._render(res, None, "wgl-host", model)
+            return self._render(res, None, "wgl-host", model, opts=opts)
 
         packed = pack_history(history, pm.encode)
 
@@ -75,7 +75,7 @@ class Linearizable(Checker):
                 max_configs=self.max_configs,
                 time_limit_s=self.time_limit_s,
             )
-            return self._render(res, packed, "wgl", model, pm)
+            return self._render(res, packed, "wgl", model, pm, opts=opts)
 
         # Device-first paths.
         from ..ops.wgl import check_wgl_device
@@ -89,6 +89,27 @@ class Linearizable(Checker):
             time_limit_s=self.time_limit_s,
         )
         used = "wgl-tpu"
+        if res.valid is False and not res.final_configs and (
+            packed.n <= CPU_FALLBACK_MAX_OPS
+        ):
+            # The device BFS settles the verdict but carries no
+            # counterexample detail; re-derive final configs on the CPU
+            # for reporting + linear.svg (checker.clj:223-229).  This
+            # pass is reporting-only, so it gets what remains of the
+            # configured budget (capped when none is set) rather than a
+            # fresh full one — the verdict stands either way.
+            remaining = 30.0
+            if self.time_limit_s is not None:
+                remaining = max(1.0, self.time_limit_s - res.elapsed_s)
+            cpu = check_wgl_cpu(
+                packed,
+                pm,
+                max_configs=self.max_configs,
+                time_limit_s=remaining,
+            )
+            if cpu.valid is False:
+                res = cpu
+                used = "wgl-tpu+cpu-report"
         if res.valid == "unknown" and (
             algorithm == "competition" or packed.n <= CPU_FALLBACK_MAX_OPS
         ):
@@ -101,7 +122,7 @@ class Linearizable(Checker):
             if cpu.valid != "unknown":
                 res = cpu
                 used = "wgl-tpu+cpu-fallback"
-        return self._render(res, packed, used, model, pm)
+        return self._render(res, packed, used, model, pm, opts=opts)
 
     def _render(
         self,
@@ -110,6 +131,7 @@ class Linearizable(Checker):
         algorithm: str,
         model,
         pm: Optional[PackedModel] = None,
+        opts: Optional[dict] = None,
     ) -> dict:
         out = {
             "valid": res.valid,
@@ -139,6 +161,24 @@ class Linearizable(Checker):
                     "history-index": int(packed.src_index[a]),
                     "op": desc,
                 }
+            # Counterexample artifact, knossos's linear.svg
+            # (checker.clj:223-229): drawn into the store dir when the
+            # run gives us one.
+            d = (opts or {}).get("dir")
+            if d and packed is not None and pm is not None:
+                import os
+
+                from .linviz import render_analysis
+
+                try:
+                    os.makedirs(d, exist_ok=True)
+                    path = render_analysis(
+                        packed, pm, res, os.path.join(d, "linear.svg")
+                    )
+                    if path:
+                        out["counterexample-file"] = path
+                except OSError:
+                    pass
         return out
 
 

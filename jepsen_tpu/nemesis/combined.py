@@ -289,7 +289,10 @@ def compose_packages(packages: Sequence[dict]) -> dict:
 def nemesis_package(opts: Optional[dict] = None) -> dict:
     """The one-stop constructor (combined.clj:508-568): opts["faults"]
     from {"partition", "kill", "pause", "packet", "clock",
-    "file-corruption"}."""
+    "file-corruption", "membership"} (membership needs
+    opts["membership"]["state"], see nemesis/membership.py)."""
+    from .membership import membership_package
+
     opts = opts or {}
     opts.setdefault("faults", {"partition"})
     return compose_packages(
@@ -299,5 +302,6 @@ def nemesis_package(opts: Optional[dict] = None) -> dict:
             packet_package(opts),
             clock_package(opts),
             file_corruption_package(opts),
+            membership_package(opts),
         ]
     )

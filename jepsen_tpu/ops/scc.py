@@ -1,0 +1,150 @@
+"""Batched device cycle screening for dependency graphs.
+
+The device half of the Elle-equivalent (checker/elle/graph.py): Adya
+anomaly detection is cycle detection over per-transaction dependency
+graphs, and a test's history shards into many *independent* per-key
+graphs (parallel/independent.py), each small.  That shape is a poor fit
+for irregular host Tarjan at scale but a great fit for the MXU: pack
+each graph as a (V, V) boolean adjacency matrix, batch over keys, and
+compute transitive closure by repeated bfloat16 matrix squaring —
+log2(V) batched matmuls.  A graph has a cycle iff its closure has a
+nonzero diagonal.
+
+The screen is conservative in the cheap direction: it decides *whether*
+each key's graph is acyclic (the common, expensive-to-confirm case) on
+device; only flagged keys go to the exact host search
+(graph.check_cycles) for cycle extraction and Adya classification, so
+verdict parity with the host path is structural.  Keys shard across the
+mesh axis like the batched WGL kernel (ops/wgl_batched.py).
+
+Equivalent role in the reference stack: elle's cycle search consumed by
+jepsen at tests/cycle/{append,wr}.clj (the elle library itself is not
+vendored; SURVEY.md §2.4).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from ..checker.elle.graph import DepGraph, check_cycles
+
+_kernel_cache: dict[tuple, Any] = {}
+
+
+def _bucket(x: int, lo: int) -> int:
+    w = lo
+    while w < x:
+        w *= 2
+    return w
+
+
+def pack_adjacency(
+    graphs: Sequence[DepGraph],
+    *,
+    pad_keys_to: Optional[int] = None,
+) -> tuple[np.ndarray, list[list[int]]]:
+    """Packs graphs into a (K, V, V) bool adjacency tensor (all edge
+    types collapsed — the screen only needs reachability) plus each
+    graph's dense-index -> vertex mapping."""
+    V = _bucket(max((len(g.vertices) for g in graphs), default=1), 8)
+    K = pad_keys_to or len(graphs)
+    adj = np.zeros((K, V, V), dtype=bool)
+    vertex_maps: list[list[int]] = []
+    for k, g in enumerate(graphs):
+        verts = sorted(g.vertices)
+        idx = {v: i for i, v in enumerate(verts)}
+        vertex_maps.append(verts)
+        for src, dsts in g.adj.items():
+            si = idx[src]
+            for dst in dsts:
+                adj[k, si, idx[dst]] = True
+    return adj, vertex_maps
+
+
+def _get_kernel(K: int, V: int, mesh=None):
+    # Keyed on the mesh object itself (a strong reference): id()
+    # keys can collide when a dead object's address is reused,
+    # silently serving a kernel compiled for something else.
+    key = (K, V, mesh)
+    fn = _kernel_cache.get(key)
+    if fn is not None:
+        return fn
+
+    import jax
+    import jax.numpy as jnp
+
+    steps = max(1, int(np.ceil(np.log2(max(V, 2)))))
+
+    def has_cycle(adj):
+        # (K, V, V) bool -> (K,) bool.  Repeated squaring in bfloat16:
+        # values are clamped to {0, 1} every step, so low precision
+        # only ever rounds sums of nonnegative reachability counts,
+        # which cannot reach zero — exactness is preserved.
+        a = adj.astype(jnp.bfloat16)
+        for _ in range(steps):
+            a = jnp.minimum(a + jnp.einsum(
+                "kij,kjh->kih", a, a,
+                preferred_element_type=jnp.bfloat16,
+            ), 1.0)
+        diag = jnp.diagonal(a, axis1=1, axis2=2)
+        return (diag > 0).any(axis=1)
+
+    if mesh is not None:
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        fn = jax.jit(
+            shard_map(
+                has_cycle, mesh=mesh,
+                in_specs=P("keys"), out_specs=P("keys"),
+            )
+        )
+    else:
+        fn = jax.jit(has_cycle)
+    _kernel_cache[key] = fn
+    return fn
+
+
+def screen_cycles(
+    graphs: Sequence[DepGraph], *, mesh=None
+) -> np.ndarray:
+    """(n_graphs,) bool: True where the graph contains a cycle.  Runs on
+    the default JAX device, keys sharded over `mesh` when given."""
+    import jax.numpy as jnp
+
+    if not graphs:
+        return np.zeros(0, dtype=bool)
+    n = len(graphs)
+    K = n
+    if mesh is not None:
+        shards = mesh.devices.size
+        K = ((n + shards - 1) // shards) * shards
+    adj, _ = pack_adjacency(graphs, pad_keys_to=K)
+    flags = np.asarray(_get_kernel(K, adj.shape[1], mesh)(jnp.asarray(adj)))
+    return flags[:n]
+
+
+def check_cycles_device(
+    graphs: Sequence[DepGraph], *, mesh=None, max_device_vertices: int = 1024
+) -> list[list[dict]]:
+    """Anomaly cycles per graph, device-screened: acyclic keys are
+    settled by the closure kernel; flagged keys get the exact host
+    layered search (same records as graph.check_cycles).  Graphs too
+    large for a dense (V, V) matrix fall back to host Tarjan."""
+    big = [
+        i for i, g in enumerate(graphs)
+        if len(g.vertices) > max_device_vertices
+    ]
+    small_idx = [i for i in range(len(graphs)) if i not in set(big)]
+    small = [graphs[i] for i in small_idx]
+    out: list[list[dict]] = [[] for _ in graphs]
+    if small:
+        flags = screen_cycles(small, mesh=mesh)
+        for i, flagged in zip(small_idx, flags):
+            if flagged:
+                out[i] = check_cycles(graphs[i])
+    for i in big:
+        out[i] = check_cycles(graphs[i])
+    return out

@@ -179,6 +179,18 @@ def _bucket(x: int, lo: int = 256) -> int:
     return w
 
 
+def window_regather(prev_active: np.ndarray, active: np.ndarray):
+    """(perm, present) mapping a new window layout onto the previous
+    one: new column j reads old column perm[j] where present[j].  Shared
+    by the BFS and witness paths so boundary handling stays in one
+    place."""
+    pos = np.searchsorted(prev_active, active)
+    pos_clip = np.clip(pos, 0, len(prev_active) - 1)
+    present = (pos < len(prev_active)) & (prev_active[pos_clip] == active)
+    perm = np.where(present, pos_clip, 0)
+    return perm, present
+
+
 def _window_tables(packed: PackedOps, n0: int, K: int, max_window: int):
     """Host-side window computation for levels [n0, n0+K)."""
     preds = packed.preds
@@ -299,12 +311,7 @@ def check_wgl_device(
         else:
             # Host-side re-gather: device gathers here recompile per
             # distinct (old, new) window shape pair and dominate runtime.
-            pos = np.searchsorted(prev_active, active)
-            pos_clip = np.clip(pos, 0, len(prev_active) - 1)
-            present = (pos < len(prev_active)) & (
-                prev_active[pos_clip] == active
-            )
-            perm = np.where(present, pos_clip, 0)
+            perm, present = window_regather(prev_active, active)
             member_np = np.asarray(member)
             Bcur = member_np.shape[0]
             new_member = np.zeros((Bcur, W), dtype=bool)
@@ -319,7 +326,10 @@ def check_wgl_device(
 
         while True:
             Cmax = cand_factor * B
-            key = (B, W, SW, Cmax, id(pm.jax_step))
+            # The step fn itself keys the cache (strong ref): an
+            # id() key can collide after GC address reuse and serve
+            # the wrong model's transition kernel.
+            key = (B, W, SW, Cmax, pm.jax_step)
             fn = _block_fn_cache.get(key)
             if fn is None:
                 fn = _make_block_fn(B, W, SW, Cmax, pm.jax_step)

@@ -220,7 +220,8 @@ def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None):
     independently — no collectives in the hot loop)."""
     import jax
 
-    key = (B, N, SW, Cmax, id(jax_step), id(mesh) if mesh is not None else None)
+    # Strong-reference keys: id() collides after GC address reuse.
+    key = (B, N, SW, Cmax, jax_step, mesh)
     fn = _kernel_cache.get(key)
     if fn is not None:
         return fn

@@ -64,18 +64,12 @@ import numpy as np
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
+from .wgl import _bucket, window_regather
 
 INF = np.int32(2**31 - 1)
 NO_BAR = np.iinfo(np.int32).max
 
 _chunk_fn_cache: dict[tuple, Any] = {}
-
-
-def _bucket(x: int, lo: int = 256) -> int:
-    w = lo
-    while w < x:
-        w *= 2
-    return w
 
 
 def _state_hash_vec(sw: int, seed: int = 0xA11CE) -> np.ndarray:
@@ -375,7 +369,10 @@ def check_wgl_witness(
     NB = blocks_per_call
     W = _bucket(max(max(len(a) for _, _, a in blocks), width_hint, 1))
 
-    key = (B, W, SW, K, D, NB, id(pm.jax_step))
+    # The step fn itself keys the cache (strong ref): an id() key
+    # can collide after GC address reuse and serve the wrong
+    # model's transition kernel.
+    key = (B, W, SW, K, D, NB, pm.jax_step)
     fn = _chunk_fn_cache.get(key)
     if fn is None:
         fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step)
@@ -424,12 +421,8 @@ def check_wgl_witness(
                 present_np[bi, :] = False
                 perm_np[bi, :] = 0
             else:
-                pos = np.searchsorted(prev_active, active)
-                pos_clip = np.clip(pos, 0, len(prev_active) - 1)
-                present = (pos < len(prev_active)) & (
-                    prev_active[pos_clip] == active
-                )
-                perm_np[bi, :nw] = np.where(present, pos_clip, 0)
+                perm, present = window_regather(prev_active, active)
+                perm_np[bi, :nw] = perm
                 perm_np[bi, nw:] = 0
                 present_np[bi, :nw] = present
                 present_np[bi, nw:] = False

@@ -7,11 +7,20 @@ in-memory reference client so every workload runs whole-stack in CI
 (tests.clj:26-66 atom-client strategy).
 """
 
-from . import append, bank, linearizable_register, long_fork, register_set, wr
+from . import (
+    append,
+    bank,
+    kafka,
+    linearizable_register,
+    long_fork,
+    register_set,
+    wr,
+)
 
 __all__ = [
     "append",
     "bank",
+    "kafka",
     "linearizable_register",
     "long_fork",
     "register_set",

@@ -1,0 +1,206 @@
+"""Kafka workload checker (workloads/kafka.py): literal-history unit
+tests per analysis (the checker_test.clj pattern) plus whole-stack runs
+against the in-memory log with fault injection."""
+
+from jepsen_tpu.history.core import Op, history
+from jepsen_tpu.workloads import kafka
+
+
+def ok(f, value, process=0, **ext):
+    return Op(type="ok", f=f, value=value, process=process, ext=ext)
+
+
+def lit(*ops):
+    return history(list(ops))
+
+
+def sent(k, off, v):
+    return ["send", k, [off, v]]
+
+
+def polled(kpairs):
+    return ["poll", kpairs]
+
+
+# -- version orders ------------------------------------------------------
+
+
+def test_version_orders_and_divergence():
+    h = [
+        ok("send", [sent("x", 0, "a"), sent("x", 1, "b")]),
+        ok("poll", [polled({"x": [[0, "a"], [1, "c"]]})], process=1),
+    ]
+    rbt = kafka.reads_by_type(h)
+    orders, errors = kafka.version_orders(h, rbt)
+    assert errors and errors[0]["key"] == "x" and errors[0]["offset"] == 1
+    assert sorted(errors[0]["values"]) == ["b", "c"]
+    res = kafka.analyze(lit(*h))
+    assert res["valid"] is False
+    assert "inconsistent-offsets" in res["anomaly-types"]
+
+
+def test_offset_gaps_are_fine():
+    # Transactions burn offsets invisibly; gaps are not divergence.
+    h = lit(
+        ok("send", [sent("x", 0, "a"), sent("x", 3, "b")]),
+        ok("poll", [polled({"x": [[0, "a"], [3, "b"]]})], process=1),
+    )
+    res = kafka.analyze(h)
+    assert res["valid"] is True
+
+
+# -- g1a / lost writes ---------------------------------------------------
+
+
+def test_g1a_aborted_read():
+    h = lit(
+        Op(type="fail", f="send", value=[["send", "x", "dead"]], process=0),
+        ok("poll", [polled({"x": [[0, "dead"]]})], process=1),
+    )
+    res = kafka.analyze(h)
+    assert res["valid"] is False
+    assert "G1a" in res["anomaly-types"]
+
+
+def test_lost_write():
+    # a at index 0, c at index 2 observed; b acked at index 1 never read.
+    h = lit(
+        ok("send", [sent("x", 0, "a")]),
+        ok("send", [sent("x", 1, "b")]),
+        ok("send", [sent("x", 2, "c")]),
+        ok("poll", [polled({"x": [[0, "a"], [2, "c"]]})], process=1),
+    )
+    res = kafka.analyze(h)
+    assert "lost-write" in res["anomaly-types"]
+    case = res["anomalies"]["lost-write"][0]
+    assert case["key"] == "x" and case["value"] == "b"
+
+
+def test_unread_tail_is_unseen_not_lost():
+    h = lit(
+        ok("send", [sent("x", 0, "a")]),
+        ok("send", [sent("x", 1, "b")]),  # never polled: just unseen
+        ok("poll", [polled({"x": [[0, "a"]]})], process=1),
+    )
+    res = kafka.analyze(h)
+    assert "lost-write" not in res["anomaly-types"]
+    assert res["unseen"] == {"x": ["b"]}
+    assert res["valid"] is True
+
+
+# -- contiguity ----------------------------------------------------------
+
+
+def test_int_poll_skip_and_nonmonotonic():
+    base = [
+        ok("send", [sent("x", 0, "a"), sent("x", 1, "b"),
+                    sent("x", 2, "c")]),
+    ]
+    skip = kafka.analyze(lit(
+        *base, ok("poll", [polled({"x": [[0, "a"], [2, "c"]]}),
+                           polled({"x": [[1, "b"]]})], process=1),
+    ))
+    # First poll mop reads a then c inside one txn: skips b.
+    assert "int-poll-skip" in skip["anomaly-types"]
+    nonmono = kafka.analyze(lit(
+        *base, ok("poll", [polled({"x": [[1, "b"], [0, "a"]]})], process=1),
+    ))
+    assert "int-poll-nonmonotonic" in nonmono["anomaly-types"]
+
+
+def test_cross_op_poll_skip_resets_on_assign():
+    base = [
+        ok("send", [sent("x", 0, "a"), sent("x", 1, "b"),
+                    sent("x", 2, "c")]),
+    ]
+    bad = kafka.analyze(lit(
+        *base,
+        ok("poll", [polled({"x": [[0, "a"]]})], process=1),
+        ok("poll", [polled({"x": [[2, "c"]]})], process=1),
+    ))
+    assert "poll-skip" in bad["anomaly-types"]
+    healed = kafka.analyze(lit(
+        *base,
+        ok("poll", [polled({"x": [[0, "a"]]})], process=1),
+        ok("assign", ["x"], process=1),
+        ok("poll", [polled({"x": [[2, "c"]]})], process=1),
+    ))
+    assert "poll-skip" not in healed["anomaly-types"]
+
+
+def test_nonmonotonic_send_across_ops():
+    h = lit(
+        ok("send", [sent("x", 1, "b")], process=0),
+        ok("send", [sent("x", 0, "a")], process=0),
+    )
+    res = kafka.analyze(h)
+    assert "nonmonotonic-send" in res["anomaly-types"]
+
+
+def test_duplicate_value():
+    h = lit(
+        ok("send", [sent("x", 0, "a")]),
+        ok("poll", [polled({"x": [[0, "a"], [1, "a"]]})], process=1),
+    )
+    res = kafka.analyze(h)
+    assert "duplicate" in res["anomaly-types"]
+
+
+# -- dependency cycles ---------------------------------------------------
+
+
+def test_wr_ww_cycle_detected():
+    """T1 sends x=a; T2 sends x=b (later offset) and T1 polls b while T2
+    polls... build a G1c-style cycle: T1 -> T2 via ww, T2 -> T1 via wr."""
+    h = lit(
+        ok("txn", [sent("x", 0, "a"),
+                   polled({"y": [[0, "p"]]})], process=0),
+        ok("txn", [sent("x", 1, "b"), sent("y", 0, "p")], process=1),
+    )
+    # ww: T1 -> T2 on x; wr: T2 -> T1 on y.
+    res = kafka.analyze(h)
+    assert res["valid"] is False
+    assert "G1c" in res["anomaly-types"]
+
+
+# -- whole stack against the in-memory log ------------------------------
+
+
+def run_workload(faults=None, n_ops=400):
+    from jepsen_tpu import core
+    from jepsen_tpu.generator.core import limit, nemesis as on_nemesis
+
+    wl = kafka.workload({"faults": faults, "fault-rate": 0.15,
+                         "key-count": 3, "seed": 7})
+    test = {
+        "nodes": ["n1"],
+        "ssh": {"dummy?": True},
+        "concurrency": 4,
+        "client": wl["client"],
+        "generator": limit(n_ops, wl["generator"]),
+        "final-generator": wl["final-generator"],
+        "checker": wl["checker"],
+        "sub-via": wl["sub-via"],
+        "name": "kafka-test",
+    }
+    result = core.run(test)
+    return result["results"]
+
+
+def test_clean_run_is_valid():
+    res = run_workload()
+    assert res["valid"] is True, res.get("anomaly-types")
+
+
+def test_lose_acked_writes_detected():
+    res = run_workload(faults={"lose-acked"})
+    assert res["valid"] is not True
+    assert ("lost-write" in res["anomaly-types"]
+            or "unseen" in (res.get("unseen") or res["anomaly-types"])
+            or res["unseen"])
+
+
+def test_duplicate_fault_detected():
+    res = run_workload(faults={"duplicate"})
+    assert res["valid"] is not True
+    assert "duplicate" in res["anomaly-types"]
