@@ -134,10 +134,13 @@ def single_test_cmd(
     *,
     name: str = "jepsen-tpu",
     extra_opts: Optional[Callable[[argparse.ArgumentParser], None]] = None,
+    tests_fn: Optional[Callable[[dict], Sequence[dict]]] = None,
 ) -> argparse.ArgumentParser:
     """Builds the parser with `test`, `analyze`, and `serve` subcommands
     (cli.clj:355-441).  `test_fn` maps the CLI option map to a test
-    map."""
+    map.  When `tests_fn` (option map -> sequence of test maps) is
+    given, a `test-all` subcommand runs the whole suite
+    (cli.clj:501-529)."""
     parser = argparse.ArgumentParser(prog=name)
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -146,6 +149,13 @@ def single_test_cmd(
     if extra_opts:
         extra_opts(t)
     t.set_defaults(_run=lambda opts: _run_test(test_fn, opts))
+
+    if tests_fn is not None:
+        ta = sub.add_parser("test-all", help="run the whole test suite")
+        add_standard_opts(ta)
+        if extra_opts:
+            extra_opts(ta)
+        ta.set_defaults(_run=lambda opts: _run_test_all(tests_fn, opts))
 
     a = sub.add_parser("analyze", help="re-run checkers on a stored test")
     add_standard_opts(a)
@@ -198,6 +208,64 @@ def _run_test(test_fn, opts) -> int:
         if _SEVERITY[code] > _SEVERITY[worst]:
             worst = code
     return worst
+
+
+def _run_test_all(tests_fn, opts) -> int:
+    """Runs a suite of tests, prints the grouped summary, and exits per
+    the reference's scheme: 255 if any crashed, 2 if any unknown, 1 if
+    any invalid, 0 if all passed (cli.clj:443-529)."""
+    opt_map = test_opts_to_map(opts)
+    if opt_map.get("seed") is not None:
+        from .generator import set_rng_seed
+
+        set_rng_seed(opt_map["seed"])
+    outcomes: dict[Any, list[str]] = {}
+    for i, test in enumerate(tests_fn(opt_map)):
+        merged = {**opt_map, **test}
+        merged.pop("seed", None)
+        label = merged.get("name", f"test-{i}")
+        try:
+            done = core.run(merged)
+            valid = done.get("results", {}).get("valid")
+            # Anything that isn't a definite pass/fail buckets as
+            # unknown — a None or exotic validity must not read as a
+            # passing suite (validity_exit semantics).
+            if valid not in (True, False):
+                valid = "unknown"
+            try:
+                where = store.test_dir(done)
+            except (ValueError, KeyError):
+                where = label
+        except Exception:  # noqa: BLE001 — one crash must not stop the suite
+            log.warning("Test %s crashed", label, exc_info=True)
+            valid = "crashed"
+            where = label
+        outcomes.setdefault(valid, []).append(str(where))
+
+    print()
+    for title, key in [
+        ("Successful tests", True),
+        ("Indeterminate tests", "unknown"),
+        ("Crashed tests", "crashed"),
+        ("Failed tests", False),
+    ]:
+        if outcomes.get(key):
+            print(f"\n# {title}\n")
+            for path in outcomes[key]:
+                print(path)
+    print()
+    print(len(outcomes.get(True, [])), "successes")
+    print(len(outcomes.get("unknown", [])), "unknown")
+    print(len(outcomes.get("crashed", [])), "crashed")
+    print(len(outcomes.get(False, [])), "failures")
+
+    if outcomes.get("crashed"):
+        return EXIT_ERROR + 1  # 255, like the reference's test-all
+    if outcomes.get("unknown"):
+        return EXIT_UNKNOWN
+    if outcomes.get(False):
+        return EXIT_INVALID
+    return EXIT_VALID
 
 
 def _run_analyze(test_fn, opts) -> int:

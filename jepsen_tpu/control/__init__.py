@@ -37,6 +37,7 @@ from .core import (
 from .remotes import (
     DockerRemote,
     DummyRemote,
+    K8sRemote,
     LocalRemote,
     RetryRemote,
     SshCliRemote,
@@ -49,6 +50,7 @@ __all__ = [
     "ConnSpec",
     "DockerRemote",
     "DummyRemote",
+    "K8sRemote",
     "Lit",
     "LocalRemote",
     "NonzeroExit",

@@ -22,6 +22,24 @@ import shlex
 from typing import Any, Iterable, Mapping, Optional, Sequence
 
 
+def split_host_port(node: Any, default_port: Optional[int] = None):
+    """Splits "host:port" node names (localhost clusters publish sshd
+    on per-container ports) into (host, port); IPv6 literals pass
+    through — use "[v6addr]:port" to give one a port.  The single
+    parser for every site that needs it (ConnSpec, clients,
+    control_ip)."""
+    s = str(node)
+    if s.startswith("["):
+        host, _, rest = s[1:].partition("]")
+        if rest.startswith(":") and rest[1:].isdigit():
+            return host, int(rest[1:])
+        return host, default_port
+    head, sep, tail = s.rpartition(":")
+    if sep and tail.isdigit() and ":" not in head:
+        return head, int(tail)
+    return s, default_port
+
+
 class RemoteError(Exception):
     """Connection-level failure (the reference's :ssh-failed)."""
 
@@ -86,9 +104,10 @@ class ConnSpec:
     @staticmethod
     def for_test(test: dict, node: str) -> "ConnSpec":
         ssh = test.get("ssh", {}) or {}
+        host, port = split_host_port(node, ssh.get("port", 22))
         return ConnSpec(
-            node,
-            port=ssh.get("port", 22),
+            host,
+            port=port,
             user=ssh.get("username", "root"),
             password=ssh.get("password"),
             private_key_path=ssh.get("private-key-path"),

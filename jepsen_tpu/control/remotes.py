@@ -36,7 +36,9 @@ class DummyRemote(Remote):
         self.spec: Optional[ConnSpec] = None
 
     def connect(self, spec: ConnSpec) -> "DummyRemote":
-        r = DummyRemote(self.actions)
+        # type(self): subclasses (tests override execute to shape
+        # probe results) must survive the connect copy.
+        r = type(self)(self.actions)
         r.spec = spec
         return r
 
@@ -276,6 +278,77 @@ class DockerRemote(Remote):
                 ["docker", "cp", f"{self.spec.host}:{p}", local_path],
                 check=True,
             )
+
+
+class K8sRemote(Remote):
+    """kubectl exec / kubectl cp transport (control/k8s.clj:14-60); the
+    node name is the pod name.  Optional kubectl context/namespace are
+    fixed at construction — ConnSpec carries only the pod."""
+
+    def __init__(self, context: Optional[str] = None,
+                 namespace: Optional[str] = None):
+        self.context = context
+        self.namespace = namespace
+        self.spec: Optional[ConnSpec] = None
+
+    def _flags(self) -> list[str]:
+        flags = []
+        if self.context:
+            flags += ["--context", self.context]
+        if self.namespace:
+            flags += ["--namespace", self.namespace]
+        return flags
+
+    def connect(self, spec: ConnSpec) -> "K8sRemote":
+        if shutil.which("kubectl") is None:
+            raise RemoteError("kubectl binary not found")
+        r = K8sRemote(self.context, self.namespace)
+        r.spec = spec
+        return r
+
+    def execute(self, action: dict) -> dict:
+        cmd = [
+            "kubectl", "exec", "-i", *self._flags(), self.spec.host,
+            "--", "sh", "-c", action["cmd"],
+        ]
+        try:
+            proc = subprocess.run(
+                cmd,
+                input=(action.get("in") or "").encode(),
+                capture_output=True,
+                timeout=action.get("timeout", 300),
+            )
+        except subprocess.TimeoutExpired as e:
+            raise RemoteError("kubectl exec timed out") from e
+        out = dict(action)
+        out.update(
+            {
+                "host": self.spec.host,
+                "out": proc.stdout.decode(errors="replace"),
+                "err": proc.stderr.decode(errors="replace"),
+                "exit": proc.returncode,
+            }
+        )
+        return out
+
+    def _cp(self, src: str, dst: str) -> None:
+        proc = subprocess.run(
+            ["kubectl", "cp", *self._flags(), src, dst],
+            capture_output=True,
+        )
+        if proc.returncode != 0:
+            raise RemoteError(
+                f"kubectl cp {src} -> {dst} failed: "
+                f"{proc.stderr.decode(errors='replace')}"
+            )
+
+    def upload(self, local_paths: Sequence[str], remote_path: str) -> None:
+        for p in local_paths:
+            self._cp(p, f"{self.spec.host}:{remote_path}")
+
+    def download(self, remote_paths: Sequence[str], local_path: str) -> None:
+        for p in remote_paths:
+            self._cp(f"{self.spec.host}:{p}", local_path)
 
 
 class RetryRemote(Remote):

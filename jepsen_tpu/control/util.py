@@ -173,3 +173,24 @@ def grep_kill(sess: Session, pattern: str, *, signal: str = "KILL") -> None:
     """pkill -f by pattern (control/util.clj grepkill!)."""
     with sess.su():
         sess.exec_star("pkill", f"-{signal}", "-f", pattern)
+
+
+def control_ip(test: Optional[dict] = None) -> str:
+    """The control node's IP as DB nodes would see it
+    (control/net.clj control-ip): the source address of a UDP route
+    toward the first node (no packets sent), falling back to a public
+    resolver target, then loopback."""
+    import socket
+
+    from .core import split_host_port
+
+    targets = list((test or {}).get("nodes") or []) + ["8.8.8.8"]
+    for t in targets:
+        host, _ = split_host_port(t)
+        try:
+            with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+                s.connect((host, 9))
+                return s.getsockname()[0]
+        except OSError:
+            continue
+    return "127.0.0.1"

@@ -74,6 +74,141 @@ class NoopDB(DB):
 noop = NoopDB()
 
 
+class Tcpdump(DB):
+    """A DB that captures packets from setup to teardown and yields the
+    pcap as a log file (db.clj:88-156).  Compose it next to your real
+    DB.  Options:
+
+      ports         ports to capture (filter `port a or port b ...`)
+      clients_only  only traffic involving the control node's IP
+      filter        extra pcap filter string, AND-ed in
+    """
+
+    DIR = "/tmp/jepsen-tpu/tcpdump"
+
+    def __init__(self, *, ports: Sequence[int] = (),
+                 clients_only: bool = False,
+                 filter: Optional[str] = None):
+        self.ports = list(ports)
+        self.clients_only = clients_only
+        self.filter = filter
+        self.log_file = f"{self.DIR}/log"
+        self.cap_file = f"{self.DIR}/tcpdump.pcap"
+        self.pid_file = f"{self.DIR}/pid"
+
+    def _filter_str(self, test: dict) -> str:
+        # Each clause parenthesized: pcap's `and` binds tighter than
+        # `or`, so a bare `port a or port b and host x` would capture
+        # ALL of port a's traffic (the reference db.clj:111-117 has
+        # this flaw; fixed here).
+        parts = []
+        if self.ports:
+            parts.append(
+                "(" + " or ".join(f"port {p}" for p in self.ports) + ")"
+            )
+        if self.clients_only:
+            from .control.util import control_ip
+
+            parts.append(f"host {control_ip(test)}")
+        if self.filter:
+            parts.append(f"({self.filter})")
+        return " and ".join(p for p in parts if p)
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        from .control.util import start_daemon
+
+        with sess.su():
+            sess.exec("mkdir", "-p", self.DIR)
+            # -U: unbuffered — SIGINT is supposed to flush the capture
+            # but loses the tail in practice (db.clj:128-134).
+            args: list = ["-w", self.cap_file, "-s", "65535",
+                          "-B", "16384", "-U"]
+            f = self._filter_str(test)
+            if f:
+                args.append(f)
+            start_daemon(
+                sess, "tcpdump", *args,
+                pidfile=self.pid_file, logfile=self.log_file,
+                chdir=self.DIR,
+            )
+
+    def teardown(self, test: dict, sess: Session, node: str) -> None:
+        from .control.util import stop_daemon
+
+        with sess.su():
+            # Clean INT first so tcpdump flushes, then the hard stop.
+            sess.exec_star(
+                "bash", "-c",
+                f"test -e {self.pid_file} && "
+                f"kill -INT $(cat {self.pid_file}) && sleep 0.2; true",
+            )
+            stop_daemon(sess, self.pid_file)
+            sess.exec_star("rm", "-rf", self.DIR)
+
+    def log_files(self, test: dict, sess: Session, node: str):
+        return [self.log_file, self.cap_file]
+
+
+class ComposedDB(DB):
+    """Runs several DBs as one: setup in order, teardown in reverse,
+    log files merged; Kill/Pause/Primary route to the first DB that
+    implements them (the reference composes DBs ad hoc; this is the
+    common shape, e.g. Tcpdump + real DB)."""
+
+    def __init__(self, dbs: Sequence[DB]):
+        self.dbs = list(dbs)
+
+    def setup(self, test, sess, node):
+        for db in self.dbs:
+            db.setup(test, sess, node)
+
+    def teardown(self, test, sess, node):
+        for db in reversed(self.dbs):
+            db.teardown(test, sess, node)
+
+    def _first_with(self, name: str):
+        for db in self.dbs:
+            if db.supports(name):
+                return db
+        return None
+
+    def kill(self, test, sess, node):
+        db = self._first_with("kill")
+        if db is None:
+            raise NotImplementedError
+        return db.kill(test, sess, node)
+
+    def start(self, test, sess, node):
+        db = self._first_with("start")
+        if db is None:
+            raise NotImplementedError
+        return db.start(test, sess, node)
+
+    def pause(self, test, sess, node):
+        db = self._first_with("pause")
+        if db is None:
+            raise NotImplementedError
+        return db.pause(test, sess, node)
+
+    def resume(self, test, sess, node):
+        db = self._first_with("resume")
+        if db is None:
+            raise NotImplementedError
+        return db.resume(test, sess, node)
+
+    def primaries(self, test):
+        db = self._first_with("primaries")
+        if db is None:
+            raise NotImplementedError
+        return db.primaries(test)
+
+    def log_files(self, test, sess, node):
+        out: list = []
+        for db in self.dbs:
+            out.extend(db.log_files(test, sess, node) or [])
+        return out
+
+
 def setup(test: dict, db: Optional[DB] = None) -> None:
     """Sets up the DB on all nodes in parallel, then primary setup on
     the first node (core.clj:164-173)."""

@@ -289,8 +289,9 @@ def compose_packages(packages: Sequence[dict]) -> dict:
 def nemesis_package(opts: Optional[dict] = None) -> dict:
     """The one-stop constructor (combined.clj:508-568): opts["faults"]
     from {"partition", "kill", "pause", "packet", "clock",
-    "file-corruption", "membership"} (membership needs
+    "file-corruption", "membership", "lazyfs"} (membership needs
     opts["membership"]["state"], see nemesis/membership.py)."""
+    from ..lazyfs import lazyfs_package
     from .membership import membership_package
 
     opts = opts or {}
@@ -303,5 +304,6 @@ def nemesis_package(opts: Optional[dict] = None) -> dict:
             clock_package(opts),
             file_corruption_package(opts),
             membership_package(opts),
+            lazyfs_package(opts),
         ]
     )

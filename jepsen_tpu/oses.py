@@ -75,6 +75,80 @@ class DebianOS(OS):
 debian = DebianOS()
 
 
+class UbuntuOS(DebianOS):
+    """Ubuntu node prep (os/ubuntu.clj): Debian mechanics plus the
+    standard package load-out and a net heal."""
+
+    DEFAULT_PACKAGES = (
+        "apt-transport-https", "wget", "curl", "vim", "man-db",
+        "faketime", "ntpdate", "unzip", "iptables", "psmisc", "tar",
+        "bzip2", "iputils-ping", "iproute2", "rsyslog", "sudo",
+        "logrotate",
+    )
+
+    def __init__(self, packages: Sequence[str] = ()):
+        super().__init__(list(packages) or list(self.DEFAULT_PACKAGES))
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        super().setup(test, sess, node)
+        net = test.get("net")
+        if net is not None:
+            try:
+                net.heal(test)
+            except Exception:  # noqa: BLE001 — `meh`, like the reference
+                log.debug("net heal during OS setup failed", exc_info=True)
+
+
+ubuntu = UbuntuOS()
+
+
+class CentOSOS(OS):
+    """CentOS node prep (os/centos.clj): loopback hostname entry, yum
+    update at most daily, yum package install."""
+
+    def __init__(self, packages: Sequence[str] = ()):
+        self.packages = list(packages)
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        self.setup_hostfile(sess)
+        self.maybe_update(sess)
+        if self.packages:
+            self.install(sess, self.packages)
+
+    def setup_hostfile(self, sess: Session) -> None:
+        """Appends the hostname to the loopback line
+        (os/centos.clj:12-25)."""
+        name = sess.exec("hostname")
+        hosts = sess.exec("cat", "/etc/hosts") or ""
+        out = []
+        for line in hosts.splitlines():
+            if line.startswith("127.0.0.1") and name not in line:
+                line = f"{line} {name}"
+            out.append(line)
+        with sess.su():
+            sess.exec("tee", "/etc/hosts", stdin="\n".join(out) + "\n")
+
+    def maybe_update(self, sess: Session) -> None:
+        """yum update unless one ran in the last day
+        (os/centos.clj:27-44)."""
+        try:
+            now = int(sess.exec("date", "+%s"))
+            last = int(sess.exec("stat", "-c", "%Y", "/var/log/yum.log"))
+            if now - last < 86400:
+                return
+        except Exception:  # noqa: BLE001 — no yum.log: just update
+            pass
+        with sess.su():
+            sess.exec_star("yum", "-y", "update")
+
+    def install(self, sess: Session, packages: Sequence[str]) -> None:
+        with sess.su():
+            sess.exec("yum", "install", "-y", *packages)
+
+
+centos = CentOSOS()
+
+
 def setup(test: dict) -> None:
     """OS setup across all nodes (core.clj:92-99 with-os)."""
     osys = test.get("os") or noop

@@ -147,7 +147,14 @@ class KvdbClient(jc.Client):
         c = KvdbClient(self.register, self.set_key)
         c.node = node
         port = node_port(test, node)
-        host = "127.0.0.1" if test.get("kvdb-local", True) else str(node)
+        if test.get("kvdb-local", True):
+            host = "127.0.0.1"
+        else:
+            # "host:sshport" node names (localhost clusters) dial the
+            # host part; the kvdb port is test["kvdb-port"].
+            from ..control.core import split_host_port
+
+            host, _ = split_host_port(node)
         c.sock = socket.create_connection((host, port), timeout=2.0)
         c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         c.f = c.sock.makefile("rw", encoding="utf-8", newline="\n")
@@ -308,8 +315,7 @@ def _extra_opts(p) -> None:
 def main(argv=None) -> int:
     """CLI entry (zookeeper.clj:139-145)."""
 
-    def suite(opt_map: dict) -> dict:
-        t = kvdb_test(opt_map)
+    def _localize(t: dict, opt_map: dict) -> dict:
         # kvdb is an UNREPLICATED store: N nodes would be N independent
         # registers, which no checker should call one linearizable
         # object.  The suite drives a single instance; the faults that
@@ -324,8 +330,21 @@ def main(argv=None) -> int:
         t.setdefault("remote", LocalRemote())
         return t
 
+    def suite(opt_map: dict) -> dict:
+        return _localize(kvdb_test(opt_map), opt_map)
+
+    def all_suites(opt_map: dict):
+        """test-all matrix: both workloads across the fault set
+        (cli.clj:501-529 pattern)."""
+        for workload in ("register", "set"):
+            for faults in (["kill"], ["pause"]):
+                o = dict(opt_map, workload=workload, faults=faults)
+                t = _localize(kvdb_test(o), o)
+                t["name"] = f"kvdb-{workload}-{'-'.join(faults)}"
+                yield t
+
     parser = jcli.single_test_cmd(
-        suite, name="kvdb", extra_opts=_extra_opts
+        suite, name="kvdb", extra_opts=_extra_opts, tests_fn=all_suites
     )
     return jcli.run(parser, argv)
 

@@ -8,8 +8,11 @@ in-memory reference client so every workload runs whole-stack in CI
 """
 
 from . import (
+    adya,
     append,
     bank,
+    causal,
+    causal_reverse,
     kafka,
     linearizable_register,
     long_fork,
@@ -18,8 +21,11 @@ from . import (
 )
 
 __all__ = [
+    "adya",
     "append",
     "bank",
+    "causal",
+    "causal_reverse",
     "kafka",
     "linearizable_register",
     "long_fork",

@@ -297,3 +297,56 @@ def test_final_generator_phased_in(tmp_path):
     out = core.run(t)
     assert out["results"]["valid"] is True
     assert out["results"]["ok-count"] > 0  # the final read happened
+
+
+def test_cli_test_all_summary_and_exit_codes(tmp_path, capsys):
+    """test-all runs every test from tests_fn, prints the grouped
+    summary, and exits with the worst outcome: 0 all-valid, 1 any
+    invalid, 2 any unknown, 255 any crashed (cli.clj:443-529)."""
+
+    class Fixed(chk.Checker):
+        def __init__(self, v):
+            self.v = v
+
+        def check(self, test, history, opts):
+            return {"valid": self.v}
+
+    def tests_fn_for(verdicts):
+        def tests_fn(opts):
+            for i, v in enumerate(verdicts):
+                if v == "crashed":
+                    # A raising checker is caught by check-safe and
+                    # becomes unknown; a client that cannot even open
+                    # crashes the run.
+                    class BoomClient(jc.Client):
+                        def open(self, test, node):
+                            raise RuntimeError("kaboom")
+
+                    t = register_test(tmp_path, client=BoomClient())
+                else:
+                    t = register_test(tmp_path, checker=Fixed(v))
+                t["name"] = f"t{i}"
+                yield t
+
+        return tests_fn
+
+    def parser_for(verdicts):
+        return cli.single_test_cmd(
+            lambda o: register_test(tmp_path),
+            tests_fn=tests_fn_for(verdicts),
+        )
+
+    argv = ["test-all", "--dummy-ssh", "--store-dir",
+            str(tmp_path / "store")]
+
+    assert cli.run(parser_for([True, True]), argv) == cli.EXIT_VALID
+    out = capsys.readouterr().out
+    assert "2 successes" in out and "Successful tests" in out
+
+    assert cli.run(parser_for([True, False]), argv) == cli.EXIT_INVALID
+    out = capsys.readouterr().out
+    assert "1 failures" in out and "Failed tests" in out
+
+    assert cli.run(parser_for([True, "unknown"]), argv) == cli.EXIT_UNKNOWN
+    # crashed beats everything: 255
+    assert cli.run(parser_for([False, "crashed"]), argv) == 255

@@ -1,0 +1,237 @@
+"""Environment-layer additions: fs_cache, faketime wrappers, lazyfs
+fault layer, and the Ubuntu/CentOS OS variants — command shapes over
+dummy remotes, real filesystem behavior for the cache."""
+
+import threading
+
+from jepsen_tpu import faketime, fs_cache, lazyfs, oses
+from jepsen_tpu.control import DummyRemote, with_sessions
+from jepsen_tpu.history import NEMESIS, Op
+
+
+def dummy_test(**kw):
+    # Explicit remote + empty ssh map: a dummy? flag would override the
+    # recording remote in default_remote.
+    t = {
+        "nodes": ["n1", "n2", "n3"],
+        "ssh": {},
+        "concurrency": 2,
+    }
+    t.setdefault("remote", kw.get("remote") or DummyRemote())
+    t.update(kw)
+    return t
+
+
+# -- fs_cache ------------------------------------------------------------
+
+
+def test_cache_string_data_file_roundtrip(tmp_path):
+    c = fs_cache.Cache(str(tmp_path / "cache"))
+    assert not c.cached(["a", 1])
+    assert c.load_string(["a", 1]) is None
+    c.save_string(["a", 1], "hello")
+    assert c.cached(["a", 1])
+    assert c.load_string(["a", 1]) == "hello"
+
+    c.save_data(["db", "license"], {"key": [1, 2, 3]})
+    assert c.load_data(["db", "license"]) == {"key": [1, 2, 3]}
+
+    src = tmp_path / "binary"
+    src.write_bytes(b"\x00\x01binary")
+    c.save_file(str(src), ["db", "1523a6b"])
+    backing = c.load_file(["db", "1523a6b"])
+    assert backing and open(backing, "rb").read() == b"\x00\x01binary"
+
+    c.clear(["a", 1])
+    assert not c.cached(["a", 1])
+    c.clear()
+    assert not c.cached(["db", "license"])
+
+
+def test_cache_path_encoding_and_locking(tmp_path):
+    c = fs_cache.Cache(str(tmp_path))
+    # Hostile path parts can't escape the root.
+    p = c.file_path(["../..", "etc/passwd"])
+    assert p.startswith(str(tmp_path))
+    order = []
+
+    def worker(i):
+        with c.locking(["shared"]):
+            order.append(("enter", i))
+            order.append(("exit", i))
+
+    ts = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    # Lock serializes: enter/exit strictly alternate.
+    for j in range(0, len(order), 2):
+        assert order[j][0] == "enter" and order[j + 1][0] == "exit"
+        assert order[j][1] == order[j + 1][1]
+
+
+def test_cache_remote_save_deploy(tmp_path):
+    c = fs_cache.Cache(str(tmp_path / "cache"))
+    remote = DummyRemote()
+    test = dummy_test(remote=remote)
+    with with_sessions(test) as t:
+        sess = t["sessions"]["n1"]
+        c.save_remote(sess, "/var/db/binary", ["kvdb", "bin"])
+        downloads = [a for a in remote.actions if "download" in a]
+        assert downloads and downloads[0]["download"] == ["/var/db/binary"]
+        c.save_string(["kvdb", "bin"], "fake-binary")
+        assert c.deploy_remote(sess, ["kvdb", "bin"], "/tmp/out") is True
+        uploads = [a for a in remote.actions if "upload" in a]
+        assert uploads and uploads[-1]["to"] == "/tmp/out"
+
+
+# -- faketime ------------------------------------------------------------
+
+
+def test_faketime_script_and_wrap_commands():
+    s = faketime.script("/usr/bin/db", init_offset=-30, rate=1.5)
+    assert 'faketime -m -f "-30s x1.5"' in s
+    assert s.endswith('/usr/bin/db.no-faketime "$@"\n') or "/usr/bin/db" in s
+
+    remote = DummyRemote()
+    test = dummy_test(remote=remote)
+    with with_sessions(test) as t:
+        sess = t["sessions"]["n1"]
+        faketime.wrap(sess, "/usr/bin/db", 10, 2.0)
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        # Dummy test(1) "succeeds", so the wrapper is rewritten in place
+        # without displacing the binary again (idempotent re-wrap).
+        assert any("tee /usr/bin/db" in c for c in cmds)
+        assert any("chmod a+x /usr/bin/db" in c for c in cmds)
+        tee = [a for a in remote.actions
+               if "cmd" in a and "tee" in a["cmd"]][0]
+        assert 'x2.0' in tee["in"]
+        faketime.unwrap(sess, "/usr/bin/db")
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any(
+            "mv /usr/bin/db.no-faketime /usr/bin/db" in c for c in cmds
+        )
+
+
+def test_faketime_rand_factor_bounds():
+    import random
+
+    rng = random.Random(1)
+    for _ in range(100):
+        r = faketime.rand_factor(2.5, rng)
+        assert 2 / (1 + 1 / 2.5) / 2.5 <= r <= 2 / (1 + 1 / 2.5)
+
+
+# -- lazyfs --------------------------------------------------------------
+
+
+def test_lazyfs_layout_and_config():
+    lz = lazyfs.LazyFS("/var/db/data")
+    assert lz.lazyfs_dir == "/var/db/data.lazyfs"
+    assert lz.data_dir == "/var/db/data.lazyfs/data"
+    cfg = lz.config()
+    assert 'fifo_path="/var/db/data.lazyfs/fifo"' in cfg
+    assert 'custom_size="0.5GB"' in cfg
+
+
+def test_lazyfs_mount_and_fault_commands():
+    remote = DummyRemote()
+    test = dummy_test(remote=remote)
+    lz = lazyfs.LazyFS("/data/db")
+    with with_sessions(test) as t:
+        sess = t["sessions"]["n1"]
+        lz.mount(sess)
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("mount-lazyfs.sh" in c and "-m /data/db" in c
+                   for c in cmds)
+        tee = [a for a in remote.actions
+               if "cmd" in a and "tee" in a["cmd"]]
+        assert any("fifo_path" in (a.get("in") or "") for a in tee)
+
+        remote.actions.clear()
+        lz.lose_unfsynced_writes(sess)
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("lazyfs::clear-cache" in c for c in cmds)
+
+
+def test_lazyfs_nemesis_and_package():
+    from jepsen_tpu import db as jdb
+    from jepsen_tpu.nemesis import combined
+
+    lost = []
+
+    class FakeDB(jdb.DB):
+        def lose_unfsynced_writes(self, test, sess, node):
+            lost.append(node)
+
+    remote = DummyRemote()
+    test = dummy_test(remote=remote, db=FakeDB())
+    with with_sessions(test):
+        nem = lazyfs.LazyFSNemesis()
+        out = nem.invoke(test, Op(type="info", f="lose-unfsynced-writes",
+                                  value=None, process=NEMESIS))
+        assert sorted(lost) == ["n1", "n2", "n3"]
+        assert out.value == {n: "lost" for n in test["nodes"]}
+
+    pkg = combined.nemesis_package(
+        {"faults": {"lazyfs"}, "interval": 0.1}
+    )
+    assert "lose-unfsynced-writes" in pkg["nemesis"].fs()
+
+
+def test_lazyfs_db_wrapper_delegates():
+    from jepsen_tpu import db as jdb
+
+    events = []
+
+    class Inner(jdb.DB):
+        def setup(self, test, sess, node):
+            events.append("inner-setup")
+
+        def teardown(self, test, sess, node):
+            events.append("inner-teardown")
+
+        def kill(self, test, sess, node):
+            events.append("inner-kill")
+
+        def log_files(self, test, sess, node):
+            return ["/var/db/log"]
+
+    lz = lazyfs.LazyFS("/data/db")
+    wrapped = lazyfs.LazyFSDB(Inner(), lz)
+    remote = DummyRemote()
+    test = dummy_test(remote=remote)
+    with with_sessions(test) as t:
+        sess = t["sessions"]["n1"]
+        wrapped.kill(test, sess, "n1")
+        assert events == ["inner-kill"]
+        files = wrapped.log_files(test, sess, "n1")
+        assert "/var/db/log" in files and lz.log_file in files
+        wrapped.teardown(test, sess, "n1")
+        assert "inner-teardown" in events
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("fusermount -uz /data/db" in c for c in cmds)
+
+
+# -- OS variants ---------------------------------------------------------
+
+
+def test_ubuntu_os_installs_packages():
+    from jepsen_tpu import net as jnet
+
+    remote = DummyRemote()
+    test = dummy_test(remote=remote, net=jnet.noop)
+    with with_sessions(test) as t:
+        oses.ubuntu.setup(test, t["sessions"]["n1"], "n1")
+    cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+    assert any("apt-get install" in c and "faketime" in c for c in cmds)
+
+
+def test_centos_os_hostfile_and_yum():
+    remote = DummyRemote()
+    test = dummy_test(remote=remote)
+    c = oses.CentOSOS(packages=["wget"])
+    with with_sessions(test) as t:
+        c.setup(test, t["sessions"]["n1"], "n1")
+    cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+    assert any("yum install -y wget" in c for c in cmds)
+    assert any("yum -y update" in c for c in cmds)

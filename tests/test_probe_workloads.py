@@ -1,0 +1,229 @@
+"""Causal, causal-reverse, and adya probe workloads + tcpdump/composed
+DB wrappers + K8sRemote command shapes."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.generator.core import limit
+from jepsen_tpu.history import NEMESIS, Op, history
+from jepsen_tpu.parallel.independent import KV
+from jepsen_tpu.workloads import adya, causal, causal_reverse
+
+
+def run_workload(wl, n_ops=200, concurrency=4):
+    test = {
+        "nodes": ["n1"],
+        "ssh": {"dummy?": True},
+        "concurrency": concurrency,
+        "client": wl["client"],
+        "generator": limit(n_ops, wl["generator"]),
+        "checker": wl["checker"],
+        "name": wl["name"],
+    }
+    return core.run(test)["results"]
+
+
+# -- causal --------------------------------------------------------------
+
+
+def test_causal_model_accepts_causal_order():
+    m = causal.CausalRegister()
+    ops = [
+        Op(type="ok", f="read-init", value=0, process=0,
+           ext={"position": 1, "link": "init"}),
+        Op(type="ok", f="write", value=1, process=0,
+           ext={"position": 2, "link": 1}),
+        Op(type="ok", f="read", value=1, process=0,
+           ext={"position": 3, "link": 2}),
+        Op(type="ok", f="write", value=2, process=0,
+           ext={"position": 4, "link": 3}),
+        Op(type="ok", f="read", value=2, process=0,
+           ext={"position": 5, "link": 4}),
+    ]
+    for op in ops:
+        m = m.step(op)
+        assert not isinstance(m, str), m
+
+
+def test_causal_model_rejects_anomalies():
+    m = causal.CausalRegister()
+    # Write out of counter order.
+    bad = m.step(Op(type="ok", f="write", value=2, process=0,
+                    ext={"position": 1, "link": "init"}))
+    assert isinstance(bad, str) and "expected value 1" in bad
+    # Broken causal link.
+    bad = m.step(Op(type="ok", f="read", value=None, process=0,
+                    ext={"position": 1, "link": 99}))
+    assert isinstance(bad, str) and "link" in bad
+    # Stale read.
+    m2 = m.step(Op(type="ok", f="write", value=1, process=0,
+                   ext={"position": 1, "link": "init"}))
+    bad = m2.step(Op(type="ok", f="read", value=0, process=0,
+                     ext={"position": 2, "link": 1}))
+    assert isinstance(bad, str)
+
+
+def test_causal_whole_stack_valid():
+    res = run_workload(causal.workload(), n_ops=60)
+    assert res["valid"] is True, res
+
+
+def test_causal_checker_flags_violation():
+    h = history([
+        Op(type="invoke", f="write", value=KV(0, 2), process=0),
+        Op(type="ok", f="write", value=KV(0, 2), process=0,
+           ext={"position": 1, "link": "init"}),
+    ])
+    from jepsen_tpu.parallel.independent import independent_checker
+
+    out = independent_checker(causal.CausalChecker()).check({}, h, {})
+    assert out["valid"] is False
+
+
+# -- causal-reverse ------------------------------------------------------
+
+
+def test_causal_reverse_precedence_and_errors():
+    h = history([
+        Op(type="invoke", f="write", value=1, process=0),
+        Op(type="ok", f="write", value=1, process=0),
+        Op(type="invoke", f="write", value=2, process=1),  # after w1 acked
+        Op(type="ok", f="write", value=2, process=1),
+        Op(type="invoke", f="read", value=None, process=2),
+        Op(type="ok", f="read", value=[2], process=2),  # sees w2, not w1!
+    ])
+    expected = causal_reverse.precedence_graph(h)
+    assert expected[2] == frozenset({1})
+    errs = causal_reverse.errors(h, expected)
+    assert errs and errs[0]["missing"] == [1]
+    out = causal_reverse.CausalReverseChecker().check({}, h, {})
+    assert out["valid"] is False
+
+
+def test_causal_reverse_whole_stack_valid():
+    res = run_workload(causal_reverse.workload({"nodes": ["n1"]}),
+                       n_ops=120)
+    assert res["valid"] is True, res
+
+
+# -- adya G2 -------------------------------------------------------------
+
+
+def test_g2_checker_counts_inserts():
+    ok2 = history([
+        Op(type="ok", f="insert", value=[1, None], process=0),
+        Op(type="ok", f="insert", value=[None, 2], process=1),
+    ])
+    assert adya.G2Checker().check({}, ok2, {})["valid"] is False
+    ok1 = history([
+        Op(type="ok", f="insert", value=[1, None], process=0),
+        Op(type="fail", f="insert", value=[None, 2], process=1),
+    ])
+    assert adya.G2Checker().check({}, ok1, {})["valid"] is True
+
+
+def test_adya_serializable_client_is_valid():
+    res = run_workload(adya.workload(), n_ops=80)
+    assert res["valid"] is True, res
+
+
+def test_adya_racy_client_caught():
+    # Barrier forces both txns of a key through the predicate read
+    # before either inserts: a guaranteed G2 for every key.
+    wl = adya.workload({"racy": True})
+    wl["client"].barrier = threading.Barrier(2)
+    res = run_workload(wl, n_ops=40, concurrency=2)
+    assert res["valid"] is False
+
+
+# -- tcpdump + composed DB ----------------------------------------------
+
+
+class ProbeAwareDummy:
+    """DummyRemote variant whose existence probes (`test -e`) fail, so
+    start_daemon's already-running check doesn't short-circuit."""
+
+    def __new__(cls):
+        from jepsen_tpu.control import DummyRemote
+
+        class _R(DummyRemote):
+            def execute(self, action):
+                out = super().execute(action)
+                if "test -e" in action.get("cmd", ""):
+                    out["exit"] = 1
+                return out
+
+        return _R()
+
+
+def test_tcpdump_db_commands():
+    from jepsen_tpu import db as jdb
+    from jepsen_tpu.control import with_sessions
+
+    remote = ProbeAwareDummy()
+    test = {"nodes": ["n1"], "ssh": {}, "remote": remote}
+    db = jdb.Tcpdump(ports=[5000, 5001], filter="host 10.0.0.1")
+    with with_sessions(test) as t:
+        sess = t["sessions"]["n1"]
+        db.setup(test, sess, "n1")
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        started = [c for c in cmds if "tcpdump" in c and "-w" in c]
+        assert started
+        assert "port 5000 or port 5001" in started[0]
+        assert "host 10.0.0.1" in started[0]
+        db.teardown(test, sess, "n1")
+        files = db.log_files(test, sess, "n1")
+        assert any(f.endswith(".pcap") for f in files)
+
+
+def test_composed_db_routes_capabilities():
+    from jepsen_tpu import db as jdb
+    from jepsen_tpu.control import DummyRemote, with_sessions
+
+    events = []
+
+    class Killable(jdb.DB):
+        def setup(self, test, sess, node):
+            events.append("db-setup")
+
+        def kill(self, test, sess, node):
+            events.append("db-kill")
+
+        def log_files(self, test, sess, node):
+            return ["/db/log"]
+
+    cap = jdb.Tcpdump(ports=[9])
+    combo = jdb.ComposedDB([cap, Killable()])
+    remote = DummyRemote()
+    test = {"nodes": ["n1"], "ssh": {}, "remote": remote}
+    with with_sessions(test) as t:
+        sess = t["sessions"]["n1"]
+        combo.setup(test, sess, "n1")
+        assert "db-setup" in events
+        combo.kill(test, sess, "n1")
+        assert "db-kill" in events
+        files = combo.log_files(test, sess, "n1")
+        assert "/db/log" in files
+        assert any("tcpdump" in f for f in files)
+        with pytest.raises(NotImplementedError):
+            combo.pause(test, sess, "n1")
+
+
+# -- K8sRemote -----------------------------------------------------------
+
+
+def test_k8s_remote_requires_kubectl():
+    import shutil
+
+    from jepsen_tpu.control import K8sRemote, RemoteError
+    from jepsen_tpu.control.core import ConnSpec
+
+    r = K8sRemote(namespace="jepsen")
+    if shutil.which("kubectl") is None:
+        with pytest.raises(RemoteError):
+            r.connect(ConnSpec("pod-1"))
+    else:  # pragma: no cover - environment-dependent
+        bound = r.connect(ConnSpec("pod-1"))
+        assert bound.namespace == "jepsen"

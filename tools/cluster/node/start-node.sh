@@ -1,0 +1,9 @@
+#!/bin/sh
+# Installs the mounted public key for root and runs sshd in the
+# foreground.
+set -eu
+if [ -f /jepsen-secret/id_ed25519.pub ]; then
+    cat /jepsen-secret/id_ed25519.pub >> /root/.ssh/authorized_keys
+    chmod 600 /root/.ssh/authorized_keys
+fi
+exec /usr/sbin/sshd -D -e
