@@ -172,6 +172,12 @@ class ComposedDB(DB):
                 return db
         return None
 
+    def supports(self, capability: str) -> bool:
+        # A wrapper "supports" a capability only if something inside
+        # does — the inherited check would see our routing methods and
+        # claim everything.
+        return self._first_with(capability) is not None
+
     def kill(self, test, sess, node):
         db = self._first_with("kill")
         if db is None:

@@ -38,7 +38,6 @@ COMMIT = "0.2.0"
 INSTALL_DIR = "/opt/jepsen-tpu/lazyfs"
 BIN = f"{INSTALL_DIR}/lazyfs/build/lazyfs"
 FUSE_DEV = "/dev/fuse"
-REAL_EXT = ".real"
 
 
 @dataclass
@@ -79,8 +78,19 @@ class LazyFS:
     # -- lifecycle --------------------------------------------------------
 
     def install(self, sess: Session) -> None:
-        """Builds lazyfs on the node (lazyfs.clj:68-108)."""
+        """Builds lazyfs on the node (lazyfs.clj:68-108).  Skips the
+        fetch + both builds when the pinned commit's binary is already
+        there — every DB cycle calls this, and `git clean -fx` would
+        otherwise force a from-scratch rebuild per run."""
         with sess.su():
+            built = sess.exec_star("test", "-x", BIN).get("exit") == 0
+            if built:
+                at = sess.exec_star(
+                    "git", "-C", INSTALL_DIR, "describe", "--tags",
+                    "--always",
+                )
+                if COMMIT in (at.get("out") or ""):
+                    return
             sess.exec(
                 "env", "DEBIAN_FRONTEND=noninteractive",
                 "apt-get", "install", "-y",

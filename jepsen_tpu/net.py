@@ -8,6 +8,14 @@ delay/loss/corrupt/duplicate/reorder/rate behaviors (:73-164).
 
 All methods act via the control-plane sessions bound in
 ``test["sessions"]`` (the reference's dynamic `c/on-nodes` binding).
+
+Addressing: iptables rules on a node name the PEER's address.  Node
+names of the form "host:port" (localhost clusters, where the host part
+is the control node's view — e.g. 127.0.0.1 with a published ssh
+port) are NOT usable as peer addresses inside the cluster; supply
+``test["node-addresses"] = {node-name: in-cluster address}`` (e.g. the
+compose service hostnames n1..n5) and the helpers below resolve
+through it, falling back to the bare host part.
 """
 
 from __future__ import annotations
@@ -15,6 +23,16 @@ from __future__ import annotations
 from typing import Any, Mapping, Optional, Sequence
 
 from .control import Session, on_nodes
+from .control.core import split_host_port
+
+
+def node_address(test: dict, node: str) -> str:
+    """The address peers use to reach `node` inside the cluster."""
+    alias = (test.get("node-addresses") or {}).get(node)
+    if alias:
+        return alias
+    host, _ = split_host_port(node)
+    return host
 
 
 class Net:
@@ -109,8 +127,8 @@ class IptablesNet(Net):
         def do(sess: Session, node: str) -> None:
             with sess.su():
                 sess.exec(
-                    "iptables", "-A", "INPUT", "-s", src,
-                    "-j", "DROP", "-w",
+                    "iptables", "-A", "INPUT", "-s",
+                    node_address(test, src), "-j", "DROP", "-w",
                 )
 
         on_nodes(test, do, [dest])
@@ -121,10 +139,13 @@ class IptablesNet(Net):
         targets = {n: sorted(cut) for n, cut in grudge.items() if cut}
 
         def do(sess: Session, node: str) -> None:
+            srcs = ",".join(
+                node_address(test, s) for s in targets[node]
+            )
             with sess.su():
                 sess.exec(
-                    "iptables", "-A", "INPUT", "-s",
-                    ",".join(targets[node]), "-j", "DROP", "-w",
+                    "iptables", "-A", "INPUT", "-s", srcs,
+                    "-j", "DROP", "-w",
                 )
 
         on_nodes(test, do, list(targets.keys()))

@@ -40,11 +40,13 @@ Execution is shaped by two measured costs (round-2 profiling):
 * Dispatch latency (~20 ms/call over a tunneled TPU): barriers are
   grouped into blocks of `bars_per_block`, and `blocks_per_call` blocks
   ship per device call — a 100k-op history runs in ~3 calls.  Inside a
-  call, an outer `lax.scan` over blocks re-lays the window and runs an
-  inner loop alternating a minimal-body fast scan (pass/direct only —
-  the member matrix is read-only there, membership of ops whose barrier
-  passed is *implied by barrier rank*) with a heavy chain-search round
-  at the barrier where the frontier died, then resumes the scan.
+  call, an outer `lax.scan` over blocks re-lays the window and scans
+  the block's barriers once: the body does the pass/direct step inline
+  (membership of ops whose barrier passed is *implied by barrier rank*,
+  so direct linearizations write no member bits) and enters the heavy
+  chain-search round behind a `lax.cond` only at barriers where the
+  frontier would die.  (An earlier fast-scan/heavy/re-scan split spent
+  ~85% of device time re-walking blocks after each heavy round.)
 
 Soundness: every transition is a legal WGL linearization step, so any
 config alive after the final barrier is a witness — `valid=True` is
@@ -110,13 +112,27 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                    jax_step):
     """One call runs NB blocks of up to K barriers each.
 
-    Args: member (B, W) bool, states (B, SW) i32, alive (B,) bool,
-    failed () bool, and per-block tensors — bars (NB, 3, K) i32 (rows:
-    window col, ret, real), tab (NB, 5, W) i32 (rows: inv, f, a0, a1,
-    bar_rank), perm (NB, W) i32 + present (NB, W) bool (member
-    re-layout from the previous block's window), k0s (NB,) i32 (global
-    rank of each block's first barrier).  Padding blocks pass identity
-    perm/present and zero `real` flags and are no-ops.
+    Args: member (W, B) bool — window-major so the per-barrier
+    membership lookup member[a] is a fast major-axis row slice (a
+    (B, W) layout makes it a minor-axis dynamic gather) —, states
+    (B, SW) i32, alive (B,) bool, failed () bool, and per-block
+    tensors — bars (NB, 6, K) i32 (rows: window col, ret, real, and
+    the barrier op's f/a0/a1 pre-gathered on host so the hot scan does
+    no table lookups), tab (NB, 5, W) i32 (rows: inv, f, a0, a1,
+    bar_rank — the heavy round's helper tables), perm (NB, W) i32 +
+    present (NB, W) bool (member re-layout from the previous block's
+    window), k0s (NB,) i32 (global rank of each block's first
+    barrier).  Padding blocks pass identity perm/present and zero
+    `real` flags and are no-ops.
+
+    The heavy chain search runs INSIDE the barrier scan behind a
+    lax.cond — round-2 profiling showed the earlier design (fast scan
+    to the death point, heavy round, masked re-scan) spent ~85% of
+    device time re-scanning: each of the ~458 heavy rounds on the
+    100k-op bench re-walked up to K barriers.  Inline, every barrier
+    is visited exactly once.
+
+    Flat (helper, lane) pair indexing is helper-major: i = h*B + lane.
     """
     import jax
     import jax.numpy as jnp
@@ -127,53 +143,27 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
     M = B * W
 
     def run_block(member, states, alive, bars, tab, k0):
-        bar_a, bar_ret, bar_real = bars[0], bars[1], bars[2]
         inv_w, f_w, a0_w, a1_w, bar_rank_w = (
             tab[0], tab[1], tab[2], tab[3], tab[4],
         )
 
-        def step_at(s, a):
-            return jax_step(s, f_w[a], a0_w[a], a1_w[a])
-
         def pair_steps(states_rep):
+            # helper-major: rows h*B+lane pair helper h with lane's state
             return jax.vmap(jax_step)(
                 states_rep,
-                jnp.tile(f_w, B),
-                jnp.tile(a0_w, B),
-                jnp.tile(a1_w, B),
+                jnp.repeat(f_w, B),
+                jnp.repeat(a0_w, B),
+                jnp.repeat(a1_w, B),
             )
 
-        # ---- fast scan: pass/direct only, member read-only ------------
-        def fast(member, states, alive, k_start):
-            def body(carry, xs):
-                states, alive, failed, fail_k, k = carry
-                a, r, real = xs
-                has = member[:, a]
-                ns, legal = jax.vmap(lambda s: step_at(s, a))(states)
-                surv_pass = alive & has
-                surv_dir = alive & ~has & legal
-                new_alive = surv_pass | surv_dir
-                ok = new_alive.any()
-                active = (real != 0) & ~failed & (k >= k_start)
-                commit = active & ok
-                states = jnp.where(commit & surv_dir[:, None], ns, states)
-                alive = jnp.where(commit, new_alive, alive)
-                died = active & ~ok
-                fail_k = jnp.where(died & (fail_k < 0), k, fail_k)
-                failed = failed | died
-                return (states, alive, failed, fail_k, k + 1), None
+        def select_children(member, child_states, good):
+            """Dedup (helper, lane) children by model state, keep <= B.
 
-            carry0 = (states, alive, jnp.bool_(False), jnp.int32(-1),
-                      jnp.int32(0))
-            (states, alive, died, fail_k, _), _ = jax.lax.scan(
-                body, carry0, (bar_a, bar_ret, bar_real)
-            )
-            return states, alive, died, fail_k
-
-        # ---- heavy chain search at one barrier ------------------------
-        def select_children(child_member, child_states, good):
-            # Dedup by model state: hash-sort + exact adjacent compare —
-            # equal states always hash equal; collisions only cost slots.
+            Selection happens over (M,) scalars FIRST; member columns
+            are materialized only for the <= B winners — building
+            (M, W) child-member matrices up front costs ~B*W*W bytes.
+            Hash-sort + exact adjacent compare: equal states always
+            hash equal; collisions only cost beam slots."""
             h = jnp.where(good, child_states.astype(jnp.float32) @ hv, BIG)
             order = jnp.argsort(h)
             hs = h[order]
@@ -185,25 +175,34 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             uniq = (hs < BIG) & ~same
             n_child = jnp.minimum(uniq.sum(), B)
             pos = order[jnp.nonzero(uniq, size=B, fill_value=0)[0]]
+            hcol = pos // B
+            lane = pos % B
+            new_member = member[:, lane] | (col[:, None] == hcol[None, :])
             new_alive = jnp.arange(B) < n_child
-            return child_member[pos], child_states[pos], new_alive
+            return new_member, child_states[pos], new_alive
 
-        def heavy(member, states, alive, a, r, k_rank):
+        def heavy(member, states, alive, a, r, bf, ba0, ba1, k_rank):
+            """Chain search at one barrier: direct -> targeted h·a ->
+            expand-any, bounded by chain depth D."""
             # Membership of ops whose barrier already passed is implied.
             implied = bar_rank_w < k_rank
 
+            def step_bar(s):
+                return jax_step(s, bf, ba0, ba1)
+
             def helper_avail(member, alive):
+                # (W, B): helper rows x lanes
                 return (
-                    alive[:, None]
+                    alive[None, :]
                     & ~member
-                    & ~implied[None, :]
-                    & (inv_w[None, :] < r)
-                    & (col[None, :] != a)
+                    & ~implied[:, None]
+                    & (inv_w[:, None] < r)
+                    & (col[:, None] != a)
                 )
 
             def try_direct(member, states, alive):
-                ns, legal = jax.vmap(lambda s: step_at(s, a))(states)
-                has = member[:, a]
+                ns, legal = jax.vmap(step_bar)(states)
+                has = member[a]
                 surv_pass = alive & has
                 surv_dir = alive & ~has & legal
                 new_alive = surv_pass | surv_dir
@@ -212,30 +211,20 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
 
             def targeted(member, states, alive):
                 avail = helper_avail(member, alive)
-                states_rep = jnp.repeat(states, W, axis=0)
+                states_rep = jnp.tile(states, (W, 1))
                 s1, legal1 = pair_steps(states_rep)
-                s2, legal2 = jax.vmap(lambda s: step_at(s, a))(s1)
+                s2, legal2 = jax.vmap(step_bar)(s1)
                 good = avail.reshape(-1) & legal1 & legal2
-                lane = jnp.arange(M) // W
-                hcol = jnp.arange(M) % W
-                child_member = member[lane] | (
-                    col[None, :] == hcol[:, None]
-                )
-                cm, cs, ca = select_children(child_member, s2, good)
+                cm, cs, ca = select_children(member, s2, good)
                 return cm, cs, ca, ca.any()
 
             def expand_any(member, states, alive):
                 avail = helper_avail(member, alive)
-                states_rep = jnp.repeat(states, W, axis=0)
+                states_rep = jnp.tile(states, (W, 1))
                 s1, legal1 = pair_steps(states_rep)
                 productive = legal1 & (s1 != states_rep).any(axis=1)
                 good = avail.reshape(-1) & productive
-                lane = jnp.arange(M) // W
-                hcol = jnp.arange(M) % W
-                child_member = member[lane] | (
-                    col[None, :] == hcol[:, None]
-                )
-                return select_children(child_member, s1, good)
+                return select_children(member, s1, good)
 
             def cond(c):
                 _, _, alive, done, d = c
@@ -270,33 +259,41 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             )
             return member, states, alive, done
 
-        # ---- block loop: fast scan until death, heavy round, resume ---
-        def outer_cond(c):
-            _, _, _, k_start, failed = c
-            return (~failed) & (k_start < K)
+        # ---- barrier scan: pass/direct inline, heavy behind a cond ----
+        def body(carry, xs):
+            member, states, alive, failed = carry
+            a, r, real, bf, ba0, ba1, k = xs
+            has = member[a]
+            ns, legal = jax.vmap(
+                lambda s: jax_step(s, bf, ba0, ba1)
+            )(states)
+            surv_pass = alive & has
+            surv_dir = alive & ~has & legal
+            new_alive = surv_pass | surv_dir
+            active = (real != 0) & ~failed
 
-        def outer_body(c):
-            member, states, alive, k_start, _ = c
-            states2, alive2, died, fail_k = fast(
-                member, states, alive, k_start
-            )
+            def easy(_):
+                commit = active & new_alive.any()
+                st = jnp.where((commit & surv_dir)[:, None], ns, states)
+                al = jnp.where(commit, new_alive, alive)
+                return member, st, al, failed
 
-            def clean(_):
-                return (member, states2, alive2, jnp.int32(K),
-                        jnp.bool_(False))
-
-            def on_death(_):
+            def hard(_):
                 m, s, al, done = heavy(
-                    member, states2, alive2,
-                    bar_a[fail_k], bar_ret[fail_k], k0 + fail_k,
+                    member, states, alive, a, r, bf, ba0, ba1, k0 + k
                 )
-                return m, s, al, fail_k + 1, ~done
+                return m, s, al, failed | ~done
 
-            return jax.lax.cond(died, on_death, clean, None)
+            out = jax.lax.cond(
+                active & ~new_alive.any(), hard, easy, None
+            )
+            return out, None
 
-        member, states, alive, _, failed = jax.lax.while_loop(
-            outer_cond, outer_body,
-            (member, states, alive, jnp.int32(0), jnp.bool_(False)),
+        carry0 = (member, states, alive, jnp.bool_(False))
+        (member, states, alive, failed), _ = jax.lax.scan(
+            body, carry0,
+            (bars[0], bars[1], bars[2], bars[3], bars[4], bars[5],
+             jnp.arange(K, dtype=jnp.int32)),
         )
         return member, states, alive, failed
 
@@ -305,7 +302,7 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         def body(carry, xs):
             member, states, alive, failed = carry
             bars_b, tab_b, perm_b, present_b, k0 = xs
-            member = jnp.where(present_b[None, :], member[:, perm_b],
+            member = jnp.where(present_b[:, None], member[perm_b],
                                False)
 
             def run(_):
@@ -378,7 +375,7 @@ def check_wgl_witness(
         fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step)
         _chunk_fn_cache[key] = fn
 
-    member = jnp.zeros((B, W), dtype=bool)
+    member = jnp.zeros((W, B), dtype=bool)
     states = jnp.tile(
         jnp.asarray(np.asarray(pm.init_state, dtype=np.int32)), (B, 1)
     )
@@ -393,7 +390,7 @@ def check_wgl_witness(
     for c0 in range(0, len(blocks), NB):
         chunk_blocks = blocks[c0 : c0 + NB]
         nblk = len(chunk_blocks)
-        bars_np = np.zeros((NB, 3, K), dtype=np.int32)
+        bars_np = np.zeros((NB, 6, K), dtype=np.int32)
         bars_np[:, 1, :] = INF
         tab_np = np.zeros((NB, 5, W), dtype=np.int32)
         perm_np = np.tile(identity_perm, (NB, 1))
@@ -407,6 +404,9 @@ def check_wgl_witness(
             bars_np[bi, 0, :nb] = np.searchsorted(active, block_bars)
             bars_np[bi, 1, :nb] = ret32[block_bars]
             bars_np[bi, 2, :nb] = 1
+            bars_np[bi, 3, :nb] = packed.f[block_bars]
+            bars_np[bi, 4, :nb] = packed.a0[block_bars]
+            bars_np[bi, 5, :nb] = packed.a1[block_bars]
             row = tab_np[bi]
             row[0, :] = INF
             row[0, :nw] = inv32[active]

@@ -40,11 +40,11 @@ def _nodes() -> list[str]:
 
 
 def _reachable(node: str) -> bool:
-    host, _, port = node.rpartition(":")
+    from jepsen_tpu.control.core import split_host_port
+
+    host, port = split_host_port(node, 22)
     try:
-        with socket.create_connection(
-            (host or node, int(port or 22)), timeout=2.0
-        ):
+        with socket.create_connection((host, port), timeout=2.0):
             return True
     except OSError:
         return False
@@ -112,23 +112,33 @@ def test_on_nodes_fanout():
 
 def test_iptables_partition_and_heal():
     """Drops links between the first two nodes with real iptables, then
-    heals — the net.clj:177-233 path that round 1 never exercised."""
+    heals — the net.clj:177-233 path that round 1 never exercised.
+
+    Against the bundled compose cluster the node names are host:port
+    views from the control machine; test["node-addresses"] maps them to
+    the in-cluster service hostnames (n1..n5) that iptables rules need.
+    """
     from jepsen_tpu import net as jnet
 
     test = ssh_test()
     if len(test["nodes"]) < 2:
         pytest.skip("needs >= 2 nodes")
     n1, n2 = test["nodes"][0], test["nodes"][1]
+    if ":" in n1:
+        test["node-addresses"] = {
+            node: f"n{i + 1}" for i, node in enumerate(test["nodes"])
+        }
     net = jnet.iptables
     with with_sessions(test) as t:
         sess1 = t["sessions"][n1]
-        h2 = sess1.exec("getent", "hosts", "n2").split()[0] \
-            if ":" in n2 else n2
+        addr2 = jnet.node_address(test, n2)
         try:
-            ping = ["ping", "-c", "1", "-W", "2", h2]
+            ping = ["ping", "-c", "1", "-W", "2", addr2]
             assert sess1.exec_star(*ping).get("exit") == 0
             net.drop(test, n2, n1)  # cut n2 -> n1... and reverse:
             net.drop(test, n1, n2)
+            # n1 can still *send* pings, but n2's replies are dropped
+            # on n1's INPUT chain (and vice versa): no round trips.
             assert sess1.exec_star(*ping).get("exit") != 0
         finally:
             net.heal(test)
