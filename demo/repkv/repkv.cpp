@@ -1,0 +1,329 @@
+// repkv: a deliberately small REPLICATED key-value store — the
+// framework's multi-node demo system, playing the role a real
+// replicated database (etcd/zookeeper) plays for the reference's
+// suites.  N processes form a primary/backup group: the primary
+// accepts writes and streams them to backups; any node serves reads.
+//
+// Replication is primary -> backup over persistent TCP connections.
+// In the default (async) mode the primary acknowledges writes without
+// waiting for backups; with --sync it waits for every *reachable*
+// backup's ack, but silently degrades to async for peers that time
+// out — exactly the kind of "mostly synchronous" replication that
+// looks linearizable until a partition makes backup reads stale.
+// Split-brain is reachable too: PROMOTE turns a backup into a second
+// primary.  The checker, not the server, is supposed to catch all of
+// this.
+//
+// Client protocol (one request per line):
+//   GET <k>              -> VAL <v> | NIL
+//   SET <k> <v>          -> OK | ERR notprimary
+//   CAS <k> <old> <new>  -> OK | FAIL | NIL | ERR notprimary
+//   PING                 -> PONG
+//   ROLE                 -> PRIMARY | BACKUP
+//   PROMOTE / DEMOTE     -> OK            (failover / fault injection)
+//   BLOCK <id>           -> OK  (drop replication to/from peer <id> —
+//   UNBLOCK <id> | *     -> OK   app-level partition injection, used
+//                                by the suite's Net implementation)
+// Peer protocol (on the same port):
+//   REPL <from> <seq> SET <k> <v>   -> ACK <seq>   (unless blocked)
+//   REPL <from> <seq> CAS ... same shape.
+//
+// Fresh implementation for this framework's demo suite.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <condition_variable>
+#include <cstdio>
+#include <cstdlib>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <set>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+int g_id = 0;
+bool g_sync = false;
+int g_ack_timeout_ms = 150;
+std::mutex g_mu;
+std::map<std::string, std::string> g_kv;
+long long g_seq = 0;          // last locally applied sequence
+bool g_primary = false;
+std::set<int> g_blocked;      // peer ids we refuse to talk to
+
+struct Peer {
+  int id;
+  std::string host;
+  int port;
+  std::mutex mu;
+  std::condition_variable cv;
+  std::deque<std::string> queue;   // REPL lines to ship
+  long long acked = 0;
+  bool stop = false;
+};
+
+std::vector<Peer*> g_peers;
+std::mutex g_ack_mu;
+std::condition_variable g_ack_cv;
+
+bool blocked(int id) {
+  std::lock_guard<std::mutex> l(g_mu);
+  return g_blocked.count(id) > 0;
+}
+
+// One writer thread per peer: connect, ship queued REPL lines, read
+// ACKs.  Reconnects forever; drops the connection while blocked.
+void peer_loop(Peer* p) {
+  int fd = -1;
+  FILE* rf = nullptr;
+  std::string carry;
+  while (true) {
+    std::string line;
+    {
+      std::unique_lock<std::mutex> l(p->mu);
+      p->cv.wait_for(l, std::chrono::milliseconds(100), [&] {
+        return p->stop || !p->queue.empty();
+      });
+      if (p->stop) break;
+      if (p->queue.empty()) continue;
+      line = p->queue.front();
+    }
+    if (blocked(p->id)) {
+      // Simulated partition: connection torn down, nothing shipped.
+      if (fd >= 0) { fclose(rf); rf = nullptr; close(fd); fd = -1; }
+      std::this_thread::sleep_for(std::chrono::milliseconds(50));
+      continue;
+    }
+    if (fd < 0) {
+      fd = socket(AF_INET, SOCK_STREAM, 0);
+      sockaddr_in a{};
+      a.sin_family = AF_INET;
+      a.sin_port = htons(p->port);
+      inet_pton(AF_INET, p->host.c_str(), &a.sin_addr);
+      if (connect(fd, (sockaddr*)&a, sizeof(a)) != 0) {
+        close(fd);
+        fd = -1;
+        std::this_thread::sleep_for(std::chrono::milliseconds(50));
+        continue;
+      }
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      rf = fdopen(fd, "r");
+    }
+    if (write(fd, line.data(), line.size()) != (ssize_t)line.size()) {
+      fclose(rf); rf = nullptr; close(fd); fd = -1;
+      continue;
+    }
+    char buf[256];
+    if (!fgets(buf, sizeof(buf), rf)) {
+      fclose(rf); rf = nullptr; close(fd); fd = -1;
+      continue;
+    }
+    long long seq = 0;
+    if (sscanf(buf, "ACK %lld", &seq) == 1) {
+      {
+        std::lock_guard<std::mutex> l(p->mu);
+        if (seq > p->acked) p->acked = seq;
+        p->queue.pop_front();
+      }
+      g_ack_cv.notify_all();
+    }
+  }
+  if (rf) fclose(rf);
+  else if (fd >= 0) close(fd);
+}
+
+// Applies a mutation under g_mu; returns the response for the client.
+std::string apply(const std::string& op, const std::string& k,
+                  const std::string& a, const std::string& b,
+                  bool* mutated) {
+  *mutated = false;
+  if (op == "SET") {
+    g_kv[k] = a;
+    *mutated = true;
+    return "OK";
+  }
+  auto it = g_kv.find(k);
+  if (it == g_kv.end()) return "NIL";
+  if (it->second != a) return "FAIL";
+  it->second = b;
+  *mutated = true;
+  return "OK";
+}
+
+// Ship an already-applied mutation to every peer; in --sync mode wait
+// for acks from unblocked peers (timeout degrades to async — the bug).
+void replicate(long long seq, const std::string& line) {
+  for (Peer* p : g_peers) {
+    std::lock_guard<std::mutex> l(p->mu);
+    p->queue.push_back(line);
+    p->cv.notify_one();
+  }
+  if (!g_sync) return;
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(g_ack_timeout_ms);
+  std::unique_lock<std::mutex> l(g_ack_mu);
+  g_ack_cv.wait_until(l, deadline, [&] {
+    for (Peer* p : g_peers) {
+      if (blocked(p->id)) continue;
+      std::lock_guard<std::mutex> pl(p->mu);
+      if (p->acked < seq) return false;
+    }
+    return true;
+  });
+}
+
+void serve(int fd) {
+  FILE* rf = fdopen(fd, "r");
+  if (!rf) { close(fd); return; }
+  char buf[4096];
+  while (fgets(buf, sizeof(buf), rf)) {
+    std::istringstream in(buf);
+    std::string cmd;
+    in >> cmd;
+    std::string resp;
+    if (cmd == "PING") {
+      resp = "PONG";
+    } else if (cmd == "GET") {
+      std::string k;
+      in >> k;
+      std::lock_guard<std::mutex> l(g_mu);
+      auto it = g_kv.find(k);
+      resp = it == g_kv.end() ? "NIL" : ("VAL " + it->second);
+    } else if (cmd == "SET" || cmd == "CAS") {
+      std::string k, a, b;
+      in >> k >> a;
+      if (cmd == "CAS") in >> b;
+      long long seq = 0;
+      bool mutated = false;
+      {
+        std::lock_guard<std::mutex> l(g_mu);
+        if (!g_primary) {
+          resp = "ERR notprimary";
+        } else {
+          resp = apply(cmd, k, a, b, &mutated);
+          if (mutated) seq = ++g_seq;
+        }
+      }
+      if (mutated) {
+        std::ostringstream repl;
+        repl << "REPL " << g_id << " " << seq << " SET " << k << " "
+             << (cmd == "SET" ? a : b) << "\n";
+        replicate(seq, repl.str());
+      }
+    } else if (cmd == "REPL") {
+      int from;
+      long long seq;
+      std::string op, k, v;
+      in >> from >> seq >> op >> k >> v;
+      if (blocked(from)) {
+        // Partitioned: swallow silently (no ack) so the sender times
+        // out, like a dropped packet.
+        continue;
+      }
+      {
+        std::lock_guard<std::mutex> l(g_mu);
+        g_kv[k] = v;
+        if (seq > g_seq) g_seq = seq;
+      }
+      resp = "ACK " + std::to_string(seq);
+    } else if (cmd == "ROLE") {
+      std::lock_guard<std::mutex> l(g_mu);
+      resp = g_primary ? "PRIMARY" : "BACKUP";
+    } else if (cmd == "PROMOTE") {
+      std::lock_guard<std::mutex> l(g_mu);
+      g_primary = true;
+      resp = "OK";
+    } else if (cmd == "DEMOTE") {
+      std::lock_guard<std::mutex> l(g_mu);
+      g_primary = false;
+      resp = "OK";
+    } else if (cmd == "BLOCK") {
+      int id;
+      in >> id;
+      std::lock_guard<std::mutex> l(g_mu);
+      g_blocked.insert(id);
+      resp = "OK";
+    } else if (cmd == "UNBLOCK") {
+      std::string id;
+      in >> id;
+      std::lock_guard<std::mutex> l(g_mu);
+      if (id == "*") g_blocked.clear();
+      else g_blocked.erase(atoi(id.c_str()));
+      resp = "OK";
+    } else {
+      resp = "ERR badcmd";
+    }
+    resp += "\n";
+    if (write(fd, resp.data(), resp.size()) != (ssize_t)resp.size())
+      break;
+  }
+  fclose(rf);
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int port = 7100;
+  std::string listen_addr = "127.0.0.1";
+  std::string peers;  // "id@host:port,id@host:port"
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() { return std::string(argv[++i]); };
+    if (a == "--port") port = atoi(next().c_str());
+    else if (a == "--listen") listen_addr = next();
+    else if (a == "--id") g_id = atoi(next().c_str());
+    else if (a == "--peers") peers = next();
+    else if (a == "--primary") g_primary = true;
+    else if (a == "--sync") g_sync = true;
+    else if (a == "--ack-timeout-ms") g_ack_timeout_ms = atoi(next().c_str());
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  std::stringstream ps(peers);
+  std::string item;
+  while (std::getline(ps, item, ',')) {
+    if (item.empty()) continue;
+    auto at = item.find('@');
+    auto colon = item.rfind(':');
+    Peer* p = new Peer();
+    p->id = atoi(item.substr(0, at).c_str());
+    p->host = item.substr(at + 1, colon - at - 1);
+    p->port = atoi(item.substr(colon + 1).c_str());
+    g_peers.push_back(p);
+    std::thread(peer_loop, p).detach();
+  }
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  inet_pton(AF_INET, listen_addr.c_str(), &addr.sin_addr);
+  if (bind(srv, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(srv, 64);
+  fprintf(stderr, "repkv id=%d %s on %s:%d (%s)\n", g_id,
+          g_primary ? "PRIMARY" : "backup", listen_addr.c_str(), port,
+          g_sync ? "sync" : "async");
+  while (true) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    int nd = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &nd, sizeof(nd));
+    std::thread(serve, fd).detach();
+  }
+}

@@ -80,14 +80,29 @@ class Linearizable(Checker):
         # Device-first paths.
         from ..ops.wgl import check_wgl_device
 
-        res = check_wgl_device(
-            packed,
-            pm,
-            beam=self.beam,
-            max_beam=self.max_beam,
-            block=self.block,
-            time_limit_s=self.time_limit_s,
-        )
+        try:
+            res = check_wgl_device(
+                packed,
+                pm,
+                beam=self.beam,
+                max_beam=self.max_beam,
+                block=self.block,
+                time_limit_s=self.time_limit_s,
+            )
+        except RuntimeError as e:
+            # No usable accelerator (backend init failure): the CPU
+            # search still settles the verdict rather than letting
+            # check-safe degrade it to unknown.
+            if "backend" not in str(e).lower():
+                raise
+            res = check_wgl_cpu(
+                packed,
+                pm,
+                max_configs=self.max_configs,
+                time_limit_s=self.time_limit_s,
+            )
+            return self._render(res, packed, "wgl-cpu-nobackend", model,
+                                pm, opts=opts)
         used = "wgl-tpu"
         if res.valid is False and not res.final_configs and (
             packed.n <= CPU_FALLBACK_MAX_OPS

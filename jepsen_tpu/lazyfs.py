@@ -78,11 +78,22 @@ class LazyFS:
     # -- lifecycle --------------------------------------------------------
 
     def install(self, sess: Session) -> None:
-        """Builds lazyfs on the node (lazyfs.clj:68-108).  Skips the
-        fetch + both builds when the pinned commit's binary is already
-        there — every DB cycle calls this, and `git clean -fx` would
-        otherwise force a from-scratch rebuild per run."""
+        """Builds lazyfs on the node (lazyfs.clj:68-108).  Node
+        environment prep (fuse device, fuse.conf) always runs — a fresh
+        container may carry a prebuilt /opt volume; only the fetch +
+        builds are skipped when the pinned commit's binary is already
+        there (every DB cycle calls this, and `git clean -fx` would
+        otherwise force a from-scratch rebuild per run)."""
         with sess.su():
+            # Environment prep: idempotent, must run even when the
+            # binary is cached (LXC/containers lose /dev/fuse).
+            if sess.exec_star("test", "-e", FUSE_DEV).get("exit") != 0:
+                sess.exec("mknod", FUSE_DEV, "c", "10", "229")
+                sess.exec("chmod", "a+rw", FUSE_DEV)
+            sess.exec(
+                "sed", "-i", r"/\s*user_allow_other/s/^#//g",
+                "/etc/fuse.conf",
+            )
             built = sess.exec_star("test", "-x", BIN).get("exit") == 0
             if built:
                 at = sess.exec_star(
@@ -96,13 +107,6 @@ class LazyFS:
                 "apt-get", "install", "-y",
                 "g++", "cmake", "libfuse3-dev", "libfuse3-3", "fuse3",
                 "git",
-            )
-            if sess.exec_star("test", "-e", FUSE_DEV).get("exit") != 0:
-                sess.exec("mknod", FUSE_DEV, "c", "10", "229")
-                sess.exec("chmod", "a+rw", FUSE_DEV)
-            sess.exec(
-                "sed", "-i", r"/\s*user_allow_other/s/^#//g",
-                "/etc/fuse.conf",
             )
             if sess.exec_star("test", "-e", INSTALL_DIR).get("exit") != 0:
                 sess.exec("mkdir", "-p",

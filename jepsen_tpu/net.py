@@ -31,7 +31,16 @@ def node_address(test: dict, node: str) -> str:
     alias = (test.get("node-addresses") or {}).get(node)
     if alias:
         return alias
-    host, _ = split_host_port(node)
+    host, port = split_host_port(node)
+    if port is not None and host in ("127.0.0.1", "localhost", "::1"):
+        # A loopback host:port name is the CONTROL node's view; as a
+        # peer address it would blackhole the node's own loopback
+        # instead of partitioning anything — fail loudly rather than
+        # inject the wrong fault.
+        raise ValueError(
+            f"node {node!r} is a control-side loopback view; supply "
+            f'test["node-addresses"] with in-cluster addresses'
+        )
     return host
 
 

@@ -1,0 +1,347 @@
+"""repkv suite: the framework against a real REPLICATED system.
+
+The multi-node analog of suites/kvdb.py, in the reference's canonical
+suite shape (zookeeper/src/jepsen/zookeeper.clj:40-145): compile the
+C++ primary/backup store (demo/repkv/repkv.cpp) on every node, boot
+the group, run a register workload where writes go to the primary and
+reads go to each client's own node, inject partitions + kills, and
+check linearizability on the device.
+
+The interesting physics: repkv's replication is asynchronous (or
+"sync until a peer times out" with --sync), so a partitioned backup
+serves stale reads — a real, checker-visible linearizability
+violation produced by a real distributed system, not a seeded fake.
+`--safe-reads` routes reads to the primary too, which restores
+linearizability under the same faults (the demo's control group).
+
+Partitions use the suite's RepkvNet: the `Net` protocol implemented
+with repkv's BLOCK/UNBLOCK admin commands instead of iptables — the
+same declarative partition packages drive either transport.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import zlib
+from typing import Any, Optional
+
+from .. import cli as jcli
+from .. import client as jc
+from .. import db as jdb
+from .. import net as jnet
+from ..checker.linearizable import Linearizable
+from ..control import Session
+from ..control import util as cutil
+from ..generator.core import mix, nemesis as gen_nemesis, phases, stagger, time_limit
+from ..history import FAIL, OK, Op
+from ..models import cas_register
+from ..nemesis.combined import nemesis_package
+
+REPKV_SRC = os.path.join(
+    os.path.dirname(__file__), "..", "..", "demo", "repkv", "repkv.cpp"
+)
+BASE_PORT = 7300
+
+
+def node_index(test: dict, node: str) -> int:
+    return (test.get("nodes") or []).index(node)
+
+
+def node_port(test: dict, node: str) -> int:
+    return test.get("repkv-base-port", BASE_PORT) + 1 + node_index(test, node)
+
+
+def node_dir(test: dict, node: str) -> str:
+    root = test.get("repkv-dir", "/tmp/jepsen-repkv")
+    return f"{root}/{node}"
+
+
+def primary_node(test: dict) -> str:
+    return (test.get("nodes") or ["n1"])[0]
+
+
+class RepkvDB(jdb.DB):
+    """Compile + daemonize one group member per node."""
+
+    def _paths(self, test: dict, node: str) -> dict:
+        d = node_dir(test, node)
+        return {
+            "dir": d,
+            "src": f"{d}/repkv.cpp",
+            "bin": f"{d}/repkv",
+            "pid": f"{d}/repkv.pid",
+            "log": f"{d}/repkv.log",
+        }
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec("mkdir", "-p", p["dir"])
+        sess.upload(os.path.abspath(REPKV_SRC), p["src"])
+        sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        self.start(test, sess, node)
+        cutil.await_tcp_port(
+            sess, node_port(test, node), timeout_s=30, interval_s=0.1
+        )
+
+    def start(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        nodes = test.get("nodes") or []
+        me = node_index(test, node)
+        peers = ",".join(
+            f"{i}@127.0.0.1:{node_port(test, n)}"
+            for i, n in enumerate(nodes)
+            if n != node
+        )
+        args = [
+            "--id", str(me),
+            "--port", str(node_port(test, node)),
+            "--peers", peers,
+        ]
+        if node == primary_node(test):
+            args.append("--primary")
+        if test.get("repkv-sync", True):
+            args.append("--sync")
+        cutil.start_daemon(
+            sess, p["bin"], *args, pidfile=p["pid"], logfile=p["log"]
+        )
+        try:
+            cutil.await_tcp_port(
+                sess, node_port(test, node), timeout_s=10, interval_s=0.05
+            )
+        except Exception:  # noqa: BLE001 — best-effort, like kvdb
+            pass
+
+    def kill(self, test: dict, sess: Session, node: str) -> None:
+        cutil.stop_daemon(sess, self._paths(test, node)["pid"],
+                          signal="KILL")
+
+    def pause(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec_star("bash", "-c", f"kill -STOP $(cat {p['pid']})")
+
+    def resume(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec_star("bash", "-c", f"kill -CONT $(cat {p['pid']})")
+
+    def primaries(self, test: dict):
+        """Ask every node its ROLE (db.clj Primary, :35-42)."""
+        out = []
+        for node in test.get("nodes") or []:
+            try:
+                with socket.create_connection(
+                    ("127.0.0.1", node_port(test, node)), timeout=1.0
+                ) as s:
+                    f = s.makefile("rw", newline="\n")
+                    f.write("ROLE\n")
+                    f.flush()
+                    if (f.readline() or "").strip() == "PRIMARY":
+                        out.append(node)
+            except OSError:
+                continue
+        return out
+
+    def teardown(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        cutil.stop_daemon(sess, p["pid"])
+        if not test.get("leave-db-running"):
+            sess.exec("rm", "-rf", p["dir"])
+
+    def log_files(self, test: dict, sess: Session, node: str):
+        return [self._paths(test, node)["log"]]
+
+
+class RepkvNet(jnet.Net):
+    """The Net protocol over repkv's BLOCK/UNBLOCK admin commands:
+    partition packages work unchanged, no iptables required."""
+
+    def _admin(self, test: dict, node: str, line: str) -> str:
+        with socket.create_connection(
+            ("127.0.0.1", node_port(test, node)), timeout=2.0
+        ) as s:
+            f = s.makefile("rw", newline="\n")
+            f.write(line + "\n")
+            f.flush()
+            return (f.readline() or "").strip()
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        self._admin(test, dest, f"BLOCK {node_index(test, src)}")
+
+    def drop_all(self, test: dict, grudge) -> None:
+        for node, cut in grudge.items():
+            for src in cut:
+                self.drop(test, src, node)
+
+    def heal(self, test: dict) -> None:
+        for node in test.get("nodes") or []:
+            try:
+                self._admin(test, node, "UNBLOCK *")
+            except OSError:
+                continue  # killed node: nothing to heal
+
+
+class RepkvClient(jc.Client):
+    """One connection to the client's own node (reads) and one to the
+    primary (writes), unless safe-reads routes everything primary-ward."""
+
+    def __init__(self, key: str = "x"):
+        self.key = key
+        self.read_sock = None
+        self.write_sock = None
+        self.node: Any = None
+
+    def open(self, test, node):
+        c = RepkvClient(self.key)
+        c.node = node
+        read_node = (
+            primary_node(test) if test.get("repkv-safe-reads") else node
+        )
+        c.read_sock = self._dial(test, read_node)
+        c.write_sock = self._dial(test, primary_node(test))
+        return c
+
+    def _dial(self, test, node):
+        s = socket.create_connection(
+            ("127.0.0.1", node_port(test, node)), timeout=2.0
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s.makefile("rw", newline="\n")
+
+    def _round_trip(self, f, line: str) -> str:
+        f.write(line + "\n")
+        f.flush()
+        resp = f.readline()
+        if not resp:
+            raise ConnectionError("repkv closed the connection")
+        return resp.strip()
+
+    def invoke(self, test, op):
+        if op.f == "read":
+            resp = self._round_trip(self.read_sock, f"GET {self.key}")
+            if resp == "NIL":
+                return op.complete(OK, value=None)
+            return op.complete(OK, value=int(resp.split(" ", 1)[1]))
+        if op.f == "write":
+            resp = self._round_trip(
+                self.write_sock, f"SET {self.key} {op.value}"
+            )
+            if resp == "OK":
+                return op.complete(OK)
+            return op.complete(FAIL, error=resp)
+        # cas
+        old, new = op.value
+        resp = self._round_trip(
+            self.write_sock, f"CAS {self.key} {old} {new}"
+        )
+        if resp == "OK":
+            return op.complete(OK)
+        if resp in ("FAIL", "NIL"):
+            return op.complete(FAIL)
+        return op.complete(FAIL, error=resp)
+
+    def close(self, test):
+        for f in (self.read_sock, self.write_sock):
+            try:
+                if f is not None:
+                    f.close()
+            except OSError:
+                pass
+
+
+def repkv_test(opts: dict) -> dict:
+    """Test-map assembly (zookeeper.clj:112-137 shape)."""
+    import itertools
+    import random
+
+    nodes = (opts.get("nodes") or ["n1", "n2", "n3"])[:5]
+    faults = set(opts.get("faults") or ["partition"])
+    rng = random.Random(opts.get("seed"))
+    # Unique, monotonically increasing write values: a stale read of an
+    # old value is then unambiguous — with a small value space a
+    # re-write of the same value could legitimately explain it.
+    counter = itertools.count(1)
+
+    def workload_gen():
+        # All three must be fn-generators: a bare map is one-shot
+        # (generator.clj:566-570), so a dict in a mix emits once ever.
+        return mix([
+            lambda: {"f": "read", "value": None},
+            lambda: {"f": "write", "value": next(counter)},
+            lambda: {"f": "cas",
+                     "value": (rng.randrange(1, 10) * 7919,
+                               next(counter))},
+        ])
+
+    pkg = nemesis_package({
+        "faults": faults,
+        "interval": opts.get("interval", 3.0),
+        "partition": {"targets": opts.get("partition-targets",
+                                          ["one", "majority"])},
+    })
+    generator = time_limit(
+        opts.get("time-limit", 15.0),
+        gen_nemesis(
+            pkg["generator"],
+            stagger(1.0 / opts.get("rate", 100), workload_gen()),
+        ),
+    )
+    if pkg.get("final-generator"):
+        generator = phases(generator, gen_nemesis(pkg["final-generator"]))
+
+    store_root = os.path.abspath(opts.get("store-dir") or "store")
+    test = {
+        "name": "repkv-register",
+        "nodes": nodes,
+        "db": RepkvDB(),
+        "net": RepkvNet(),
+        "client": RepkvClient(),
+        "nemesis": pkg["nemesis"],
+        "generator": generator,
+        "model": cas_register(),
+        "checker": Linearizable(
+            algorithm=opts.get("algorithm", "wgl-tpu"),
+            time_limit_s=60.0,
+        ),
+        "repkv-sync": opts.get("sync", True),
+        "repkv-safe-reads": opts.get("safe-reads", False),
+        "repkv-dir": opts.get("repkv-dir") or os.path.join(
+            store_root, "repkv-data"
+        ),
+        "repkv-base-port": BASE_PORT + (
+            zlib.crc32(store_root.encode()) % 2000
+        ) * 10,
+    }
+    return test
+
+
+def _extra_opts(p) -> None:
+    p.add_argument("--faults", action="append", default=None,
+                   choices=["partition", "kill", "pause"])
+    p.add_argument("--rate", type=float, default=100.0)
+    p.add_argument("--interval", type=float, default=3.0)
+    p.add_argument("--no-sync", dest="sync", action="store_false",
+                   help="fully asynchronous replication")
+    p.add_argument("--safe-reads", action="store_true",
+                   help="route reads to the primary (the control group)")
+    p.add_argument("--algorithm", default="wgl-tpu",
+                   choices=["cpu", "wgl", "wgl-tpu"])
+
+
+def main(argv=None) -> int:
+    def suite(opt_map: dict) -> dict:
+        from ..control import LocalRemote
+
+        t = repkv_test(opt_map)
+        t.setdefault("remote", LocalRemote())
+        return t
+
+    parser = jcli.single_test_cmd(
+        suite, name="repkv", extra_opts=_extra_opts
+    )
+    return jcli.run(parser, argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -124,13 +124,17 @@ def test_iptables_partition_and_heal():
     if len(test["nodes"]) < 2:
         pytest.skip("needs >= 2 nodes")
     n1, n2 = test["nodes"][0], test["nodes"][1]
-    if ":" in n1:
-        test["node-addresses"] = {
-            node: f"n{i + 1}" for i, node in enumerate(test["nodes"])
-        }
     net = jnet.iptables
     with with_sessions(test) as t:
         sess1 = t["sessions"][n1]
+        if ":" in n1:
+            # host:port node names are the control machine's view; ask
+            # each node its own in-cluster hostname rather than
+            # assuming list order matches service numbering.
+            test["node-addresses"] = {
+                node: t["sessions"][node].exec("hostname")
+                for node in test["nodes"]
+            }
         addr2 = jnet.node_address(test, n2)
         try:
             ping = ["ping", "-c", "1", "-W", "2", addr2]

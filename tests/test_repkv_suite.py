@@ -1,0 +1,73 @@
+"""Whole-framework integration against the replicated C++ store
+(demo/repkv): three real processes, primary/backup replication, real
+partitions injected through the Net protocol (repkv's BLOCK admin
+command), linearizability checked on the device path.
+
+The physics under test: backup reads + a partition produce *stale
+reads* — genuine linearizability violations from a genuine distributed
+system — while routing reads to the primary (safe-reads) restores
+validity under identical faults."""
+
+import os
+
+import pytest
+
+from jepsen_tpu import core
+from jepsen_tpu.control import LocalRemote
+from jepsen_tpu.suites import repkv
+
+
+def run_repkv(tmp_path, **opts):
+    o = {
+        "nodes": ["n1", "n2", "n3"],
+        "store-dir": str(tmp_path / "store"),
+        "time-limit": 8.0,
+        "rate": 120.0,
+        "interval": 1.5,
+        "concurrency": 6,
+        "algorithm": "wgl-tpu",
+    }
+    o.update(opts)
+    test = repkv.repkv_test(o)
+    test["remote"] = LocalRemote()
+    test["concurrency"] = o["concurrency"]
+    test["store-dir"] = o["store-dir"]
+    return core.run(test)
+
+
+@pytest.mark.slow
+def test_safe_reads_valid_under_partitions(tmp_path):
+    done = run_repkv(tmp_path, **{"safe-reads": True,
+                                  "faults": ["partition"]})
+    res = done["results"]
+    assert res["valid"] is True, res
+    # The nemesis actually partitioned something.
+    nem_ops = [o for o in done["history"]
+               if o.process == "nemesis" and o.f == "start-partition"]
+    assert nem_ops
+
+
+@pytest.mark.slow
+def test_stale_backup_reads_caught(tmp_path):
+    """Async-visible staleness: reads served by partitioned backups must
+    produce an invalid linearizability verdict."""
+    for attempt in range(3):
+        done = run_repkv(
+            tmp_path / f"a{attempt}",
+            **{"safe-reads": False, "faults": ["partition"],
+               "time-limit": 10.0, "interval": 1.0, "seed": attempt},
+        )
+        res = done["results"]
+        if res["valid"] is False:
+            return  # caught the stale read
+    pytest.fail(f"3 partitioned runs never produced a violation: {res}")
+
+
+@pytest.mark.slow
+def test_primary_reflection_and_kill_recovery(tmp_path):
+    done = run_repkv(tmp_path, **{"safe-reads": True, "faults": ["kill"],
+                                  "time-limit": 6.0})
+    res = done["results"]
+    # Kills hit random nodes; killed-primary windows make writes fail,
+    # which is fine — validity must hold because reads are safe.
+    assert res["valid"] in (True, "unknown"), res
