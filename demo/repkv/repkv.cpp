@@ -118,6 +118,13 @@ void peer_loop(Peer* p) {
       }
       int one = 1;
       setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      // Bounded ack wait: a receiver that swallows a REPL line (its
+      // side of a partition) must not wedge this thread in fgets
+      // forever — timeout, drop the conn, retry the queued line.
+      timeval tv{};
+      tv.tv_sec = 0;
+      tv.tv_usec = 500 * 1000;
+      setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
       rf = fdopen(fd, "r");
     }
     if (write(fd, line.data(), line.size()) != (ssize_t)line.size()) {

@@ -90,10 +90,6 @@ class LazyFS:
             if sess.exec_star("test", "-e", FUSE_DEV).get("exit") != 0:
                 sess.exec("mknod", FUSE_DEV, "c", "10", "229")
                 sess.exec("chmod", "a+rw", FUSE_DEV)
-            sess.exec(
-                "sed", "-i", r"/\s*user_allow_other/s/^#//g",
-                "/etc/fuse.conf",
-            )
             built = sess.exec_star("test", "-x", BIN).get("exit") == 0
             if built:
                 at = sess.exec_star(
@@ -101,12 +97,28 @@ class LazyFS:
                     "--always",
                 )
                 if COMMIT in (at.get("out") or ""):
+                    # Cached build: fuse.conf exists iff fuse3 was ever
+                    # installed; gate the sed so a stripped image
+                    # doesn't crash here.
+                    if sess.exec_star(
+                        "test", "-e", "/etc/fuse.conf"
+                    ).get("exit") == 0:
+                        sess.exec(
+                            "sed", "-i",
+                            r"/\s*user_allow_other/s/^#//g",
+                            "/etc/fuse.conf",
+                        )
                     return
             sess.exec(
                 "env", "DEBIAN_FRONTEND=noninteractive",
                 "apt-get", "install", "-y",
                 "g++", "cmake", "libfuse3-dev", "libfuse3-3", "fuse3",
                 "git",
+            )
+            # fuse3 ships /etc/fuse.conf; enable user_allow_other.
+            sess.exec(
+                "sed", "-i", r"/\s*user_allow_other/s/^#//g",
+                "/etc/fuse.conf",
             )
             if sess.exec_star("test", "-e", INSTALL_DIR).get("exit") != 0:
                 sess.exec("mkdir", "-p",

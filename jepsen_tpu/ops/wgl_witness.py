@@ -79,8 +79,19 @@ def _state_hash_vec(sw: int, seed: int = 0xA11CE) -> np.ndarray:
     return rng.uniform(1.0, 2.0, size=(sw,)).astype(np.float32)
 
 
-def _plan_blocks(packed: PackedOps, bars_per_block: int):
-    """Host-side plan: barrier order, per-block active windows."""
+def _plan_blocks(packed: PackedOps, bars_per_block: int,
+                 info_window: Optional[int] = None):
+    """Host-side plan: barrier order, per-block active windows.
+
+    `info_window` keeps only the most recently invoked N indeterminate
+    ops in each block's window.  Dropping an info column is SOUND for
+    the witness tier regardless of its membership state — an
+    unlinearized one merely stops being a helper candidate
+    (completeness loss only), and a linearized one keeps its state
+    contribution while becoming un-relinearizable.  Without the bound,
+    info ops accumulate for the whole run (ret = ∞) and the window —
+    hence heavy-round cost — grows linearly with history length: the
+    1M-op bench config reaches W = 65536 unbounded."""
     status = packed.status
     inv32 = packed.inv.astype(np.int32)
     ret32 = np.minimum(packed.ret, np.int64(INF)).astype(np.int32)
@@ -88,23 +99,33 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int):
     bars = ok_rows[np.argsort(ret32[ok_rows], kind="stable")]
     bar_rank = np.full(packed.n, NO_BAR, dtype=np.int64)
     bar_rank[bars] = np.arange(len(bars))
+    is_info = status != ST_OK
     blocks = []
     for k0 in range(0, len(bars), bars_per_block):
         block_bars = bars[k0 : k0 + bars_per_block]
         end_ret = int(ret32[block_bars[-1]])
         # Window: ops invoked before the block's last barrier whose own
         # barrier hasn't passed by block start (info ops never pass).
-        active = np.nonzero((inv32 < end_ret) & (bar_rank >= k0))[0]
+        live = (inv32 < end_ret) & (bar_rank >= k0)
+        if info_window is not None:
+            info_live = np.nonzero(live & is_info)[0]
+            if len(info_live) > info_window:
+                # Rows are invocation-ordered: keep the newest N.
+                drop = info_live[: len(info_live) - info_window]
+                live = live.copy()
+                live[drop] = False
+        active = np.nonzero(live)[0]
         blocks.append((k0, block_bars, active))
     return bars, bar_rank, inv32, ret32, blocks
 
 
-def plan_width(packed: PackedOps, bars_per_block: int = 1024) -> int:
+def plan_width(packed: PackedOps, bars_per_block: int = 1024,
+               info_window: Optional[int] = 4096) -> int:
     """The window width a witness run over `packed` will use — lets a
     warm-up run pre-compile the same kernel via `width_hint`."""
     if packed.n == 0 or packed.n_ok == 0:
         return 0
-    _, _, _, _, blocks = _plan_blocks(packed, bars_per_block)
+    _, _, _, _, blocks = _plan_blocks(packed, bars_per_block, info_window)
     return _bucket(max(max(len(a) for _, _, a in blocks), 1))
 
 
@@ -331,6 +352,7 @@ def check_wgl_witness(
     bars_per_block: int = 1024,
     blocks_per_call: int = 32,
     depth: int = 5,
+    info_window: Optional[int] = 4096,
     max_window: int = 32768,
     width_hint: int = 0,
     time_limit_s: Optional[float] = None,
@@ -353,7 +375,7 @@ def check_wgl_witness(
                          elapsed_s=time.monotonic() - t0)
 
     bars, bar_rank, inv32, ret32, blocks = _plan_blocks(
-        packed, bars_per_block
+        packed, bars_per_block, info_window
     )
     n_bars = len(bars)
     if max(len(a) for _, _, a in blocks) > max_window:

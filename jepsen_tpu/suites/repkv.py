@@ -61,6 +61,19 @@ def primary_node(test: dict) -> str:
     return (test.get("nodes") or ["n1"])[0]
 
 
+def node_host(test: dict, node: str) -> str:
+    """Where clients/peers dial this node: loopback in the default
+    local topology, the node's host part against real machines
+    (test["repkv-local"] = False) — the kvdb-local pattern
+    (suites/kvdb.py:150-158)."""
+    if test.get("repkv-local", True):
+        return "127.0.0.1"
+    from ..control.core import split_host_port
+
+    host, _ = split_host_port(node)
+    return host
+
+
 class RepkvDB(jdb.DB):
     """Compile + daemonize one group member per node."""
 
@@ -89,7 +102,7 @@ class RepkvDB(jdb.DB):
         nodes = test.get("nodes") or []
         me = node_index(test, node)
         peers = ",".join(
-            f"{i}@127.0.0.1:{node_port(test, n)}"
+            f"{i}@{node_host(test, n)}:{node_port(test, n)}"
             for i, n in enumerate(nodes)
             if n != node
         )
@@ -98,6 +111,8 @@ class RepkvDB(jdb.DB):
             "--port", str(node_port(test, node)),
             "--peers", peers,
         ]
+        if not test.get("repkv-local", True):
+            args += ["--listen", "0.0.0.0"]
         if node == primary_node(test):
             args.append("--primary")
         if test.get("repkv-sync", True):
@@ -129,14 +144,8 @@ class RepkvDB(jdb.DB):
         out = []
         for node in test.get("nodes") or []:
             try:
-                with socket.create_connection(
-                    ("127.0.0.1", node_port(test, node)), timeout=1.0
-                ) as s:
-                    f = s.makefile("rw", newline="\n")
-                    f.write("ROLE\n")
-                    f.flush()
-                    if (f.readline() or "").strip() == "PRIMARY":
-                        out.append(node)
+                if _admin_round_trip(test, node, "ROLE") == "PRIMARY":
+                    out.append(node)
             except OSError:
                 continue
         return out
@@ -155,34 +164,95 @@ class RepkvNet(jnet.Net):
     """The Net protocol over repkv's BLOCK/UNBLOCK admin commands:
     partition packages work unchanged, no iptables required."""
 
-    def _admin(self, test: dict, node: str, line: str) -> str:
-        with socket.create_connection(
-            ("127.0.0.1", node_port(test, node)), timeout=2.0
-        ) as s:
-            f = s.makefile("rw", newline="\n")
-            f.write(line + "\n")
-            f.flush()
-            return (f.readline() or "").strip()
-
     def drop(self, test: dict, src: str, dest: str) -> None:
-        self._admin(test, dest, f"BLOCK {node_index(test, src)}")
-
-    def drop_all(self, test: dict, grudge) -> None:
-        for node, cut in grudge.items():
-            for src in cut:
-                self.drop(test, src, node)
+        _admin_round_trip(test, dest, f"BLOCK {node_index(test, src)}",
+                          timeout=2.0)
 
     def heal(self, test: dict) -> None:
         for node in test.get("nodes") or []:
             try:
-                self._admin(test, node, "UNBLOCK *")
+                _admin_round_trip(test, node, "UNBLOCK *", timeout=2.0)
             except OSError:
                 continue  # killed node: nothing to heal
 
 
+def _admin_round_trip(test: dict, node: str, line: str,
+                      timeout: float = 1.0) -> str:
+    with socket.create_connection(
+        (node_host(test, node), node_port(test, node)), timeout=timeout
+    ) as s:
+        f = s.makefile("rw", newline="\n")
+        f.write(line + "\n")
+        f.flush()
+        return (f.readline() or "").strip()
+
+
+def discover_primary(test: dict) -> str:
+    """The first node whose ROLE is PRIMARY, else the static first
+    node (clients rediscover after failover)."""
+    for node in test.get("nodes") or []:
+        try:
+            if _admin_round_trip(test, node, "ROLE") == "PRIMARY":
+                return node
+        except OSError:
+            continue
+    return primary_node(test)
+
+
+class RepkvMembership:
+    """Failover state machine for the membership nemesis
+    (nemesis/membership.py): node views are each node's ROLE; when the
+    merged view shows no live primary, propose promoting the first
+    live backup; the op resolves once that node reports PRIMARY."""
+
+    def node_view(self, test, session, node):
+        try:
+            return _admin_round_trip(test, node, "ROLE")
+        except OSError:
+            return "DOWN"
+
+    def merge_views(self, test):
+        return dict(self.node_views)
+
+    def fs(self):
+        return {"promote"}
+
+    def setup(self, test):
+        return self
+
+    def op(self, test):
+        from ..generator.core import PENDING
+
+        view = self.view or {}
+        if "PRIMARY" in view.values():
+            return PENDING
+        backups = [n for n, r in view.items() if r == "BACKUP"]
+        if not backups or self.pending:
+            return PENDING
+        return {"type": "info", "f": "promote", "value": backups[0]}
+
+    def invoke(self, test, op):
+        try:
+            resp = _admin_round_trip(test, op.value, "PROMOTE")
+        except OSError as e:
+            resp = f"error: {e}"
+        return op.replace(ext=dict(op.ext, resp=resp))
+
+    def resolve(self, test):
+        return False
+
+    def resolve_op(self, test, pair):
+        inv, _ = pair
+        return (self.view or {}).get(inv.value) == "PRIMARY"
+
+    def teardown(self, test):
+        pass
+
+
 class RepkvClient(jc.Client):
     """One connection to the client's own node (reads) and one to the
-    primary (writes), unless safe-reads routes everything primary-ward."""
+    primary (writes), unless safe-reads routes everything primary-ward.
+    Writes rediscover the primary on open (failover support)."""
 
     def __init__(self, key: str = "x"):
         self.key = key
@@ -193,16 +263,21 @@ class RepkvClient(jc.Client):
     def open(self, test, node):
         c = RepkvClient(self.key)
         c.node = node
+        primary = (
+            discover_primary(test)
+            if test.get("repkv-failover")
+            else primary_node(test)
+        )
         read_node = (
-            primary_node(test) if test.get("repkv-safe-reads") else node
+            primary if test.get("repkv-safe-reads") else node
         )
         c.read_sock = self._dial(test, read_node)
-        c.write_sock = self._dial(test, primary_node(test))
+        c.write_sock = self._dial(test, primary)
         return c
 
     def _dial(self, test, node):
         s = socket.create_connection(
-            ("127.0.0.1", node_port(test, node)), timeout=2.0
+            (node_host(test, node), node_port(test, node)), timeout=2.0
         )
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return s.makefile("rw", newline="\n")
@@ -272,12 +347,20 @@ def repkv_test(opts: dict) -> dict:
                                next(counter))},
         ])
 
-    pkg = nemesis_package({
+    pkg_opts = {
         "faults": faults,
         "interval": opts.get("interval", 3.0),
         "partition": {"targets": opts.get("partition-targets",
                                           ["one", "majority"])},
-    })
+    }
+    if "membership" in faults:
+        # Failover: the membership state machine watches node ROLEs and
+        # promotes a live backup whenever the primary disappears.
+        pkg_opts["membership"] = {
+            "state": RepkvMembership(),
+            "view-interval": opts.get("view-interval", 0.5),
+        }
+    pkg = nemesis_package(pkg_opts)
     generator = time_limit(
         opts.get("time-limit", 15.0),
         gen_nemesis(
@@ -304,6 +387,7 @@ def repkv_test(opts: dict) -> dict:
         ),
         "repkv-sync": opts.get("sync", True),
         "repkv-safe-reads": opts.get("safe-reads", False),
+        "repkv-failover": "membership" in faults,
         "repkv-dir": opts.get("repkv-dir") or os.path.join(
             store_root, "repkv-data"
         ),

@@ -318,8 +318,10 @@ def test_grudge_math():
 
 def test_interpreter_throughput_floor():
     """Perf smoke (interpreter_test.clj:43-88 asserts >10k ops/s on JVM
-    at concurrency 1024; we assert a modest floor at concurrency 64
-    on the in-process noop client)."""
+    at concurrency 1024).  Measured here: ~23k ops/s at concurrency 64
+    and ~13k at 1024 on the in-process noop client; the assertion floor
+    is set low enough to survive CI noise while still catching an
+    order-of-magnitude regression."""
     import time
 
     n = 4000
@@ -331,8 +333,25 @@ def test_interpreter_throughput_floor():
     )
     dt = time.monotonic() - t0
     assert len(h) == 2 * n
-    rate = n / dt
-    assert rate > 1000, f"interpreter too slow: {rate:.0f} ops/s"
+    assert n / dt > 2000, f"interpreter too slow: {n/dt:.0f} ops/s"
+
+
+@pytest.mark.slow
+def test_interpreter_throughput_reference_shape():
+    """The reference's exact perf-test shape: concurrency 1024
+    (interpreter_test.clj:43-88).  Measured ~13k ops/s; floor 3k."""
+    import time
+
+    n = 10000
+    t0 = time.monotonic()
+    h = run_test(
+        gen.limit(n, gen.repeat({"f": "w", "value": 0})),
+        client=jc.noop,
+        concurrency=1024,
+    )
+    dt = time.monotonic() - t0
+    assert len(h) == 2 * n
+    assert n / dt > 3000, f"interpreter too slow: {n/dt:.0f} ops/s"
 
 
 def test_majorities_ring_bidirectional():

@@ -1,0 +1,78 @@
+"""Small parity modules: reconnect wrappers, report redirection, codec."""
+
+import threading
+
+import pytest
+
+from jepsen_tpu import codec, reconnect, report
+
+
+def test_codec_roundtrip():
+    for v in (None, 0, "x", [1, {"a": [2, 3]}], {"k": None}):
+        assert codec.decode(codec.encode(v)) == v
+    assert codec.encode(None) == b""
+    assert codec.decode(b"") is None
+    assert codec.decode(None) is None
+
+
+def test_report_to(tmp_path, capsys):
+    path = str(tmp_path / "sub" / "report.txt")
+    with report.to(path):
+        print("hello from the report")
+    out = capsys.readouterr().out
+    assert "Report written to" in out
+    assert open(path).read() == "hello from the report\n"
+
+
+class FlakyConn:
+    def __init__(self, generation):
+        self.generation = generation
+        self.closed = False
+
+
+def test_reconnect_reopens_on_error():
+    gen = [0]
+    closed = []
+
+    def open_conn():
+        gen[0] += 1
+        return FlakyConn(gen[0])
+
+    w = reconnect.Wrapper(
+        open=open_conn, close=lambda c: closed.append(c.generation),
+        name="test", log_reconnects=False,
+    )
+    with w.conn() as c:
+        assert c.generation == 1
+    # Same conn reused while healthy.
+    with w.conn() as c:
+        assert c.generation == 1
+    # A body error closes + reopens.
+    with pytest.raises(RuntimeError):
+        with w.conn() as c:
+            raise RuntimeError("connection reset")
+    assert closed == [1]
+    with w.conn() as c:
+        assert c.generation == 2
+    w.close()
+    assert closed == [1, 2]
+
+
+def test_reconnect_concurrent_readers():
+    w = reconnect.Wrapper(
+        open=lambda: FlakyConn(0), close=lambda c: None,
+        log_reconnects=False,
+    )
+    w.open()
+    inside = threading.Barrier(4, timeout=5)
+    done = []
+
+    def reader():
+        with w.conn():
+            inside.wait()  # all 4 readers hold the read lock at once
+        done.append(1)
+
+    ts = [threading.Thread(target=reader) for _ in range(4)]
+    [t.start() for t in ts]
+    [t.join(timeout=5) for t in ts]
+    assert len(done) == 4
