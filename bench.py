@@ -180,6 +180,10 @@ def main() -> int:
         sys.stderr.write((e.stderr or b"").decode(errors="replace"))
         for line in partial.splitlines():
             if line.startswith("{"):
+                try:
+                    json.loads(line)  # a truncated line must not pass
+                except ValueError:
+                    continue
                 print(line)
                 return 0
         emit(0.0, 0.0, error=(

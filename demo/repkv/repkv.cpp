@@ -60,6 +60,7 @@ std::map<std::string, std::string> g_kv;
 long long g_seq = 0;          // last locally applied sequence
 bool g_primary = false;
 std::set<int> g_blocked;      // peer ids we refuse to talk to
+std::map<int, long long> g_applied_from;  // per-sender dedup watermark
 
 struct Peer {
   int id;
@@ -239,9 +240,17 @@ void serve(int fd) {
         continue;
       }
       {
+        // Idempotent apply: a slow ack (> the sender's recv timeout)
+        // makes the sender re-ship the line on a fresh connection, so
+        // replays at or below the per-sender watermark are ACKed
+        // without re-applying.
         std::lock_guard<std::mutex> l(g_mu);
-        g_kv[k] = v;
-        if (seq > g_seq) g_seq = seq;
+        long long& applied = g_applied_from[from];
+        if (seq > applied) {
+          g_kv[k] = v;
+          applied = seq;
+          if (seq > g_seq) g_seq = seq;
+        }
       }
       resp = "ACK " + std::to_string(seq);
     } else if (cmd == "ROLE") {

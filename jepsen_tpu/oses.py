@@ -149,6 +149,31 @@ class CentOSOS(OS):
 centos = CentOSOS()
 
 
+class SmartOSOS(CentOSOS):
+    """SmartOS node prep (os/smartos.clj): the CentOS hostfile
+    mechanics with pkgin as the package manager."""
+
+    def maybe_update(self, sess: Session) -> None:
+        try:
+            now = int(sess.exec("date", "+%s"))
+            last = int(sess.exec(
+                "stat", "-c", "%Y", "/var/db/pkgin/pkgin.db"
+            ))
+            if now - last < 86400:
+                return
+        except Exception:  # noqa: BLE001 — no pkgin db yet: update
+            pass
+        with sess.su():
+            sess.exec_star("pkgin", "-y", "update")
+
+    def install(self, sess: Session, packages: Sequence[str]) -> None:
+        with sess.su():
+            sess.exec("pkgin", "-y", "install", *packages)
+
+
+smartos = SmartOSOS()
+
+
 def setup(test: dict) -> None:
     """OS setup across all nodes (core.clj:92-99 with-os)."""
     osys = test.get("os") or noop

@@ -155,7 +155,32 @@ class IndependentChecker(Checker):
         from ..ops.wgl_batched import check_wgl_batched
         from .mesh import checker_mesh
 
-        packs = [pack_history(subs[k], pm.encode) for k in keys]
+        all_packs = {k: pack_history(subs[k], pm.encode) for k in keys}
+        # Long keys skip the batched kernel entirely: its compile/pad
+        # cost scales with the LONGEST key, and the single-history
+        # witness-first path (check_wgl_device) is built for length.
+        long_keys = [k for k in keys if all_packs[k].n > 2000]
+        keys = [k for k in keys if all_packs[k].n <= 2000]
+        results_long: dict[Any, dict] = {}
+        if long_keys:
+            long_chk = Linearizable(
+                model, "wgl-tpu",
+                beam=lin.beam, max_beam=lin.max_beam,
+                time_limit_s=lin.time_limit_s,
+                max_configs=lin.max_configs,
+            )
+            rs = bounded_pmap(
+                lambda k: check_safe(
+                    long_chk, test, subs[k], {**opts, "history_key": k}
+                ),
+                long_keys,
+                bound=self.bound,
+            )
+            results_long = dict(zip(long_keys, rs))
+            if not keys:
+                return results_long
+
+        packs = [all_packs[k] for k in keys]
         mesh = checker_mesh(test)
         # Start the beam small — per-key histories are short, and the
         # overflow-retry doubles straight up to the configured beam.
@@ -168,7 +193,7 @@ class IndependentChecker(Checker):
             time_limit_s=lin.time_limit_s,
         )
 
-        results: dict[Any, dict] = {}
+        results: dict[Any, dict] = dict(results_long)
         for i, k in enumerate(keys):
             v = batch.valid[i]
             if v is True:

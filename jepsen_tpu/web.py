@@ -85,6 +85,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._index()
             elif path.startswith("/files/"):
                 self._file(path[len("/files/"):])
+            elif path.startswith("/zip/"):
+                self._zip(path[len("/zip/"):])
             else:
                 self._send(404, _page("404", "<p>not found</p>"))
         except BrokenPipeError:
@@ -99,18 +101,53 @@ class Handler(http.server.BaseHTTPRequestHandler):
             for t, d in sorted(runs.items(), reverse=True):
                 v = _validity(d)
                 rel = os.path.relpath(d, self.store_dir)
+                q = urllib.parse.quote(rel)
                 rows.append(
-                    f"<tr><td><a href='/files/{urllib.parse.quote(rel)}/'>"
+                    f"<tr><td><a href='/files/{q}/'>"
                     f"{html.escape(name)}</a></td>"
                     f"<td>{html.escape(t)}</td>"
-                    f"<td class='valid-{html.escape(v.lower())}'>{html.escape(v)}</td></tr>"
+                    f"<td class='valid-{html.escape(v.lower())}'>{html.escape(v)}</td>"
+                    f"<td><a href='/zip/{q}'>zip</a></td></tr>"
                 )
         body = (
-            "<table><tr><th>test</th><th>time</th><th>valid?</th></tr>"
+            "<table><tr><th>test</th><th>time</th><th>valid?</th>"
+            "<th></th></tr>"
             + "".join(rows)
             + "</table>"
         )
         self._send(200, _page("jepsen-tpu store", body))
+
+    def _zip(self, rel: str) -> None:
+        """Streams a test dir as a zip (web.clj's zip download).  Built
+        in a spooled temp file (large runs would double in RSS as a
+        BytesIO) and each member is realpath-checked like _file so a
+        symlink inside a run dir can't pull outside files into the
+        archive."""
+        import shutil
+        import tempfile
+        import zipfile
+
+        root = os.path.realpath(self.store_dir)
+        target = os.path.realpath(os.path.join(root, rel.strip("/")))
+        if not (target.startswith(root + os.sep) and os.path.isdir(target)):
+            self._send(404, _page("404", "<p>not found</p>"))
+            return
+        with tempfile.TemporaryFile() as buf:
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                for dirpath, _dirs, files in os.walk(target):
+                    for fn in files:
+                        full = os.path.join(dirpath, fn)
+                        real = os.path.realpath(full)
+                        if not real.startswith(root + os.sep):
+                            continue  # symlink escaping the store
+                        z.write(real, os.path.relpath(full, target))
+            size = buf.tell()
+            buf.seek(0)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/zip")
+            self.send_header("Content-Length", str(size))
+            self.end_headers()
+            shutil.copyfileobj(buf, self.wfile)
 
     def _file(self, rel: str) -> None:
         # Resolve inside the store dir only.

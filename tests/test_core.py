@@ -350,3 +350,30 @@ def test_cli_test_all_summary_and_exit_codes(tmp_path, capsys):
     assert cli.run(parser_for([True, "unknown"]), argv) == cli.EXIT_UNKNOWN
     # crashed beats everything: 255
     assert cli.run(parser_for([False, "crashed"]), argv) == 255
+
+
+def test_web_zip_download(tmp_path):
+    import io
+    import urllib.request
+    import zipfile
+
+    from jepsen_tpu import web
+
+    test = core.run(register_test(tmp_path))
+    d = store.test_dir(test)
+    rel = os.path.relpath(d, test["store-dir"])
+    srv = web.make_server(test["store-dir"], "127.0.0.1", 0)
+    port = srv.server_address[1]
+    thread = threading.Thread(target=srv.serve_forever, daemon=True)
+    thread.start()
+    try:
+        data = urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/zip/{rel}", timeout=5
+        ).read()
+        z = zipfile.ZipFile(io.BytesIO(data))
+        names = z.namelist()
+        assert "history.txt" in names
+        assert any(n.endswith("jepsen.log") for n in names)
+    finally:
+        srv.shutdown()
+        srv.server_close()

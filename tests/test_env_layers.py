@@ -235,3 +235,13 @@ def test_centos_os_hostfile_and_yum():
     cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
     assert any("yum install -y wget" in c for c in cmds)
     assert any("yum -y update" in c for c in cmds)
+
+
+def test_smartos_os_pkgin():
+    remote = DummyRemote()
+    test = dummy_test(remote=remote)
+    c = oses.SmartOSOS(packages=["gcc"])
+    with with_sessions(test) as t:
+        c.setup(test, t["sessions"]["n1"], "n1")
+    cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+    assert any("pkgin -y install gcc" in c for c in cmds)
