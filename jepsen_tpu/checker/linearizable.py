@@ -68,14 +68,9 @@ class Linearizable(Checker):
 
         packed = pack_history(history, pm.encode)
 
-        if algorithm in ("wgl", "linear", "cpu"):
-            res = check_wgl_cpu(
-                packed,
-                pm,
-                max_configs=self.max_configs,
-                time_limit_s=self.time_limit_s,
-            )
-            return self._render(res, packed, "wgl", model, pm, opts=opts)
+        if algorithm in ("wgl", "linear", "cpu", "event"):
+            res, engine = self._cpu_exact(packed, pm, algorithm)
+            return self._render(res, packed, engine, model, pm, opts=opts)
 
         # Device-first paths.
         from ..ops.wgl import check_wgl_device
@@ -95,13 +90,8 @@ class Linearizable(Checker):
             # check-safe degrade it to unknown.
             if "backend" not in str(e).lower():
                 raise
-            res = check_wgl_cpu(
-                packed,
-                pm,
-                max_configs=self.max_configs,
-                time_limit_s=self.time_limit_s,
-            )
-            return self._render(res, packed, "wgl-cpu-nobackend", model,
+            res, engine = self._cpu_exact(packed, pm)
+            return self._render(res, packed, f"{engine}-nobackend", model,
                                 pm, opts=opts)
         used = "wgl-tpu"
         if res.valid is False and not res.final_configs and (
@@ -116,28 +106,46 @@ class Linearizable(Checker):
             remaining = 30.0
             if self.time_limit_s is not None:
                 remaining = max(1.0, self.time_limit_s - res.elapsed_s)
-            cpu = check_wgl_cpu(
-                packed,
-                pm,
-                max_configs=self.max_configs,
-                time_limit_s=remaining,
-            )
+            cpu, _ = self._cpu_exact(packed, pm, time_limit_s=remaining)
             if cpu.valid is False:
                 res = cpu
                 used = "wgl-tpu+cpu-report"
         if res.valid == "unknown" and (
             algorithm == "competition" or packed.n <= CPU_FALLBACK_MAX_OPS
         ):
-            cpu = check_wgl_cpu(
-                packed,
-                pm,
-                max_configs=self.max_configs,
-                time_limit_s=self.time_limit_s,
-            )
+            cpu, _ = self._cpu_exact(packed, pm)
             if cpu.valid != "unknown":
                 res = cpu
                 used = "wgl-tpu+cpu-fallback"
         return self._render(res, packed, used, model, pm, opts=opts)
+
+    def _cpu_exact(self, packed, pm, algorithm: str = "auto",
+                   time_limit_s: Optional[float] = None):
+        """The exact host search -> (result, engine-label): the
+        event-walk with the info-class quotient (checker/wgl_event.py)
+        when indeterminate ops are present — identity-based DFS
+        memoization explodes on exactly those — else the memoized DFS.
+        The time limit is a call argument, never instance mutation:
+        one checker instance serves concurrent per-key threads
+        (parallel/independent.py)."""
+        from .wgl_event import check_wgl_event
+
+        limit = self.time_limit_s if time_limit_s is None else time_limit_s
+        if algorithm == "event" or (
+            algorithm != "wgl" and packed.n > packed.n_ok
+        ):
+            return check_wgl_event(
+                packed,
+                pm,
+                max_configs=self.max_configs,
+                time_limit_s=limit,
+            ), "event"
+        return check_wgl_cpu(
+            packed,
+            pm,
+            max_configs=self.max_configs,
+            time_limit_s=limit,
+        ), "wgl"
 
     def _render(
         self,

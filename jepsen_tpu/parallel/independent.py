@@ -141,7 +141,7 @@ class IndependentChecker(Checker):
             pm = model.packed()
         except (NotImplementedError, AttributeError):
             pm = None
-        if pm is None or lin.algorithm in ("wgl", "linear", "cpu"):
+        if pm is None or lin.algorithm in ("wgl", "linear", "cpu", "event"):
             rs = bounded_pmap(
                 lambda k: check_safe(
                     lin, test, subs[k], {**opts, "history_key": k}
@@ -206,9 +206,11 @@ class IndependentChecker(Checker):
                 # invalid or unknown: settle on CPU for the exact verdict
                 # and the counterexample detail (per-key histories are
                 # short; checker.clj renders these via knossos.linear.report).
+                # "cpu" auto-routes info-heavy keys to the event-walk
+                # engine, which settles cases the memoized DFS cannot.
                 single = Linearizable(
                     model,
-                    "wgl",
+                    "cpu",
                     time_limit_s=lin.time_limit_s,
                     max_configs=lin.max_configs,
                 )
