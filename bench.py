@@ -176,21 +176,47 @@ def main() -> int:
         # A child may emit its JSON and only then wedge in runtime
         # teardown: forward that line rather than printing a second,
         # contradictory one (exactly-one-JSON-line contract).
-        partial = (e.stdout or b"").decode(errors="replace")
-        sys.stderr.write((e.stderr or b"").decode(errors="replace"))
-        for line in partial.splitlines():
-            if line.startswith("{"):
-                try:
-                    json.loads(line)  # a truncated line must not pass
-                except ValueError:
-                    continue
-                print(line)
-                return 0
+        if _forward_json(e):
+            return 0
+        # Wedged accelerator runtime (observed: the tunneled TPU
+        # service hanging mid-call for hours).  One CPU retry — with a
+        # small fixed deadline so the total stays inside the driver's
+        # patience — so the round still records a real number.
+        if env.get("JEPSEN_BENCH_PLATFORM") != "cpu":
+            print("# accelerator hung; retrying on CPU", file=sys.stderr)
+            env2 = dict(env, JEPSEN_BENCH_PLATFORM="cpu")
+            try:
+                proc = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__)],
+                    timeout=180.0, env=env2, capture_output=True,
+                )
+                sys.stderr.write(proc.stderr.decode(errors="replace"))
+                sys.stdout.write(proc.stdout.decode(errors="replace"))
+                return proc.returncode
+            except subprocess.TimeoutExpired as e2:
+                if _forward_json(e2):
+                    return 0
         emit(0.0, 0.0, error=(
             f"bench hung past {deadline:.0f}s (accelerator runtime "
             f"stuck); child killed"
         ))
         return 1
+
+
+def _forward_json(e) -> bool:
+    """Scans a killed child's partial stdout for a completed JSON line
+    and forwards it; True if one was found."""
+    partial = (e.stdout or b"").decode(errors="replace")
+    sys.stderr.write((e.stderr or b"").decode(errors="replace"))
+    for line in partial.splitlines():
+        if line.startswith("{"):
+            try:
+                json.loads(line)  # a truncated line must not pass
+            except ValueError:
+                continue
+            print(line)
+            return True
+    return False
 
 
 if __name__ == "__main__":

@@ -58,15 +58,23 @@ class Linearizable(Checker):
             pm = None
 
         if pm is None:
-            res = check_wgl_host_model(
-                history,
-                model,
-                max_configs=self.max_configs,
-                time_limit_s=self.time_limit_s,
-            )
-            return self._render(res, None, "wgl-host", model, opts=opts)
+            return self._host_fallback(history, model, "wgl-host", opts)
 
-        packed = pack_history(history, pm.encode)
+        try:
+            packed = pack_history(history, pm.encode)
+        except ValueError:
+            # The history contains ops the packed form cannot encode
+            # soundly (e.g. indeterminate dequeues): host model search.
+            return self._host_fallback(
+                history, model, "wgl-host-unpackable", opts
+            )
+        if pm.validate_packed is not None:
+            reason = pm.validate_packed(packed)
+            if reason is not None:
+                return self._host_fallback(
+                    history, model, "wgl-host-unpackable", opts,
+                    reason=reason,
+                )
 
         if algorithm in ("wgl", "linear", "cpu", "event"):
             res, engine = self._cpu_exact(packed, pm, algorithm)
@@ -118,6 +126,19 @@ class Linearizable(Checker):
                 res = cpu
                 used = "wgl-tpu+cpu-fallback"
         return self._render(res, packed, used, model, pm, opts=opts)
+
+    def _host_fallback(self, history, model, label: str, opts,
+                       reason=None) -> dict:
+        res = check_wgl_host_model(
+            history,
+            model,
+            max_configs=self.max_configs,
+            time_limit_s=self.time_limit_s,
+        )
+        out = self._render(res, None, label, model, opts=opts)
+        if reason is not None:
+            out["packed-fallback-reason"] = reason
+        return out
 
     def _cpu_exact(self, packed, pm, algorithm: str = "auto",
                    time_limit_s: Optional[float] = None):

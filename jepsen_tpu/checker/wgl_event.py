@@ -38,9 +38,7 @@ Exact verdicts both ways; `max_configs`/`time_limit_s` degrade to
 from __future__ import annotations
 
 import time
-from typing import Any, Optional
-
-import numpy as np
+from typing import Optional
 
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
@@ -89,6 +87,7 @@ def check_wgl_event(
     zero_counts = (0,) * n_classes
 
     explored = 0
+    passed_mask = 0  # barriers already passed: members everywhere
     # Frontier: {(state, ok_members_mask): [count-vector antichain]}
     frontier: dict[tuple, list[tuple]] = {(init, 0): [zero_counts]}
     avail_upto = 0            # rows with index < avail_upto are available
@@ -187,8 +186,10 @@ def check_wgl_event(
             # reachable configuration — non-linearizable.
             final = []
             for (state, okm), chain in list(frontier.items())[:report_configs]:
+                members = okm | passed_mask
                 final.append({
-                    "linearized": [i for i in range(n) if okm >> i & 1],
+                    "linearized": [i for i in range(n)
+                                   if members >> i & 1],
                     "info-consumed": {
                         repr(class_ops[c]): k
                         for c, k in enumerate(chain[0]) if k
@@ -206,6 +207,7 @@ def check_wgl_event(
         # candidate pool and from the ok-membership key (its bit is
         # implied), keeping keys compact.
         avail_ok = [h for h in avail_ok if h != a]
+        passed_mask |= a_bit
         frontier = {}
         for (state, okm), chain in survivors.items():
             okm2 = okm & ~a_bit

@@ -113,6 +113,12 @@ class PackedModel:
     interner: Interner
     #: optional pretty-printer for a packed op row
     describe_op: Optional[Callable[[int, int, int], str]] = None
+    #: optional soundness gate: given the PackedOps about to be
+    #: searched, return None when the packed form is exact for this
+    #: history, or a reason string when it is not (e.g. a bounded-
+    #: capacity queue whose capacity the history could exceed) — the
+    #: checker then falls back to the host-model search.
+    validate_packed: Optional[Callable[..., Optional[str]]] = None
 
 
 def intern_value(interner: Interner, v: Any) -> int:

@@ -6,9 +6,10 @@ specialized checkers (checker.set / checker.queue / checker.total_queue)
 don't need a model at all, mirroring the reference split
 (checker.clj:235-287, 648-708).
 
-These models carry unbounded Python collections, so they have no packed
-int32 form yet; `packed()` raises, and the linearizable checker falls back
-to the CPU search for them.
+These models carry unbounded Python collections.  UnorderedQueue has a
+bounded packed int32 form (capacity-gated, see its docstring); the
+others have none — `packed()` raises and the linearizable checker falls
+back to the host-model search.
 """
 
 from __future__ import annotations
@@ -62,9 +63,20 @@ class SetModel(Model):
 
 class UnorderedQueue(Model):
     """A queue where dequeue may return any enqueued-but-not-dequeued
-    element (knossos.model/unordered-queue)."""
+    element (knossos.model/unordered-queue).
 
-    __slots__ = ("pending",)
+    Device form: a bounded multiset of `packed_capacity` int32 slots
+    (0 = empty), kept sorted for canonical equality.  The packed form
+    is exact only when the history can never hold more than
+    capacity elements; `validate_packed` checks a sound upper bound
+    (enqueues invoked so far minus dequeues completed so far, maxed
+    over the walk) and the checker falls back to the host model when
+    it could bind.  Indeterminate dequeues with unknown values have no
+    deterministic packed transition, so packing such histories raises
+    and likewise falls back."""
+
+    __slots__ = ("pending", "_packed_cache")
+    packed_capacity = 32
 
     def __init__(self, pending: Tuple[Any, ...] = ()):
         self.pending = tuple(pending)
@@ -90,6 +102,102 @@ class UnorderedQueue(Model):
 
     def __repr__(self):
         return f"UnorderedQueue({list(self.pending)!r})"
+
+    def _compile_packed(self):
+        from ..history.packed import NIL, Interner
+        from ..history.core import OK
+        from .base import PackedModel, intern_value
+
+        C = self.packed_capacity
+        if len(self.pending) > C:
+            raise NotImplementedError("initial queue exceeds capacity")
+        interner = Interner()
+        interner.intern(None)  # reserve id 0 -> code 1 for None
+        F_ENQ, F_DEQ = 0, 1
+
+        def code(v):
+            return intern_value(interner, _freeze(v)) + 1  # 0 = empty
+
+        def encode(inv, comp):
+            if inv.f == "enqueue":
+                return (F_ENQ, code(inv.value), NIL)
+            if inv.f == "dequeue":
+                if comp is None or comp.type != OK:
+                    raise ValueError(
+                        "indeterminate dequeue has no packed form"
+                    )
+                return (F_DEQ, code(comp.value), NIL)
+            raise ValueError(f"queue model can't encode f {inv.f!r}")
+
+        init = [0] * C
+        for i, v in enumerate(sorted(code(x) for x in self.pending)):
+            init[C - len(self.pending) + i] = v
+        init_state = tuple(init)
+
+        def py_step(state, f, a0, a1):
+            s = list(state)
+            if f == F_ENQ:
+                if 0 not in s:
+                    return state, False
+                s[s.index(0)] = a0
+                return tuple(sorted(s)), True
+            if a0 not in s:
+                return state, False
+            s.remove(a0)
+            return tuple(sorted([0] + s)), True
+
+        def jax_step(state, f, a0, a1):
+            import jax.numpy as jnp
+
+            is_enq = f == F_ENQ
+            has_room = (state == 0).any()
+            enq = state.at[jnp.argmin(state)].set(a0)
+            eq = state == a0
+            present = eq.any()
+            deq = jnp.where(
+                jnp.arange(state.shape[0]) == jnp.argmax(eq), 0, state
+            )
+            legal = jnp.where(is_enq, has_room, present)
+            new = jnp.where(is_enq, enq, jnp.where(present, deq, state))
+            return jnp.sort(new), legal
+
+        def validate_packed(packed) -> "str | None":
+            # Sound size bound at any linearization point t: every
+            # enqueue invoked by t could be in the queue; dequeues
+            # completed by t must already be linearized (removed).
+            size = len(self.pending)
+            worst = size
+            events = []  # (when, +1 enq-invoked / -1 deq-completed)
+            for i in range(packed.n):
+                if packed.f[i] == F_ENQ:
+                    events.append((int(packed.inv[i]), 1))
+                else:
+                    events.append((int(packed.ret[i]), -1))
+            for _, delta in sorted(events):
+                size += delta
+                worst = max(worst, size)
+            if worst > C:
+                return (
+                    f"history may hold {worst} elements; packed "
+                    f"capacity is {C}"
+                )
+            return None
+
+        def describe_op(f, a0, a1):
+            v = interner.value(a0 - 1) if a0 > 0 else "?"
+            return ("enqueue " if f == F_ENQ else "dequeue -> ") + repr(v)
+
+        return PackedModel(
+            name="unordered-queue",
+            state_width=C,
+            init_state=init_state,
+            encode=encode,
+            py_step=py_step,
+            jax_step=jax_step,
+            interner=interner,
+            describe_op=describe_op,
+            validate_packed=validate_packed,
+        )
 
 
 class FIFOQueue(Model):

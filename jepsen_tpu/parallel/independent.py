@@ -155,7 +155,35 @@ class IndependentChecker(Checker):
         from ..ops.wgl_batched import check_wgl_batched
         from .mesh import checker_mesh
 
-        all_packs = {k: pack_history(subs[k], pm.encode) for k in keys}
+        all_packs = {}
+        unpackable = []
+        for k in keys:
+            try:
+                p = pack_history(subs[k], pm.encode)
+            except ValueError:
+                # e.g. an indeterminate dequeue: no packed form for
+                # this key — the single-key checker falls back to the
+                # host-model search itself.
+                unpackable.append(k)
+                continue
+            if pm.validate_packed is not None and \
+                    pm.validate_packed(p) is not None:
+                unpackable.append(k)
+                continue
+            all_packs[k] = p
+        results_unpack: dict[Any, dict] = {}
+        if unpackable:
+            rs = bounded_pmap(
+                lambda k: check_safe(
+                    lin, test, subs[k], {**opts, "history_key": k}
+                ),
+                unpackable,
+                bound=self.bound,
+            )
+            results_unpack = dict(zip(unpackable, rs))
+            keys = [k for k in keys if k in all_packs]
+            if not keys:
+                return results_unpack
         # Long keys skip the batched kernel entirely: its compile/pad
         # cost scales with the LONGEST key, and the single-history
         # witness-first path (check_wgl_device) is built for length.
@@ -178,7 +206,7 @@ class IndependentChecker(Checker):
             )
             results_long = dict(zip(long_keys, rs))
             if not keys:
-                return results_long
+                return {**results_unpack, **results_long}
 
         packs = [all_packs[k] for k in keys]
         mesh = checker_mesh(test)
@@ -193,7 +221,7 @@ class IndependentChecker(Checker):
             time_limit_s=lin.time_limit_s,
         )
 
-        results: dict[Any, dict] = dict(results_long)
+        results: dict[Any, dict] = {**results_unpack, **results_long}
         for i, k in enumerate(keys):
             v = batch.valid[i]
             if v is True:

@@ -1,0 +1,92 @@
+"""Packed unordered-queue model: device-checkable queue
+linearizability with capacity gating (models/collections.py)."""
+
+import pytest
+
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.history.core import Op, history
+from jepsen_tpu.history.packed import pack_history
+from jepsen_tpu.models import unordered_queue
+
+
+def q(*ops):
+    return history(list(ops))
+
+
+VALID = q(
+    Op(type="invoke", f="enqueue", value=1, process=0),
+    Op(type="invoke", f="enqueue", value=2, process=1),
+    Op(type="ok", f="enqueue", value=1, process=0),
+    Op(type="ok", f="enqueue", value=2, process=1),
+    Op(type="invoke", f="dequeue", value=None, process=2),
+    Op(type="ok", f="dequeue", value=2, process=2),  # unordered: fine
+    Op(type="invoke", f="dequeue", value=None, process=0),
+    Op(type="ok", f="dequeue", value=1, process=0),
+)
+
+BAD = q(
+    Op(type="invoke", f="enqueue", value=1, process=0),
+    Op(type="ok", f="enqueue", value=1, process=0),
+    Op(type="invoke", f="dequeue", value=None, process=1),
+    Op(type="ok", f="dequeue", value=9, process=1),  # never enqueued
+)
+
+INFO_ENQ = q(
+    Op(type="invoke", f="enqueue", value=5, process=0),
+    Op(type="info", f="enqueue", value=5, process=0),  # maybe enqueued
+    Op(type="invoke", f="dequeue", value=None, process=1),
+    Op(type="ok", f="dequeue", value=5, process=1),  # proves it was
+)
+
+
+@pytest.mark.parametrize("algo", ["cpu", "wgl-tpu"])
+def test_queue_verdicts(algo):
+    for h, expect in [(VALID, True), (BAD, False), (INFO_ENQ, True)]:
+        out = Linearizable(unordered_queue(), algo).check({}, h, {})
+        assert out["valid"] is expect, (algo, out)
+
+
+def test_py_jax_step_parity():
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    pm = unordered_queue().packed()
+    packed = pack_history(VALID, pm.encode)
+    state_py = tuple(pm.init_state)
+    state_dev = jnp.asarray(np.asarray(pm.init_state, dtype=np.int32))
+    for i in range(packed.n):
+        f, a0, a1 = int(packed.f[i]), int(packed.a0[i]), int(packed.a1[i])
+        state_py, legal_py = pm.py_step(state_py, f, a0, a1)
+        state_dev, legal_dev = pm.jax_step(state_dev, f, a0, a1)
+        assert bool(legal_dev) == bool(legal_py)
+        assert tuple(np.asarray(state_dev)) == state_py
+
+
+def test_capacity_gate_falls_back_to_host():
+    class Tiny(type(unordered_queue())):
+        packed_capacity = 1
+
+    out = Linearizable(Tiny(), "wgl-tpu").check({}, VALID, {})
+    assert out["valid"] is True
+    assert "unpackable" in out["algorithm"]
+    assert "capacity" in out["packed-fallback-reason"]
+
+
+def test_info_dequeue_falls_back_to_host():
+    h = q(
+        Op(type="invoke", f="enqueue", value=1, process=0),
+        Op(type="ok", f="enqueue", value=1, process=0),
+        Op(type="invoke", f="dequeue", value=None, process=1),
+        Op(type="info", f="dequeue", value=None, process=1),
+    )
+    out = Linearizable(unordered_queue(), "wgl-tpu").check({}, h, {})
+    assert out["valid"] is True
+    assert "unpackable" in out["algorithm"]
+
+
+def test_validate_packed_bound_is_sound():
+    pm = unordered_queue().packed()
+    packed = pack_history(VALID, pm.encode)
+    # Two concurrent enqueues: bound is 2, well under capacity 32.
+    assert pm.validate_packed(packed) is None
