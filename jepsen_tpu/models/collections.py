@@ -6,10 +6,10 @@ specialized checkers (checker.set / checker.queue / checker.total_queue)
 don't need a model at all, mirroring the reference split
 (checker.clj:235-287, 648-708).
 
-These models carry unbounded Python collections.  UnorderedQueue has a
-bounded packed int32 form (capacity-gated, see its docstring); the
-others have none — `packed()` raises and the linearizable checker falls
-back to the host-model search.
+These models carry unbounded Python collections.  UnorderedQueue and
+FIFOQueue have bounded packed int32 forms (capacity-gated, see the
+UnorderedQueue docstring); SetModel has none — `packed()` raises and
+the linearizable checker falls back to the host-model search.
 """
 
 from __future__ import annotations
@@ -104,109 +104,148 @@ class UnorderedQueue(Model):
         return f"UnorderedQueue({list(self.pending)!r})"
 
     def _compile_packed(self):
-        from ..history.packed import NIL, Interner
-        from ..history.core import OK
-        from .base import PackedModel, intern_value
+        return _queue_packed(self.pending, self.packed_capacity, fifo=False)
 
-        C = self.packed_capacity
-        if len(self.pending) > C:
-            raise NotImplementedError("initial queue exceeds capacity")
-        interner = Interner()
-        interner.intern(None)  # reserve id 0 -> code 1 for None
-        F_ENQ, F_DEQ = 0, 1
 
-        def code(v):
-            return intern_value(interner, _freeze(v)) + 1  # 0 = empty
+def _queue_packed(initial, capacity: int, *, fifo: bool):
+    """Shared packed form for the bounded queues: `capacity` int32
+    slots, 0 = empty.  Unordered keeps the multiset sorted for
+    canonical equality; FIFO keeps insertion order left-aligned.  See
+    UnorderedQueue's docstring for the soundness gates."""
+    from ..history.core import OK
+    from ..history.packed import NIL, Interner
+    from .base import PackedModel, intern_value
 
-        def encode(inv, comp):
-            if inv.f == "enqueue":
-                return (F_ENQ, code(inv.value), NIL)
-            if inv.f == "dequeue":
-                if comp is None or comp.type != OK:
-                    raise ValueError(
-                        "indeterminate dequeue has no packed form"
-                    )
-                return (F_DEQ, code(comp.value), NIL)
-            raise ValueError(f"queue model can't encode f {inv.f!r}")
+    C = capacity
+    initial = tuple(initial)
+    if len(initial) > C:
+        raise NotImplementedError("initial queue exceeds capacity")
+    interner = Interner()
+    interner.intern(None)  # reserve id 0 -> code 1 for None
+    F_ENQ, F_DEQ = 0, 1
 
-        init = [0] * C
-        for i, v in enumerate(sorted(code(x) for x in self.pending)):
-            init[C - len(self.pending) + i] = v
-        init_state = tuple(init)
+    def code(v):
+        return intern_value(interner, _freeze(v)) + 1  # 0 = empty
 
-        def py_step(state, f, a0, a1):
-            s = list(state)
+    def encode(inv, comp):
+        if inv.f == "enqueue":
+            return (F_ENQ, code(inv.value), NIL)
+        if inv.f == "dequeue":
+            if comp is None or comp.type != OK:
+                raise ValueError(
+                    "indeterminate dequeue has no packed form"
+                )
+            return (F_DEQ, code(comp.value), NIL)
+        raise ValueError(f"queue model can't encode f {inv.f!r}")
+
+    codes = [code(x) for x in initial]
+    if fifo:
+        init_state = tuple(codes + [0] * (C - len(codes)))
+    else:
+        init_state = tuple([0] * (C - len(codes)) + sorted(codes))
+
+    def py_step(state, f, a0, a1):
+        s = list(state)
+        if fifo:
             if f == F_ENQ:
                 if 0 not in s:
                     return state, False
                 s[s.index(0)] = a0
-                return tuple(sorted(s)), True
-            if a0 not in s:
+                return tuple(s), True
+            if s[0] != a0 or a0 == 0:
                 return state, False
-            s.remove(a0)
-            return tuple(sorted([0] + s)), True
+            return tuple(s[1:] + [0]), True
+        if f == F_ENQ:
+            if 0 not in s:
+                return state, False
+            s[s.index(0)] = a0
+            return tuple(sorted(s)), True
+        if a0 not in s:
+            return state, False
+        s.remove(a0)
+        return tuple(sorted([0] + s)), True
 
-        def jax_step(state, f, a0, a1):
-            import jax.numpy as jnp
+    def jax_step(state, f, a0, a1):
+        import jax.numpy as jnp
 
-            is_enq = f == F_ENQ
-            has_room = (state == 0).any()
-            enq = state.at[jnp.argmin(state)].set(a0)
-            eq = state == a0
-            present = eq.any()
-            deq = jnp.where(
-                jnp.arange(state.shape[0]) == jnp.argmax(eq), 0, state
+        is_enq = f == F_ENQ
+        if fifo:
+            # Left-aligned: first zero is the tail slot.
+            length = (state != 0).sum()
+            has_room = length < C
+            enq = state.at[jnp.clip(length, 0, C - 1)].set(a0)
+            head_ok = (state[0] == a0) & (a0 != 0)
+            deq = jnp.roll(state, -1).at[C - 1].set(0)
+            legal = jnp.where(is_enq, has_room, head_ok)
+            new = jnp.where(
+                is_enq,
+                jnp.where(has_room, enq, state),
+                jnp.where(head_ok, deq, state),
             )
-            legal = jnp.where(is_enq, has_room, present)
-            new = jnp.where(is_enq, enq, jnp.where(present, deq, state))
-            return jnp.sort(new), legal
-
-        def validate_packed(packed) -> "str | None":
-            # Sound size bound at any linearization point t: every
-            # enqueue invoked by t could be in the queue; dequeues
-            # completed by t must already be linearized (removed).
-            size = len(self.pending)
-            worst = size
-            events = []  # (when, +1 enq-invoked / -1 deq-completed)
-            for i in range(packed.n):
-                if packed.f[i] == F_ENQ:
-                    events.append((int(packed.inv[i]), 1))
-                else:
-                    events.append((int(packed.ret[i]), -1))
-            for _, delta in sorted(events):
-                size += delta
-                worst = max(worst, size)
-            if worst > C:
-                return (
-                    f"history may hold {worst} elements; packed "
-                    f"capacity is {C}"
-                )
-            return None
-
-        def describe_op(f, a0, a1):
-            v = interner.value(a0 - 1) if a0 > 0 else "?"
-            return ("enqueue " if f == F_ENQ else "dequeue -> ") + repr(v)
-
-        return PackedModel(
-            name="unordered-queue",
-            state_width=C,
-            init_state=init_state,
-            encode=encode,
-            py_step=py_step,
-            jax_step=jax_step,
-            interner=interner,
-            describe_op=describe_op,
-            validate_packed=validate_packed,
+            return new, legal
+        has_room = (state == 0).any()
+        enq = state.at[jnp.argmin(state)].set(a0)
+        eq = state == a0
+        present = eq.any()
+        deq = jnp.where(
+            jnp.arange(state.shape[0]) == jnp.argmax(eq), 0, state
         )
+        legal = jnp.where(is_enq, has_room, present)
+        new = jnp.where(is_enq, enq, jnp.where(present, deq, state))
+        return jnp.sort(new), legal
+
+    def validate_packed(packed) -> "str | None":
+        # Sound size bound at any linearization point t: every enqueue
+        # invoked by t could be in the queue; dequeues completed by t
+        # must already be linearized (removed).
+        size = len(initial)
+        worst = size
+        events = []  # (when, +1 enq-invoked / -1 deq-completed)
+        for i in range(packed.n):
+            if packed.f[i] == F_ENQ:
+                events.append((int(packed.inv[i]), 1))
+            else:
+                events.append((int(packed.ret[i]), -1))
+        for _, delta in sorted(events):
+            size += delta
+            worst = max(worst, size)
+        if worst > C:
+            return (
+                f"history may hold {worst} elements; packed "
+                f"capacity is {C}"
+            )
+        return None
+
+    def describe_op(f, a0, a1):
+        v = interner.value(a0 - 1) if a0 > 0 else "?"
+        return ("enqueue " if f == F_ENQ else "dequeue -> ") + repr(v)
+
+    return PackedModel(
+        name="fifo-queue" if fifo else "unordered-queue",
+        state_width=C,
+        init_state=init_state,
+        encode=encode,
+        py_step=py_step,
+        jax_step=jax_step,
+        interner=interner,
+        describe_op=describe_op,
+        validate_packed=validate_packed,
+    )
 
 
 class FIFOQueue(Model):
-    """A strict FIFO queue: dequeue must return the head."""
+    """A strict FIFO queue: dequeue must return the head.  Device form:
+    left-aligned bounded slots with the same capacity/indeterminate
+    gates as UnorderedQueue."""
 
-    __slots__ = ("items",)
+    __slots__ = ("items", "_packed_cache")
+    packed_capacity = 32
 
     def __init__(self, items: Tuple[Any, ...] = ()):
         self.items = tuple(items)
+
+    def _compile_packed(self):
+        return _queue_packed(self.items, self.packed_capacity, fifo=True)
 
     def step(self, op: Op):
         v = _freeze(op.value)

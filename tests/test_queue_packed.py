@@ -90,3 +90,56 @@ def test_validate_packed_bound_is_sound():
     packed = pack_history(VALID, pm.encode)
     # Two concurrent enqueues: bound is 2, well under capacity 32.
     assert pm.validate_packed(packed) is None
+
+
+FIFO_VALID = q(
+    Op(type="invoke", f="enqueue", value=1, process=0),
+    Op(type="ok", f="enqueue", value=1, process=0),
+    Op(type="invoke", f="enqueue", value=2, process=1),
+    Op(type="ok", f="enqueue", value=2, process=1),
+    Op(type="invoke", f="dequeue", value=None, process=2),
+    Op(type="ok", f="dequeue", value=1, process=2),
+    Op(type="invoke", f="dequeue", value=None, process=0),
+    Op(type="ok", f="dequeue", value=2, process=0),
+)
+
+# Sequential enqueue 1 then 2, but dequeue returns 2 first: violates
+# FIFO (while the unordered queue would accept it).
+FIFO_BAD = q(
+    Op(type="invoke", f="enqueue", value=1, process=0),
+    Op(type="ok", f="enqueue", value=1, process=0),
+    Op(type="invoke", f="enqueue", value=2, process=1),
+    Op(type="ok", f="enqueue", value=2, process=1),
+    Op(type="invoke", f="dequeue", value=None, process=2),
+    Op(type="ok", f="dequeue", value=2, process=2),
+)
+
+
+@pytest.mark.parametrize("algo", ["cpu", "wgl-tpu"])
+def test_fifo_queue_verdicts(algo):
+    from jepsen_tpu.models import fifo_queue, unordered_queue
+
+    for h, expect in [(FIFO_VALID, True), (FIFO_BAD, False)]:
+        out = Linearizable(fifo_queue(), algo).check({}, h, {})
+        assert out["valid"] is expect, (algo, out)
+    # The unordered model accepts the out-of-order dequeue.
+    out = Linearizable(unordered_queue(), algo).check({}, FIFO_BAD, {})
+    assert out["valid"] is True
+
+
+def test_fifo_py_jax_parity():
+    import numpy as np
+    import jax.numpy as jnp
+
+    from jepsen_tpu.models import fifo_queue
+
+    pm = fifo_queue().packed()
+    packed = pack_history(FIFO_VALID, pm.encode)
+    sp = tuple(pm.init_state)
+    sd = jnp.asarray(np.asarray(pm.init_state, dtype=np.int32))
+    for i in range(packed.n):
+        f, a0, a1 = int(packed.f[i]), int(packed.a0[i]), int(packed.a1[i])
+        sp, lp = pm.py_step(sp, f, a0, a1)
+        sd, ld = pm.jax_step(sd, f, a0, a1)
+        assert bool(ld) == bool(lp)
+        assert tuple(np.asarray(sd)) == sp
