@@ -221,5 +221,45 @@ class IptablesNet(Net):
         on_nodes(test, do, nodes)
 
 
+class IpfilterNet(IptablesNet):
+    """IPFilter implementation for SmartOS/illumos nodes
+    (net.clj:235-270): partitions via `ipf` rules fed on stdin, heal
+    via `ipf -Fa`; shaping inherits the tc/netem path (the reference's
+    ipfilter impl shells out to tc for slow/flaky/fast/shape too)."""
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "ipf", "-f", "-",
+                    stdin=f"block in from {node_address(test, src)} to any\n",
+                )
+
+        on_nodes(test, do, [dest])
+
+    def drop_all(self, test: dict, grudge: Mapping[str, Any]) -> None:
+        # One ipf invocation per node with the whole rule set on stdin
+        # (the bulk analogue of iptables' comma-joined PartitionAll).
+        targets = {n: sorted(cut) for n, cut in grudge.items() if cut}
+
+        def do(sess: Session, node: str) -> None:
+            rules = "".join(
+                f"block in from {node_address(test, s)} to any\n"
+                for s in targets[node]
+            )
+            with sess.su():
+                sess.exec("ipf", "-f", "-", stdin=rules)
+
+        on_nodes(test, do, list(targets.keys()))
+
+    def heal(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec("ipf", "-Fa")
+
+        on_nodes(test, do)
+
+
 iptables = IptablesNet()
+ipfilter = IpfilterNet()
 noop = NoopNet()

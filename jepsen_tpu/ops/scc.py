@@ -92,8 +92,12 @@ def _get_kernel(K: int, V: int, mesh=None):
         return (diag > 0).any(axis=1)
 
     if mesh is not None:
-        from jax.experimental.shard_map import shard_map
         from jax.sharding import PartitionSpec as P
+
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
 
         fn = jax.jit(
             shard_map(

@@ -230,7 +230,11 @@ def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None):
     batched = jax.vmap(key_fn, in_axes=(0, 0, 0, 0, 0, 0, None, 0))
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
-        from jax.experimental.shard_map import shard_map
+
+        try:
+            from jax import shard_map  # jax >= 0.8
+        except ImportError:  # pragma: no cover - older jax
+            from jax.experimental.shard_map import shard_map
 
         pk = P("keys")
         in_specs = (pk, pk, pk, pk, pk, pk, P(None), pk)

@@ -13,6 +13,7 @@ from . import (
     bank,
     causal,
     causal_reverse,
+    cycle,
     kafka,
     linearizable_register,
     long_fork,
@@ -26,6 +27,7 @@ __all__ = [
     "bank",
     "causal",
     "causal_reverse",
+    "cycle",
     "kafka",
     "linearizable_register",
     "long_fork",

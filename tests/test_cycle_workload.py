@@ -1,0 +1,203 @@
+"""Generic cycle workload (tests/cycle.clj parity) + ipfilter Net."""
+
+from jepsen_tpu import net as jnet
+from jepsen_tpu.checker.elle.graph import DepGraph
+from jepsen_tpu.control import DummyRemote, with_sessions
+from jepsen_tpu.history import INVOKE, OK, Op, History
+from jepsen_tpu.workloads import cycle
+
+
+def _h(rows):
+    """(process, type, f, value) tuples -> History."""
+    return History([Op(type=t, f=f, value=v, process=p)
+                    for (p, t, f, v) in rows])
+
+
+# -- custom-analyzer checker ---------------------------------------------
+
+
+def test_custom_analyzer_finds_cycle():
+    hist = _h([
+        (0, INVOKE, "w", 1),
+        (0, OK, "w", 1),
+        (1, INVOKE, "w", 2),
+        (1, OK, "w", 2),
+    ])
+
+    def analyzer(h):
+        g = DepGraph()
+        g.add_edge(0, 2, "ww")
+        g.add_edge(2, 0, "wr")
+        return g
+
+    res = cycle.checker(analyzer).check({}, hist, {})
+    assert res["valid"] is False
+    assert res["anomaly-types"] == ["G1c"]
+    [c] = [a for a in res["anomalies"] if a["type"] == "G1c"]
+    assert set(c["cycle"]) == {0, 2}
+
+
+def test_custom_analyzer_acyclic():
+    hist = _h([(0, INVOKE, "w", 1), (0, OK, "w", 1)])
+
+    def analyzer(h):
+        g = DepGraph()
+        g.add_edge(0, 1, "ww")
+        return g
+
+    res = cycle.checker(analyzer).check({}, hist, {})
+    assert res["valid"] is True
+    assert res["anomalies"] == []
+    assert res["edges"] == 1
+
+
+def test_combined_analyzers_union_edges():
+    hist = _h([
+        (0, INVOKE, "a", None),
+        (0, OK, "a", None),
+        (1, INVOKE, "b", None),
+        (1, OK, "b", None),
+    ])
+
+    def fwd(h):
+        g = DepGraph()
+        g.add_edge(0, 2, "ww")
+        return g
+
+    def back(h):
+        g = DepGraph()
+        g.add_edge(2, 0, "rw")
+        return g
+
+    res = cycle.checker(fwd, back).check({}, hist, {})
+    assert res["valid"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+# -- stock analyzers ------------------------------------------------------
+
+
+def test_process_graph_orders_same_process():
+    hist = _h([
+        (0, INVOKE, "a", None), (0, OK, "a", None),
+        (1, INVOKE, "b", None), (1, OK, "b", None),
+        (0, INVOKE, "c", None), (0, OK, "c", None),
+    ])
+    g = cycle.process_graph(hist)
+    assert g.edge_types(0, 4) == {"process"}
+    assert g.edge_types(0, 2) == set()
+
+
+def test_realtime_graph_orders_nonoverlapping():
+    # A completes before B invokes; B overlaps C.
+    hist = _h([
+        (0, INVOKE, "a", None),   # 0
+        (0, OK, "a", None),       # 1
+        (1, INVOKE, "b", None),   # 2
+        (2, INVOKE, "c", None),   # 3
+        (1, OK, "b", None),       # 4
+        (2, OK, "c", None),       # 5
+    ])
+    g = cycle.realtime_graph(hist)
+    assert g.edge_types(0, 2) == {"realtime"}
+    assert g.edge_types(0, 3) == {"realtime"}
+    # Concurrent ops are unordered.
+    assert g.edge_types(2, 3) == set()
+    assert g.edge_types(3, 2) == set()
+
+
+def test_realtime_graph_skips_fail_and_info():
+    from jepsen_tpu.history import FAIL, INFO
+
+    hist = _h([
+        (0, INVOKE, "a", None),   # 0: fails — never took effect
+        (0, FAIL, "a", None),     # 1
+        (1, INVOKE, "b", None),   # 2: crashes — effect may land later
+        (1, INFO, "b", None),     # 3
+        (2, INVOKE, "c", None),   # 4
+        (2, OK, "c", None),       # 5
+    ])
+    g = cycle.realtime_graph(hist)
+    assert g.n_edges() == 0
+
+
+def test_realtime_graph_reduction_preserves_reachability():
+    # A < B < D in realtime; the A->D edge may be dropped only if
+    # A ~> D survives through B.
+    hist = _h([
+        (0, INVOKE, "a", None),   # 0
+        (0, OK, "a", None),       # 1
+        (1, INVOKE, "b", None),   # 2
+        (1, OK, "b", None),       # 3
+        (0, INVOKE, "d", None),   # 4
+        (0, OK, "d", None),       # 5
+    ])
+    g = cycle.realtime_graph(hist)
+
+    def reachable(src, dst):
+        seen, work = set(), [src]
+        while work:
+            v = work.pop()
+            if v == dst:
+                return True
+            for w in g.out_edges(v):
+                if w not in seen:
+                    seen.add(w)
+                    work.append(w)
+        return False
+
+    assert reachable(0, 2) and reachable(2, 4) and reachable(0, 4)
+
+
+# -- ipfilter net ---------------------------------------------------------
+
+
+def _net_test(remote):
+    return {
+        "nodes": ["n1", "n2", "n3"],
+        "ssh": {},
+        "remote": remote,
+        "net": jnet.ipfilter,
+    }
+
+
+def test_ipfilter_drop_renders_ipf_rule():
+    remote = DummyRemote()
+    with with_sessions(_net_test(remote)) as t:
+        jnet.ipfilter.drop(t, "n1", "n2")
+    cmds = [a for a in remote.actions if "cmd" in a]
+    assert any(
+        "ipf -f -" in a["cmd"] and a.get("host") == "n2"
+        and "block in from n1 to any" in (a.get("in") or "")
+        for a in cmds
+    ), cmds
+
+
+def test_ipfilter_drop_all_bulk_rules():
+    remote = DummyRemote()
+    grudge = {"n1": {"n2", "n3"}, "n2": {"n1"}}
+    with with_sessions(_net_test(remote)) as t:
+        jnet.ipfilter.drop_all(t, grudge)
+    n1_cmds = [a for a in remote.actions
+               if "cmd" in a and a.get("host") == "n1"]
+    [rule_cmd] = [a for a in n1_cmds if "ipf -f -" in a["cmd"]]
+    stdin = rule_cmd.get("in") or ""
+    assert "block in from n2 to any" in stdin
+    assert "block in from n3 to any" in stdin
+
+
+def test_ipfilter_heal_flushes_all_nodes():
+    remote = DummyRemote()
+    with with_sessions(_net_test(remote)) as t:
+        jnet.ipfilter.heal(t)
+    hosts = {a.get("host") for a in remote.actions
+             if "cmd" in a and "ipf -Fa" in a["cmd"]}
+    assert hosts == {"n1", "n2", "n3"}
+
+
+def test_ipfilter_inherits_tc_shaping():
+    remote = DummyRemote()
+    with with_sessions(_net_test(remote)) as t:
+        jnet.ipfilter.slow(t, mean=10)
+    assert any("tc qdisc add" in a.get("cmd", "")
+               for a in remote.actions)
