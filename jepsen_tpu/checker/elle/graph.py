@@ -261,7 +261,8 @@ def check_cycles(graph: DepGraph) -> list[dict]:
 
     # Layer 3: G-single / G2-item — cycles through an rw edge in the
     # full graph.
-    for comp in graph.sccs():
+    full_comps = graph.sccs()
+    for comp in full_comps:
         comp_set = set(comp)
         found = None
         for src in comp_set:
@@ -274,4 +275,17 @@ def check_cycles(graph: DepGraph) -> list[dict]:
                 break
         if found is not None:
             out.append(_cycle_record(graph, found, comp))
+
+    # Layer 4: leftovers — an SCC that none of the typed layers could
+    # explain is still a cycle (e.g. custom edge types from a
+    # user-supplied analyzer, workloads/cycle.py); report it rather
+    # than silently passing it as valid, like elle.core/check.
+    covered = [set(r["cycle"]) for r in out]
+    for comp in full_comps:
+        comp_set = set(comp)
+        if any(c <= comp_set for c in covered):
+            continue
+        cycle = graph.find_cycle_in(comp)
+        if cycle is not None:
+            out.append(_cycle_record(graph, cycle, comp))
     return out

@@ -119,8 +119,13 @@ def majorities_ring(nodes: Sequence[Any]) -> dict:
     plus its k nearest neighbors each way).  A window keyed at i
     instead of centered on it would isolate every node: i could hear
     nodes that cannot hear it back.  Even majority sizes round up to
-    the next odd window to stay symmetric."""
+    the next odd window to stay symmetric.
+
+    The ring order is shuffled per call, like the reference's
+    majorities-ring-perfect (nemesis.clj:203-217): repeated partitions
+    in one test then cut different edges each time."""
     nodes = list(nodes)
+    _rng().shuffle(nodes)
     n = len(nodes)
     k = majority(n) // 2
     grudge = {}

@@ -246,6 +246,40 @@ class ClockNemesis(Nemesis):
         return {"bump", "strobe", "reset", "check-offsets"}
 
 
+class ClockScrambler(ClockNemesis):
+    """The classic coarse clock fault (nemesis.clj:436-451): on
+    f="start", bumps every node's clock by an independent uniformly
+    random offset within ±dt seconds; f="stop" resets clocks via NTP.
+    Inherits ClockNemesis's helper compilation, offset reporting, and
+    teardown."""
+
+    def __init__(self, dt_secs: float):
+        self.dt_secs = dt_secs
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        from .core import _rng
+
+        if op.f == "start":
+            dt_ms = int(self.dt_secs * 1000)
+            spec = {
+                n: _rng().randint(-dt_ms, dt_ms)
+                for n in test.get("nodes") or []
+            }
+            return super().invoke(test, op.replace(f="bump", value=spec)
+                                  ).replace(f="start")
+        if op.f == "stop":
+            return super().invoke(test, op.replace(f="reset", value=None)
+                                  ).replace(f="stop")
+        raise ValueError(f"unknown clock-scrambler f {op.f!r}")
+
+    def fs(self) -> set:
+        return {"start", "stop"}
+
+
+def clock_scrambler(dt_secs: float) -> ClockScrambler:
+    return ClockScrambler(dt_secs)
+
+
 # ---------------------------------------------------------------------------
 # Disk faults
 # ---------------------------------------------------------------------------

@@ -74,6 +74,29 @@ def test_combined_analyzers_union_edges():
     assert "G-single" in res["anomaly-types"]
 
 
+def test_custom_edge_types_still_detected():
+    # A cycle whose edges use analyzer-invented types must not pass as
+    # valid (check_cycles layer 4).
+    hist = _h([
+        (0, INVOKE, "w", 1),
+        (0, OK, "w", 1),
+        (1, INVOKE, "w", 2),
+        (1, OK, "w", 2),
+    ])
+
+    def analyzer(h):
+        g = DepGraph()
+        g.add_edge(0, 2, "version-order")
+        g.add_edge(2, 0, "version-order")
+        return g
+
+    res = cycle.checker(analyzer).check({}, hist, {})
+    assert res["valid"] is False
+    assert res["anomaly-types"] == ["cycle"]
+    [c] = res["anomalies"]
+    assert set(c["cycle"]) == {0, 2}
+
+
 # -- stock analyzers ------------------------------------------------------
 
 

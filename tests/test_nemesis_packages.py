@@ -86,6 +86,54 @@ def test_clock_nemesis_compiles_and_bumps():
         assert set(out.value["clock-offsets"]) == set(test["nodes"])
 
 
+def test_clock_scrambler_start_stop():
+    remote = DummyRemote()
+    test = dummy_test(remote=remote, ssh={})
+    with with_sessions(test):
+        nem = faults.clock_scrambler(60).setup(test)
+        remote.actions.clear()
+        out = nem.invoke(
+            test, Op(type="info", f="start", value=None, process=NEMESIS)
+        )
+        assert out.f == "start"
+        bumped = out.value["bumped"]
+        assert set(bumped) == set(test["nodes"])
+        # Independent random deltas within +/-60s, in milliseconds.
+        assert all(-60_000 <= d <= 60_000 for d in bumped.values())
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert sum("bump-time" in c for c in cmds) == len(test["nodes"])
+
+        out = nem.invoke(
+            test, Op(type="info", f="stop", value=None, process=NEMESIS)
+        )
+        assert out.f == "stop"
+        cmds = [a["cmd"] for a in remote.actions if "cmd" in a]
+        assert any("ntpdate" in c for c in cmds)
+        assert nem.fs() == {"start", "stop"}
+
+
+def test_majorities_ring_shuffles_but_keeps_invariant():
+    from jepsen_tpu.nemesis import majorities_ring
+    from jepsen_tpu.utils import majority
+
+    nodes = [f"n{i}" for i in range(7)]
+    seen = set()
+    for _ in range(12):
+        grudge = majorities_ring(nodes)
+        seen.add(tuple(sorted((k, tuple(sorted(v)))
+                              for k, v in grudge.items())))
+        views = {}
+        for node in nodes:
+            visible = frozenset(set(nodes) - set(grudge[node]))
+            assert node in visible
+            assert len(visible) >= majority(len(nodes))
+            views[node] = visible
+        # No two nodes see the same majority.
+        assert len(set(views.values())) == len(nodes)
+    # The ring order is randomized per call.
+    assert len(seen) > 1
+
+
 def test_bitflip_and_truncate_command_shape():
     remote = DummyRemote()
     test = dummy_test(remote=remote, ssh={})
