@@ -260,14 +260,45 @@ def check_wgl_device(
         return WGLResult(valid=True, configs_explored=1, elapsed_s=time.monotonic() - t0)
 
     if witness:
-        from .wgl_witness import check_wgl_witness
+        from .wgl_witness import (
+            NARROW_INFO_WINDOW,
+            WIDE_INFO_WINDOW,
+            check_wgl_witness,
+            plan_drops,
+        )
+
+        # Window-width ladder: the narrow default first (fastest,
+        # covers almost every valid history), then a wide retry whose
+        # extra helper columns recover most of the completeness the
+        # narrow info_window trades away.  Each rung gets the budget
+        # REMAINING after earlier rungs and only pays a compile if its
+        # W lands in a new bucket.  The wide rung runs only when the
+        # narrow plan actually dropped info columns (checked lazily,
+        # off the happy path) — otherwise both plans are identical and
+        # the retry would deterministically fail again.
+        def remaining() -> Optional[float]:
+            if time_limit_s is None:
+                return None
+            return time_limit_s - (time.monotonic() - t0)
+
+        def timed_out() -> bool:
+            r = remaining()
+            return r is not None and r <= 0
 
         wres = check_wgl_witness(
-            packed, pm, time_limit_s=time_limit_s, width_hint=width_hint
+            packed, pm, info_window=NARROW_INFO_WINDOW,
+            time_limit_s=remaining(), width_hint=width_hint,
         )
+        if wres is None and not timed_out() and plan_drops(
+            packed, info_window=NARROW_INFO_WINDOW
+        ):
+            wres = check_wgl_witness(
+                packed, pm, info_window=WIDE_INFO_WINDOW,
+                time_limit_s=remaining(), width_hint=width_hint,
+            )
         if wres is not None:
             return wres
-        if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
+        if timed_out():
             return WGLResult(
                 valid="unknown",
                 configs_explored=0,

@@ -233,15 +233,21 @@ def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None):
 
         try:
             from jax import shard_map  # jax >= 0.8
+            # Replication checking was renamed check_rep -> check_vma
+            # with the stable API; disabled either way (outputs are
+            # fully sharded over keys, nothing is replicated).
+            rep_kw = {"check_vma": False}
         except ImportError:  # pragma: no cover - older jax
             from jax.experimental.shard_map import shard_map
+
+            rep_kw = {"check_rep": False}
 
         pk = P("keys")
         in_specs = (pk, pk, pk, pk, pk, pk, P(None), pk)
         out_specs = (pk, pk, pk, pk)
         batched = shard_map(
             batched, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
+            **rep_kw,
         )
     fn = jax.jit(batched)
     _kernel_cache[key] = fn

@@ -71,6 +71,15 @@ from .wgl import _bucket, window_regather
 INF = np.int32(2**31 - 1)
 NO_BAR = np.iinfo(np.int32).max
 
+#: Default per-block bound on indeterminate-op window columns.  Narrow
+#: on purpose: W buckets to 2048 on the bench config (1.8 s vs 3.2 s at
+#: 4096 — round-2 measurement).  check_wgl_device escalates to
+#: WIDE_INFO_WINDOW when a narrow attempt that actually dropped columns
+#: finds no witness.  bench.py's warm-up precompiles via plan_width,
+#: which shares this default — keep them coupled through this constant.
+NARROW_INFO_WINDOW = 512
+WIDE_INFO_WINDOW = 4096
+
 _chunk_fn_cache: dict[tuple, Any] = {}
 
 
@@ -91,7 +100,11 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
     contribution while becoming un-relinearizable.  Without the bound,
     info ops accumulate for the whole run (ret = ∞) and the window —
     hence heavy-round cost — grows linearly with history length: the
-    1M-op bench config reaches W = 65536 unbounded."""
+    1M-op bench config reaches W = 65536 unbounded.
+
+    Returns (bars, bar_rank, inv32, ret32, blocks, any_dropped);
+    `any_dropped` reports whether any block actually lost info columns
+    to the bound — when False, a wider retry would plan identically."""
     status = packed.status
     inv32 = packed.inv.astype(np.int32)
     ret32 = np.minimum(packed.ret, np.int64(INF)).astype(np.int32)
@@ -101,6 +114,7 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
     bar_rank[bars] = np.arange(len(bars))
     is_info = status != ST_OK
     blocks = []
+    any_dropped = False
     for k0 in range(0, len(bars), bars_per_block):
         block_bars = bars[k0 : k0 + bars_per_block]
         end_ret = int(ret32[block_bars[-1]])
@@ -114,19 +128,33 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
                 drop = info_live[: len(info_live) - info_window]
                 live = live.copy()
                 live[drop] = False
+                any_dropped = True
         active = np.nonzero(live)[0]
         blocks.append((k0, block_bars, active))
-    return bars, bar_rank, inv32, ret32, blocks
+    return bars, bar_rank, inv32, ret32, blocks, any_dropped
 
 
 def plan_width(packed: PackedOps, bars_per_block: int = 1024,
-               info_window: Optional[int] = 4096) -> int:
+               info_window: Optional[int] = NARROW_INFO_WINDOW) -> int:
     """The window width a witness run over `packed` will use — lets a
     warm-up run pre-compile the same kernel via `width_hint`."""
     if packed.n == 0 or packed.n_ok == 0:
         return 0
-    _, _, _, _, blocks = _plan_blocks(packed, bars_per_block, info_window)
+    _, _, _, _, blocks, _ = _plan_blocks(packed, bars_per_block,
+                                         info_window)
     return _bucket(max(max(len(a) for _, _, a in blocks), 1))
+
+
+def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
+               info_window: Optional[int] = NARROW_INFO_WINDOW) -> bool:
+    """Whether a witness plan at this info_window would drop any info
+    columns — when False, a wider window plans identically and an
+    escalation retry is pointless."""
+    if packed.n == 0 or packed.n_ok == 0 or info_window is None:
+        return False
+    if packed.n - packed.n_ok <= info_window:
+        return False  # cheap bound: fewer info ops than the window
+    return _plan_blocks(packed, bars_per_block, info_window)[5]
 
 
 def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
@@ -352,7 +380,7 @@ def check_wgl_witness(
     bars_per_block: int = 1024,
     blocks_per_call: int = 32,
     depth: int = 5,
-    info_window: Optional[int] = 4096,
+    info_window: Optional[int] = NARROW_INFO_WINDOW,
     max_window: int = 32768,
     width_hint: int = 0,
     time_limit_s: Optional[float] = None,
@@ -374,7 +402,7 @@ def check_wgl_witness(
         return WGLResult(valid=True, configs_explored=1,
                          elapsed_s=time.monotonic() - t0)
 
-    bars, bar_rank, inv32, ret32, blocks = _plan_blocks(
+    bars, bar_rank, inv32, ret32, blocks, _ = _plan_blocks(
         packed, bars_per_block, info_window
     )
     n_bars = len(bars)
