@@ -88,7 +88,23 @@ class Op:
         return self.ext.get("error")
 
     def replace(self, **kw: Any) -> "Op":
-        return dataclasses.replace(self, **kw)
+        # Hand-rolled dataclasses.replace: this sits on the interpreter
+        # hot path (3 calls per executed op) and the generic version's
+        # per-call field introspection showed up in whole-stack
+        # profiles.
+        if kw.keys() - _OP_FIELDS:
+            raise TypeError(
+                f"unknown Op fields {sorted(kw.keys() - _OP_FIELDS)}"
+            )
+        return Op(
+            type=kw.get("type", self.type),
+            f=kw.get("f", self.f),
+            value=kw.get("value", self.value),
+            process=kw.get("process", self.process),
+            time=kw.get("time", self.time),
+            index=kw.get("index", self.index),
+            ext=kw.get("ext", self.ext),
+        )
 
     def complete(self, type: str, value: Any = _KEEP, **ext: Any) -> "Op":
         """The completion of this invocation: same process/f, new type,
@@ -96,8 +112,7 @@ class Op:
         index are left for the interpreter to fill."""
         new_ext = dict(self.ext)
         new_ext.update(ext)
-        return dataclasses.replace(
-            self,
+        return self.replace(
             type=type,
             value=self.value if value is _KEEP else value,
             time=-1,
@@ -108,7 +123,7 @@ class Op:
     def with_ext(self, **kw: Any) -> "Op":
         ext = dict(self.ext)
         ext.update(kw)
-        return dataclasses.replace(self, ext=ext)
+        return self.replace(ext=ext)
 
     def to_dict(self) -> dict[str, Any]:
         d = {
@@ -144,6 +159,9 @@ class Op:
             f"{self.index}\t{self.process}\t{self.type}\t{self.f}\t{self.value!r}"
             + (f"\t{self.ext}" if self.ext else "")
         )
+
+
+_OP_FIELDS = frozenset(f.name for f in dataclasses.fields(Op))
 
 
 def op(type: str, f: Any = None, value: Any = None, process: Any = None, **ext: Any) -> Op:
@@ -186,7 +204,7 @@ class History(Sequence[Op]):
             reindex = not all(o.index == i for i, o in enumerate(rows))
         if reindex:
             rows = [
-                dataclasses.replace(o, index=i, time=(o.time if o.time >= 0 else i))
+                o.replace(index=i, time=(o.time if o.time >= 0 else i))
                 for i, o in enumerate(rows)
             ]
         self.ops: tuple[Op, ...] = tuple(rows)
@@ -321,7 +339,7 @@ class History(Sequence[Op]):
 
     def strip_indices(self) -> list[Op]:
         """Ops with indices removed (generator/test.clj:73)."""
-        return [dataclasses.replace(o, index=-1) for o in self.ops]
+        return [o.replace(index=-1) for o in self.ops]
 
     # -- convenience -------------------------------------------------------
 

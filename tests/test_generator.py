@@ -271,6 +271,44 @@ class TestTiming:
         ops = gt.perfect(gen.limit(5, gen.delay(3e-9, gen.repeat({"f": "w"}))))
         assert [o.time for o in ops] == [0, 3, 6, 10, 13]
 
+    def test_concat(self):
+        # concat-test (generator_test.clj:505-512): sequential
+        # composition of heterogeneous generators.
+        ops = gt.perfect(
+            gen.concat(
+                [{"value": "a"}, {"value": "b"}],
+                gen.limit(1, gen.repeat({"value": "c"})),
+                {"value": "d"},
+            )
+        )
+        assert fvals(ops, "value") == ["a", "b", "c", "d"]
+
+    def test_any_stagger_no_starvation(self):
+        # any-stagger-test (generator_test.clj:514-537): two staggers
+        # raced under `any` must both keep their own rates — neither
+        # may be starved.
+        n = 1000
+        ops = gt.perfect(
+            gen.clients(
+                gen.limit(
+                    n,
+                    gen.any_gen(
+                        gen.stagger(3.0, gen.repeat({"f": "a"})),
+                        gen.stagger(5.0, gen.repeat({"f": "b"})),
+                    ),
+                )
+            )
+        )
+        assert len(ops) == n
+
+        def mean_interval_secs(fs):
+            times = [o.time for o in ops if o.f == fs]
+            gaps = [b - a for a, b in zip(times, times[1:])]
+            return sum(gaps) / len(gaps) / 1e9
+
+        assert 2.5 <= mean_interval_secs("a") <= 3.5
+        assert 4.5 <= mean_interval_secs("b") <= 5.5
+
     def test_stagger_rate(self):
         n = 1000
         dt = 20e-9
