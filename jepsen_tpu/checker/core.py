@@ -154,11 +154,28 @@ class Stats(Checker):
     (checker.clj:159-200)."""
 
     def check(self, test, history, opts):
-        by_f: dict[Any, MultiSet] = defaultdict(MultiSet)
-        for o in history:
-            if o.is_invoke or not o.is_client_op:
-                continue
-            by_f[o.f][o.type] += 1
+        # Chunk-parallel fold, like the reference's tesser fold over
+        # the history (checker.clj:193-200).
+        from ..history.fold import fold as run_fold, loopf
+
+        def reduce_op(acc: dict, o) -> dict:
+            if not o.is_invoke and o.is_client_op:
+                acc[o.f][o.type] += 1
+            return acc
+
+        def combine(a: dict, b: dict) -> dict:
+            for f, counts in b.items():
+                tgt = a[f]
+                for t, n in counts.items():
+                    tgt[t] += n
+            return a
+
+        rows = history if isinstance(history, History) else list(history)
+        by_f: dict[Any, MultiSet] = run_fold(
+            rows,
+            loopf(identity=lambda: defaultdict(MultiSet),
+                  reducer=reduce_op, combiner=combine),
+        )
         stats = {}
         for f, counts in by_f.items():
             n = sum(counts.values())

@@ -25,6 +25,13 @@ from .core import (
     op,
     parse_literal,
 )
+from .fold import (
+    Fold,
+    Task,
+    fold,
+    loopf,
+    task,
+)
 from .packed import (
     NIL,
     NO_RET,
@@ -37,6 +44,11 @@ from .packed import (
 
 __all__ = [
     "FAIL",
+    "Fold",
+    "Task",
+    "fold",
+    "loopf",
+    "task",
     "INFO",
     "INVOKE",
     "NEMESIS",

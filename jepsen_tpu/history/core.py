@@ -337,6 +337,16 @@ class History(Sequence[Op]):
             lambda o: not (o.is_fail or o.index in failed_invokes)
         )
 
+    def fold(self, f: "Any", chunk_size: "int | None" = None) -> Any:
+        """Runs a history.fold.Fold over this history (h/fold)."""
+        # Import the submodule explicitly: the package re-exports the
+        # `fold` FUNCTION, which shadows the module name.
+        from .fold import fold as run_fold
+
+        if chunk_size is None:
+            return run_fold(self, f)
+        return run_fold(self, f, chunk_size=chunk_size)
+
     def strip_indices(self) -> list[Op]:
         """Ops with indices removed (generator/test.clj:73)."""
         return [o.replace(index=-1) for o in self.ops]

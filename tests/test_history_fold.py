@@ -1,0 +1,111 @@
+"""Chunked parallel folds + async tasks (jepsen.history.fold / h/task
+parity, SURVEY.md §2.4)."""
+
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu.history import (
+    Fold,
+    History,
+    Op,
+    fold,
+    loopf,
+    task,
+)
+
+
+def big_history(n=40_000):
+    return History([
+        Op(type="invoke" if i % 2 == 0 else "ok", f="w",
+           value=i // 2, process=(i // 2) % 7)
+        for i in range(n)
+    ])
+
+
+def count_fold():
+    return loopf(
+        identity=lambda: 0,
+        reducer=lambda acc, o: acc + (1 if o.type == "ok" else 0),
+        combiner=lambda a, b: a + b,
+    )
+
+
+def test_fold_parallel_matches_sequential():
+    h = big_history()
+    f = count_fold()
+    assert fold(h, f) == sum(1 for o in h if o.type == "ok")
+    # Forcing tiny chunks exercises the combine path.
+    assert fold(h, f, chunk_size=1000) == 20_000
+
+
+def test_fold_sequential_without_combiner():
+    # Order-dependent reduction: list of ok values, no combiner.
+    h = big_history(2_000)
+    f = Fold(
+        identity=list,
+        reducer=lambda acc, o: (acc.append(o.value) or acc)
+        if o.type == "ok" else acc,
+    )
+    assert fold(h, f) == [o.value for o in h if o.type == "ok"]
+
+
+def test_fold_post_and_method_form():
+    h = big_history(8_000)
+    f = loopf(
+        identity=lambda: (0, 0),
+        reducer=lambda acc, o: (acc[0] + 1, acc[1] + (o.value or 0)),
+        combiner=lambda a, b: (a[0] + b[0], a[1] + b[1]),
+        post=lambda acc: acc[1] / acc[0],
+    )
+    mean = h.fold(f, chunk_size=512)
+    assert mean == pytest.approx(
+        sum((o.value or 0) for o in h) / len(h)
+    )
+
+
+def test_fold_combines_in_chunk_order():
+    h = big_history(6_000)
+    f = loopf(
+        identity=list,
+        reducer=lambda acc, o: (acc.append(o.index) or acc),
+        combiner=lambda a, b: a + b,
+    )
+    assert h.fold(f, chunk_size=500) == list(range(6_000))
+
+
+def test_task_runs_async_and_chains():
+    started = threading.Event()
+
+    def slow():
+        started.set()
+        time.sleep(0.05)
+        return 21
+
+    a = task("a", slow)
+    assert started.wait(2.0)
+    b = task("double", lambda x: x * 2, deps=[a])
+    assert b.result(5.0) == 42
+    assert a.done() and b.done()
+
+
+def test_task_deep_dependency_chain():
+    # Deeper than any worker pool — must not deadlock.
+    t = task("t0", lambda: 0)
+    for i in range(32):
+        t = task(f"t{i + 1}", lambda x: x + 1, deps=[t])
+    assert t.result(10.0) == 32
+
+
+def test_task_exception_propagates():
+    def boom():
+        raise ValueError("nope")
+
+    t = task("boom", boom)
+    with pytest.raises(ValueError, match="nope"):
+        t.result(5.0)
+    # Downstream of a failed dep fails too.
+    t2 = task("after", lambda x: x, deps=[t])
+    with pytest.raises(ValueError):
+        t2.result(5.0)
