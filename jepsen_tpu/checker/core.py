@@ -154,8 +154,10 @@ class Stats(Checker):
     (checker.clj:159-200)."""
 
     def check(self, test, history, opts):
-        # Chunk-parallel fold, like the reference's tesser fold over
-        # the history (checker.clj:193-200).
+        # Fold in the tesser shape the reference uses
+        # (checker.clj:193-200).  No combiner: a pure-Python reducer
+        # is GIL-serialized anyway, so the sequential pass avoids the
+        # chunk pool's overhead.
         from ..history.fold import fold as run_fold, loopf
 
         def reduce_op(acc: dict, o) -> dict:
@@ -163,18 +165,11 @@ class Stats(Checker):
                 acc[o.f][o.type] += 1
             return acc
 
-        def combine(a: dict, b: dict) -> dict:
-            for f, counts in b.items():
-                tgt = a[f]
-                for t, n in counts.items():
-                    tgt[t] += n
-            return a
-
         rows = history if isinstance(history, History) else list(history)
         by_f: dict[Any, MultiSet] = run_fold(
             rows,
             loopf(identity=lambda: defaultdict(MultiSet),
-                  reducer=reduce_op, combiner=combine),
+                  reducer=reduce_op),
         )
         stats = {}
         for f, counts in by_f.items():

@@ -25,13 +25,8 @@ from .core import (
     op,
     parse_literal,
 )
-from .fold import (
-    Fold,
-    Task,
-    fold,
-    loopf,
-    task,
-)
+from .fold import Fold, Task, loopf, task
+from .fold import fold as run_fold  # `fold` stays the submodule name
 from .packed import (
     NIL,
     NO_RET,
@@ -46,8 +41,8 @@ __all__ = [
     "FAIL",
     "Fold",
     "Task",
-    "fold",
     "loopf",
+    "run_fold",
     "task",
     "INFO",
     "INVOKE",

@@ -119,6 +119,15 @@ class PackedModel:
     #: capacity queue whose capacity the history could exceed) — the
     #: checker then falls back to the host-model search.
     validate_packed: Optional[Callable[..., Optional[str]]] = None
+    #: optional batched transition `(states (state_width, B) i32, f,
+    #: a0, a1) -> (states', legal (B,))` — LANE-MAJOR (beam lanes on
+    #: the trailing axis) and written WITHOUT scatter ops (no
+    #: `.at[...].set` — use masked `jnp.where` over rows): the Pallas
+    #: witness sweep (ops/wgl_witness.py) lowers this through Mosaic,
+    #: which rejects the scatters `vmap(jax_step)` produces and
+    #: sub-32-bit / lane<->sublane relayouts.  Models without one
+    #: simply stay on the XLA-scan sweep.
+    jax_step_rows: Optional[Callable[..., Any]] = None
 
 
 def intern_value(interner: Interner, v: Any) -> int:

@@ -117,6 +117,18 @@ def _register_packed(model: Register, allow_cas: bool) -> PackedModel:
         new = jnp.where(is_write, a0, jnp.where(is_cas, a1, s))
         return state.at[0].set(new), legal
 
+    def jax_step_rows(states, f, a0, a1):
+        # Scatter-free lane-major form for the Pallas sweep (states
+        # is (1, B); the single row IS the register).
+        import jax.numpy as jnp
+
+        s = states[0]
+        is_write = f == F_WRITE
+        is_cas = f == F_CAS
+        legal = is_write | (s == a0)
+        new = jnp.where(is_write, a0, jnp.where(is_cas, a1, s))
+        return new[None, :], legal
+
     def describe_op(f: int, a0: int, a1: int) -> str:
         if f == F_READ:
             return f"read -> {interner.value(a0)!r}"
@@ -133,6 +145,7 @@ def _register_packed(model: Register, allow_cas: bool) -> PackedModel:
         jax_step=jax_step,
         interner=interner,
         describe_op=describe_op,
+        jax_step_rows=jax_step_rows,
     )
 
 
@@ -208,6 +221,23 @@ class MultiRegister(Model):
             new = jnp.where(is_write, a1, cur)
             return state.at[a0].set(new), legal
 
+        def jax_step_rows(states, f, a0, a1):
+            # Scatter-free lane-major form for the Pallas sweep
+            # (states is (n_keys, B)): the written key row is selected
+            # by mask, not scatter.
+            import jax
+            import jax.numpy as jnp
+
+            nk = states.shape[0]
+            key_mask = (
+                jax.lax.broadcasted_iota(jnp.int32, (nk, 1), 0) == a0
+            )
+            cur = jnp.where(key_mask, states, 0).sum(axis=0)  # (B,)
+            is_write = f == F_WRITE
+            legal = is_write | (cur == a1)
+            out = jnp.where(key_mask & is_write, a1, states)
+            return out, legal
+
         def describe_op(f: int, a0: int, a1: int) -> str:
             verb = "read" if f == F_READ else "write"
             return f"{verb} {keys[a0]!r} {interner.value(a1)!r}"
@@ -221,6 +251,7 @@ class MultiRegister(Model):
             jax_step=jax_step,
             interner=interner,
             describe_op=describe_op,
+            jax_step_rows=jax_step_rows,
         )
 
 

@@ -157,8 +157,121 @@ def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
     return _plan_blocks(packed, bars_per_block, info_window)[5]
 
 
+def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
+                       interpret: bool):
+    """The easy-path barrier sweep as a Pallas TPU kernel.
+
+    The XLA `lax.scan` version pays ~30 µs of small-op critical path
+    per barrier (round-2 measurement: 1.36 s for a 47k-barrier 0-info
+    history).  Here the whole sweep runs inside one kernel whose state
+    (member bits, beam states, alive mask) stays on-chip, with a
+    `while_loop` that exits at the first barrier the easy path cannot
+    survive — the heavy chain search stays in XLA and resumes the
+    sweep afterwards.
+
+    Mosaic constraints shape the layout: dynamic per-barrier scalar
+    reads must come from SMEM (VMEM vector loads need statically
+    aligned indices), so the barrier table lives in SMEM and the
+    member matrix is BIT-PACKED to one int32 word per window row
+    ((W,) in SMEM; lane b of the beam is bit b — arithmetic
+    right-shift + &1 extracts bits for any B <= 32).  All vector
+    state is LANE-MAJOR (beam lanes on the 128-lane axis: states
+    (SW, B), masks (1, B)) and 32-bit, because sub-32-bit relayouts
+    and lane<->sublane reshapes don't lower.
+
+    Outputs: states', alive', death (1,1) SMEM i32 — death == K means
+    the block completed; any smaller value is the barrier index whose
+    pass/direct step would have killed the frontier (state/alive
+    returned are from just BEFORE that barrier).  Identical
+    transition semantics to the `easy` branch of the scan path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(start_ref, bars_ref, mbits_ref, states_ref, alive_ref,
+               states_out, alive_out, death_ref):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+        start = start_ref[0, 0]
+        states0 = states_ref[:]          # (SW, B) i32
+        alive0 = alive_ref[:]            # (1, B) i32 0/1
+
+        # All VECTOR masks are int32 0/1 — Mosaic fails to legalize
+        # selects that produce bool vectors; scalar bools (loop
+        # control) are fine.
+        def cond(c):
+            k, _, _, died = c
+            return jnp.logical_and(k < K, jnp.logical_not(died))
+
+        def body(c):
+            k, states, alive, _ = c
+            a = bars_ref[0, k]
+            real = bars_ref[2, k] != 0   # scalar bool
+            bf = bars_ref[3, k]
+            ba0 = bars_ref[4, k]
+            ba1 = bars_ref[5, k]
+            bits = mbits_ref[a]
+            has = (bits >> lane) & 1                   # (1, B) i32
+            ns, legal_b = jax_step_rows(states, bf, ba0, ba1)
+            legal = legal_b.reshape(1, B).astype(jnp.int32)
+            surv_pass = alive & has
+            surv_dir = alive & (1 - has) & legal
+            new_alive = surv_pass | surv_dir
+            died = real & (new_alive.max() == 0)       # scalar bool
+            commit_i = jnp.where(real & ~died, 1, 0)   # scalar i32
+            take = commit_i * surv_dir                 # (1, B) i32
+            st = jnp.where(take != 0, ns, states)
+            al = commit_i * new_alive + (1 - commit_i) * alive
+            return (jnp.where(died, k, k + 1), st, al, died)
+
+        k, states, alive, died = jax.lax.while_loop(
+            cond, body, (start, states0, alive0, jnp.bool_(False))
+        )
+        states_out[:] = states
+        alive_out[:] = alive
+        death_ref[0, 0] = jnp.where(died, k, K)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((SW, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+        ),
+        interpret=interpret,
+    )
+
+    def sweep(start_k, bars, member, states, alive):
+        start = jnp.asarray(start_k, jnp.int32).reshape(1, 1)
+        # Pack each member row to one int32 word (lane b -> bit b).
+        mbits = (
+            member.astype(jnp.int32)
+            << jnp.arange(B, dtype=jnp.int32)[None, :]
+        ).sum(axis=1).astype(jnp.int32)
+        s2, al2, dk = call(
+            start, bars, mbits, states.T,
+            alive[None, :].astype(jnp.int32),
+        )
+        return s2.T, al2[0] != 0, dk[0, 0]
+
+    return sweep
+
+
 def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
-                   jax_step):
+                   jax_step, pallas_mode: str = "off",
+                   jax_step_rows=None):
     """One call runs NB blocks of up to K barriers each.
 
     Args: member (W, B) bool — window-major so the per-barrier
@@ -190,6 +303,15 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
     hv = jnp.asarray(_state_hash_vec(SW))
     BIG = jnp.float32(3.0e38)
     M = B * W
+
+    pallas_sweep = (
+        _make_pallas_sweep(
+            B, W, SW, K, jax_step_rows,
+            interpret=(pallas_mode == "interpret"),
+        )
+        if pallas_mode != "off"
+        else None
+    )
 
     def run_block(member, states, alive, bars, tab, k0):
         inv_w, f_w, a0_w, a1_w, bar_rank_w = (
@@ -308,6 +430,38 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             )
             return member, states, alive, done
 
+        if pallas_sweep is not None:
+            # ---- pallas hybrid: VMEM sweep to the next death point,
+            # heavy in XLA, resume — all under one while_loop ----
+            def cond_w(c):
+                k, _, _, _, failed = c
+                return (k < K) & ~failed
+
+            def body_w(c):
+                k, member, states, alive, failed = c
+                s2, al2, dk = pallas_sweep(k, bars, member, states, alive)
+
+                def clean(_):
+                    return jnp.int32(K), member, s2, al2, failed
+
+                def death(_):
+                    colv = jax.lax.dynamic_slice(
+                        bars, (jnp.int32(0), dk), (6, 1)
+                    )[:, 0]
+                    m, s, al, done = heavy(
+                        member, s2, al2, colv[0], colv[1], colv[3],
+                        colv[4], colv[5], k0 + dk,
+                    )
+                    return dk + 1, m, s, al, failed | ~done
+
+                return jax.lax.cond(dk >= K, clean, death, None)
+
+            _, member, states, alive, failed = jax.lax.while_loop(
+                cond_w, body_w,
+                (jnp.int32(0), member, states, alive, jnp.bool_(False)),
+            )
+            return member, states, alive, failed
+
         # ---- barrier scan: pass/direct inline, heavy behind a cond ----
         def body(carry, xs):
             member, states, alive, failed = carry
@@ -384,6 +538,7 @@ def check_wgl_witness(
     max_window: int = 32768,
     width_hint: int = 0,
     time_limit_s: Optional[float] = None,
+    pallas: str = "auto",
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
 
@@ -393,7 +548,12 @@ def check_wgl_witness(
 
     `width_hint` forces at least that window width so a warm-up run can
     pre-compile the kernels a bigger history will use (see plan_width).
+
+    `pallas`: "auto" runs the easy sweep as a Pallas VMEM kernel on TPU
+    backends and the XLA scan elsewhere; "on"/"interpret"/"off" force a
+    mode ("interpret" is the CPU-testable emulation of the kernel).
     """
+    import jax
     import jax.numpy as jnp
 
     t0 = time.monotonic()
@@ -416,13 +576,26 @@ def check_wgl_witness(
     NB = blocks_per_call
     W = _bucket(max(max(len(a) for _, _, a in blocks), width_hint, 1))
 
+    if pallas not in ("auto", "on", "off", "interpret"):
+        raise ValueError(f"unknown pallas mode {pallas!r}")
+    if pallas == "auto":
+        # devices()[0].platform is "tpu" even under tunneled plugin
+        # platforms whose backend name differs (e.g. axon).
+        pallas = "on" if jax.devices()[0].platform == "tpu" else "off"
+    if pm.jax_step_rows is None or B > 32:
+        # No Mosaic-safe batched step for this model, or the beam no
+        # longer fits the kernel's one-word member bit-packing.
+        pallas = "off"
+
     # The step fn itself keys the cache (strong ref): an id() key
     # can collide after GC address reuse and serve the wrong
     # model's transition kernel.
-    key = (B, W, SW, K, D, NB, pm.jax_step)
+    key = (B, W, SW, K, D, NB, pm.jax_step, pallas)
     fn = _chunk_fn_cache.get(key)
     if fn is None:
-        fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step)
+        fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
+                            pallas_mode=pallas,
+                            jax_step_rows=pm.jax_step_rows)
         _chunk_fn_cache[key] = fn
 
     member = jnp.zeros((W, B), dtype=bool)

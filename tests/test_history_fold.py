@@ -10,8 +10,8 @@ from jepsen_tpu.history import (
     Fold,
     History,
     Op,
-    fold,
     loopf,
+    run_fold as fold,
     task,
 )
 
