@@ -61,9 +61,28 @@ class Mutex(Model):
 
             held = state[0]
             is_acq = f == F_ACQUIRE
-            legal = ((held == 0) & is_acq) | ((held == 1) & ~is_acq)
+            # where() rather than &~: `f` may be a plain Python int
+            # (tests, py callers), and ~bool is deprecated.
+            legal = jnp.where(is_acq, held == 0, held == 1)
             new = jnp.where(is_acq, 1, 0)
             return state.at[0].set(new), legal
+
+        def jax_step_rows(states, f, a0, a1):
+            # Scatter-free lane-major form for the Pallas sweep
+            # (states is (1, B)).
+            import jax.numpy as jnp
+
+            held = states[0]
+            is_acq = f == F_ACQUIRE
+            # int32 legality: Mosaic fails to legalize selects that
+            # produce bool vectors (see _make_pallas_sweep).
+            legal = jnp.where(
+                is_acq,
+                (held == 0).astype(jnp.int32),
+                (held == 1).astype(jnp.int32),
+            )
+            new = jnp.where(is_acq, 1, 0)
+            return jnp.broadcast_to(new, held.shape)[None, :], legal
 
         def describe_op(f: int, a0: int, a1: int) -> str:
             return "acquire" if f == F_ACQUIRE else "release"
@@ -77,6 +96,7 @@ class Mutex(Model):
             jax_step=jax_step,
             interner=interner,
             describe_op=describe_op,
+            jax_step_rows=jax_step_rows,
         )
 
 
