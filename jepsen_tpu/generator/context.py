@@ -94,18 +94,34 @@ class Context:
             ext={},
         )
 
-    def _clone(self, **kw: Any) -> "Context":
+    def _clone(self, *, time: Any = None, next_thread_index: Any = None,
+               all_mask: Any = None, free_mask: Any = None,
+               thread_process: Any = None, process_thread: Any = None,
+               ext: Any = None) -> "Context":
+        # Named parameters, not **kw: this runs ~3x per scheduled op
+        # and the kwargs-dict form showed up in whole-stack profiles.
+        # None is never a legitimate value for any of these fields, so
+        # it doubles as the keep-current sentinel.
         return Context(
-            time=kw.get("time", self.time),
-            next_thread_index=kw.get("next_thread_index", self.next_thread_index),
+            time=self.time if time is None else time,
+            next_thread_index=(
+                self.next_thread_index if next_thread_index is None
+                else next_thread_index
+            ),
             names=self.names,
             index=self._index,
             int_thread_count=self.int_thread_count,
-            all_mask=kw.get("all_mask", self.all_mask),
-            free_mask=kw.get("free_mask", self.free_mask),
-            thread_process=kw.get("thread_process", self.thread_process),
-            process_thread=kw.get("process_thread", self.process_thread),
-            ext=kw.get("ext", self.ext),
+            all_mask=self.all_mask if all_mask is None else all_mask,
+            free_mask=self.free_mask if free_mask is None else free_mask,
+            thread_process=(
+                self.thread_process if thread_process is None
+                else thread_process
+            ),
+            process_thread=(
+                self.process_thread if process_thread is None
+                else process_thread
+            ),
+            ext=self.ext if ext is None else ext,
         )
 
     # -- map-ish behavior (context.clj "contexts also behave like maps") ----
