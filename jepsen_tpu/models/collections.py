@@ -194,6 +194,35 @@ def _queue_packed(initial, capacity: int, *, fifo: bool):
         new = jnp.where(is_enq, enq, jnp.where(present, deq, state))
         return jnp.sort(new), legal
 
+    def jax_step_rows(states, f, a0, a1):
+        # Scatter-free lane-major FIFO step for the Pallas sweep
+        # (states is (C, B), left-aligned): the enqueue slot is picked
+        # by a row-iota mask, dequeue is a static one-row shift.  The
+        # unordered variant needs a per-lane sort, which Mosaic has no
+        # cheap form for — it stays on the XLA-scan sweep.
+        import jax
+        import jax.numpy as jnp
+
+        is_enq = f == F_ENQ
+        nonzero = (states != 0).astype(jnp.int32)
+        length = nonzero.sum(axis=0)                      # (B,)
+        has_room = (length < C).astype(jnp.int32)
+        row = jax.lax.broadcasted_iota(jnp.int32, (C, 1), 0)
+        slot = row == length[None, :]                     # (C, B)
+        # length == C matches no row, so a full lane keeps its state.
+        enq = jnp.where(slot, a0, states)
+        head_ok = ((states[0] == a0) & (a0 != 0)).astype(jnp.int32)
+        deq = jnp.concatenate(
+            [states[1:], jnp.zeros((1, states.shape[1]), jnp.int32)],
+            axis=0,
+        )
+        legal = jnp.where(is_enq, has_room, head_ok)
+        new = jnp.where(
+            is_enq, enq,
+            jnp.where((head_ok != 0)[None, :], deq, states),
+        )
+        return new, legal
+
     def validate_packed(packed) -> "str | None":
         # Sound size bound at any linearization point t: every enqueue
         # invoked by t could be in the queue; dequeues completed by t
@@ -230,6 +259,7 @@ def _queue_packed(initial, capacity: int, *, fifo: bool):
         interner=interner,
         describe_op=describe_op,
         validate_packed=validate_packed,
+        jax_step_rows=jax_step_rows if fifo else None,
     )
 
 
