@@ -654,14 +654,45 @@ def check_wgl_witness(
                 present_np[bi, nw:] = False
             prev_active = active
 
-        member, states, alive, failed = fn(
-            member, states, alive, failed,
-            jnp.asarray(bars_np), jnp.asarray(tab_np),
-            jnp.asarray(perm_np), jnp.asarray(present_np),
-            jnp.asarray(k0s_np),
-        )
-        # One sync per chunk (~32k barriers): early exit + time budget.
-        if bool(failed):
+        try:
+            member, states, alive, failed = fn(
+                member, states, alive, failed,
+                jnp.asarray(bars_np), jnp.asarray(tab_np),
+                jnp.asarray(perm_np), jnp.asarray(present_np),
+                jnp.asarray(k0s_np),
+            )
+            # One sync per chunk (~32k barriers): early exit + time
+            # budget.  The sync ALSO belongs inside the try — jitted
+            # dispatch is asynchronous, so execution-time failures
+            # only raise when a result is consumed.
+            failed_now = bool(failed)
+        except Exception:
+            if pallas != "on":
+                raise
+            # A Mosaic compile or transient runtime failure on the
+            # tunneled chip must not cost the verdict: evict the
+            # kernel and restart this search on the XLA-scan sweep.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas sweep failed; retrying witness on the XLA "
+                "scan sweep", exc_info=True,
+            )
+            _chunk_fn_cache.pop(key, None)
+            if time_limit_s is not None:
+                remaining = time_limit_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    return None  # budget blown: escalate directly
+            else:
+                remaining = None
+            return check_wgl_witness(
+                packed, pm, beam=beam, bars_per_block=bars_per_block,
+                blocks_per_call=blocks_per_call, depth=depth,
+                info_window=info_window, max_window=max_window,
+                width_hint=width_hint, time_limit_s=remaining,
+                pallas="off",
+            )
+        if failed_now:
             return None
         if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
             return None

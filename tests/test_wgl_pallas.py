@@ -115,6 +115,39 @@ def test_mutex_rows_step_parity_and_witness():
     assert _verdict(a) == _verdict(b) is True
 
 
+def test_pallas_runtime_failure_falls_back_to_scan(monkeypatch):
+    """A Mosaic/remote-compile failure mid-search must retry on the
+    XLA-scan sweep, not surface as an error."""
+    import jepsen_tpu.ops.wgl_witness as w
+
+    pm = cas_register().packed()
+    h = random_register_history(512, procs=4, info_rate=0.1, seed=9)
+    p = pack_history(h, pm.encode)
+
+    real_make = w._make_chunk_fn
+    calls = []
+
+    def fake_make(B, W, SW, K, D, NB, jax_step, pallas_mode="off",
+                  jax_step_rows=None):
+        calls.append(pallas_mode)
+        if pallas_mode == "on":
+            def boom(*a, **k):
+                raise RuntimeError("Mosaic failed to compile TPU kernel")
+            return boom
+        return real_make(B, W, SW, K, D, NB, jax_step,
+                         pallas_mode=pallas_mode,
+                         jax_step_rows=jax_step_rows)
+
+    monkeypatch.setattr(w, "_make_chunk_fn", fake_make)
+    w._chunk_fn_cache.clear()
+    try:
+        r = w.check_wgl_witness(p, pm, pallas="on")
+    finally:
+        w._chunk_fn_cache.clear()
+    assert _verdict(r) is True
+    assert calls == ["on", "off"]
+
+
 def test_models_without_rows_step_fall_back():
     from jepsen_tpu.models import unordered_queue
 
