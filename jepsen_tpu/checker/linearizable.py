@@ -91,6 +91,12 @@ class Linearizable(Checker):
                 max_beam=self.max_beam,
                 block=self.block,
                 time_limit_s=self.time_limit_s,
+                # "search-mesh" shards this ONE search's BFS frontier
+                # across devices (the within-search axis).  It is a
+                # distinct key from "mesh", which already means the
+                # ACROSS-keys axis (parallel/independent.py) — the two
+                # compose badly if conflated.
+                mesh=(test or {}).get("search-mesh"),
             )
         except RuntimeError as e:
             # No usable accelerator (backend init failure): the CPU

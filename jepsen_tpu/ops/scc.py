@@ -94,10 +94,9 @@ def _get_kernel(K: int, V: int, mesh=None):
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
-        try:
-            from jax import shard_map  # jax >= 0.8
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
+        from ..parallel.mesh import shard_map_compat
+
+        shard_map, _rep_kw = shard_map_compat()
 
         fn = jax.jit(
             shard_map(

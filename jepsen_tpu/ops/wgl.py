@@ -58,6 +58,97 @@ def _hash_vectors(w: int, sw: int, seed: int = 0x5EED) -> tuple[np.ndarray, ...]
     )
 
 
+
+def _expand_level(member, states, alive, tables, n_rows, n_slots,
+                  jax_step):
+    """One frontier level's expansion, shared by the single-device and
+    frontier-sharded block fns: candidate rule (two masked
+    min-reductions per config), static-size compaction, vmapped model
+    step, child bitsets, acceptance and dedup hashes.  `n_rows` is the
+    (local) frontier height, `n_slots` the (local) candidate budget.
+
+    Returns (child, new_states, live_c, h1, h2, accepted_any,
+    overflow)."""
+    import jax
+    import jax.numpy as jnp
+
+    (ret_w, inv_w, f_w, a0_w, a1_w, ok_w, fmin1, f_has_ok,
+     h1v, h2v, sh1v, sh2v) = tables
+    W = ret_w.shape[0]
+
+    # --- candidate rule ---------------------------------------------
+    nm_ret = jnp.where(member | ~alive[:, None], INF, ret_w[None, :])
+    m1w = nm_ret.min(axis=1)
+    am1 = jnp.argmin(nm_ret, axis=1)
+    nm_ret2 = nm_ret.at[jnp.arange(n_rows), am1].set(INF)
+    m2w = nm_ret2.min(axis=1)
+    # Merge with the (host-precomputed) min over "future" ops outside
+    # the window — they are non-members of every config.
+    is_w_min = m1w <= fmin1
+    total_m1 = jnp.minimum(m1w, fmin1)
+    second_for_argmin = jnp.minimum(m2w, fmin1)
+    bound = jnp.where(
+        (jnp.arange(W)[None, :] == am1[:, None]) & is_w_min[:, None],
+        second_for_argmin[:, None],
+        total_m1[:, None],
+    )
+    order_ok = (~member) & alive[:, None] & (inv_w[None, :] < bound)
+
+    # --- compact candidate (config, op) pairs ------------------------
+    flat = order_ok.reshape(-1)
+    count = flat.sum()
+    cand_idx = jnp.nonzero(flat, size=n_slots, fill_value=0)[0]
+    valid_c = jnp.arange(n_slots) < count
+    overflow = count > n_slots
+    parent = cand_idx // W
+    a = cand_idx % W
+
+    # --- model transition, vmapped over survivors only ---------------
+    new_states, legal = jax.vmap(jax_step)(
+        states[parent], f_w[a], a0_w[a], a1_w[a]
+    )
+    live_c = valid_c & legal
+
+    child = member[parent]
+    child = child.at[jnp.arange(n_slots), a].set(True)
+
+    # --- acceptance: some live child covers every :ok op -------------
+    cover = (child | ~ok_w[None, :]).all(axis=1)
+    accepted_any = jnp.any(live_c & cover & ~f_has_ok)
+
+    # --- dedup hashes ------------------------------------------------
+    cf = child.astype(jnp.float32)
+    sf = new_states.astype(jnp.float32)
+    big = jnp.float32(3.0e38)
+    h1 = jnp.where(live_c, cf @ h1v + sf @ sh1v, big)
+    h2 = jnp.where(live_c, cf @ h2v + sf @ sh2v, big)
+    return child, new_states, live_c, h1, h2, accepted_any, overflow
+
+
+def _dedup_sort(child, new_states, live_c, h1, h2, n_slots):
+    """Hash-sort + exact adjacent compare over candidates: equal
+    configs always hash equal, so dedup is exact; collisions only cost
+    slots.  Returns (child_s, states_s, uniq, n_uniq) in sort order."""
+    import jax
+    import jax.numpy as jnp
+
+    h1s, h2s, perm = jax.lax.sort(
+        (h1, h2, jnp.arange(n_slots)), num_keys=2
+    )
+    child_s = child[perm]
+    states_s = new_states[perm]
+    live_s = live_c[perm]
+    same_h = (h1s == jnp.roll(h1s, 1)) & (h2s == jnp.roll(h2s, 1))
+    same_h = same_h.at[0].set(False)
+    same_full = (
+        same_h
+        & (child_s == jnp.roll(child_s, 1, axis=0)).all(axis=1)
+        & (states_s == jnp.roll(states_s, 1, axis=0)).all(axis=1)
+    )
+    uniq = live_s & ~same_full
+    return child_s, states_s, uniq, uniq.sum()
+
+
 def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step):
     """Builds the jitted block runner for static shapes (B, W, SW, Cmax).
 
@@ -69,69 +160,14 @@ def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step):
 
     def level_step(carry, tables):
         member, states, alive, accepted, incomplete, explored, it = carry
-        (ret_w, inv_w, f_w, a0_w, a1_w, ok_w, fmin1, f_has_ok, h1v, h2v, sh1v, sh2v) = tables
-
-        # --- candidate rule: two masked min-reductions per config -------
-        nm_ret = jnp.where(member | ~alive[:, None], INF, ret_w[None, :])  # (B, W)
-        m1w = nm_ret.min(axis=1)
-        am1 = jnp.argmin(nm_ret, axis=1)
-        nm_ret2 = nm_ret.at[jnp.arange(B), am1].set(INF)
-        m2w = nm_ret2.min(axis=1)
-        # Merge with the (host-precomputed) min over "future" ops outside
-        # the window — they are non-members of every config.
-        is_w_min = m1w <= fmin1
-        total_m1 = jnp.minimum(m1w, fmin1)
-        second_for_argmin = jnp.minimum(m2w, fmin1)
-        bound = jnp.where(
-            (jnp.arange(W)[None, :] == am1[:, None]) & is_w_min[:, None],
-            second_for_argmin[:, None],
-            total_m1[:, None],
+        child, new_states, live_c, h1, h2, acc, overflow = _expand_level(
+            member, states, alive, tables, B, Cmax, jax_step
         )
-        order_ok = (~member) & alive[:, None] & (inv_w[None, :] < bound)
-
-        # --- compact candidate (config, op) pairs ------------------------
-        flat = order_ok.reshape(-1)
-        count = flat.sum()
-        cand_idx = jnp.nonzero(flat, size=Cmax, fill_value=0)[0]
-        valid_c = jnp.arange(Cmax) < count
-        incomplete = incomplete | (count > Cmax)
-        parent = cand_idx // W
-        a = cand_idx % W
-
-        # --- model transition, vmapped over survivors only ---------------
-        new_states, legal = jax.vmap(jax_step)(
-            states[parent], f_w[a], a0_w[a], a1_w[a]
+        accepted = accepted | acc
+        incomplete = incomplete | overflow
+        child_s, states_s, uniq, n_uniq = _dedup_sort(
+            child, new_states, live_c, h1, h2, Cmax
         )
-        live_c = valid_c & legal
-
-        child = member[parent]
-        child = child.at[jnp.arange(Cmax), a].set(True)
-
-        # --- acceptance: some live child covers every :ok op -------------
-        cover = (child | ~ok_w[None, :]).all(axis=1)
-        accepted = accepted | jnp.any(live_c & cover & ~f_has_ok)
-
-        # --- dedup: hash-sort + exact adjacent compare -------------------
-        cf = child.astype(jnp.float32)
-        sf = new_states.astype(jnp.float32)
-        big = jnp.float32(3.0e38)
-        h1 = jnp.where(live_c, cf @ h1v + sf @ sh1v, big)
-        h2 = jnp.where(live_c, cf @ h2v + sf @ sh2v, big)
-        h1s, h2s, perm = jax.lax.sort(
-            (h1, h2, jnp.arange(Cmax)), num_keys=2
-        )
-        child_s = child[perm]
-        states_s = new_states[perm]
-        live_s = live_c[perm]
-        same_h = (h1s == jnp.roll(h1s, 1)) & (h2s == jnp.roll(h2s, 1))
-        same_h = same_h.at[0].set(False)
-        same_full = (
-            same_h
-            & (child_s == jnp.roll(child_s, 1, axis=0)).all(axis=1)
-            & (states_s == jnp.roll(states_s, 1, axis=0)).all(axis=1)
-        )
-        uniq = live_s & ~same_full
-        n_uniq = uniq.sum()
         incomplete = incomplete | (n_uniq > B)
 
         # --- select the next frontier ------------------------------------
@@ -170,6 +206,117 @@ def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step):
         return jax.lax.while_loop(cond, body, carry)
 
     return jax.jit(block)
+
+
+def _make_block_fn_sharded(B: int, W: int, SW: int, Cmax: int, jax_step,
+                           mesh):
+    """Frontier-sharded variant of _make_block_fn: ONE search's beam
+    splits across the mesh (the within-search axis SURVEY.md §5 frames
+    as the ring-attention analog — parallelism over the configuration
+    frontier rather than over sequence position).
+
+    Layout per level: the B frontier rows and their candidate
+    expansion (the FLOP-heavy part: candidate rule over (B, W),
+    Cmax model steps, (Cmax, W) child bitsets) are sharded B/n per
+    device; candidates then `all_gather` over ICI (hashes + bitsets +
+    states) and the small global dedup-sort runs replicated, after
+    which each device keeps its B/n slice of the new frontier.
+    Verdict-relevant scalars (accepted / incomplete / n_alive) are
+    globalized with `psum`, so control flow stays identical on every
+    device.  Verdicts match the single-device search exactly; the one
+    behavioral difference is overflow detection — candidate compaction
+    is per-shard (Cmax/n slots each), so a lopsided level can trip the
+    (sound) beam-retry/unknown path where the global compactor would
+    not."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import shard_map_compat
+
+    shard_map, rep_kw = shard_map_compat()
+
+    axis = mesh.axis_names[0]
+    n = mesh.devices.size
+    assert B % n == 0 and Cmax % n == 0, (B, Cmax, n)
+    B_l = B // n
+    C_l = Cmax // n
+
+    def level_step(carry, tables):
+        (member, states, alive, accepted, incomplete, explored, it,
+         n_alive) = carry
+
+        # --- expansion on the LOCAL frontier rows -----------------------
+        child, new_states, live_c, h1, h2, acc_local, local_overflow = (
+            _expand_level(
+                member, states, alive, tables, B_l, C_l, jax_step
+            )
+        )
+
+        # --- globalize: gather candidates, psum flags -------------------
+        def gather(x):
+            return jax.lax.all_gather(x, axis).reshape(
+                (Cmax,) + x.shape[1:]
+            )
+
+        child_g = gather(child)
+        states_g = gather(new_states)
+        live_g = gather(live_c)
+        h1_g = gather(h1)
+        h2_g = gather(h2)
+        accepted = accepted | (
+            jax.lax.psum(acc_local.astype(jnp.int32), axis) > 0
+        )
+        incomplete = incomplete | (
+            jax.lax.psum(local_overflow.astype(jnp.int32), axis) > 0
+        )
+
+        # --- replicated dedup-sort over the gathered candidates ---------
+        child_s, states_s, uniq, n_uniq = _dedup_sort(
+            child_g, states_g, live_g, h1_g, h2_g, Cmax
+        )
+        incomplete = incomplete | (n_uniq > B)
+
+        # --- each device keeps its slice of the new frontier ------------
+        sel = jnp.nonzero(uniq, size=B, fill_value=0)[0]
+        d = jax.lax.axis_index(axis)
+        sel_l = jax.lax.dynamic_slice_in_dim(sel, d * B_l, B_l)
+        n_alive = jnp.minimum(n_uniq, B)
+        new_alive = (jnp.arange(B_l) + d * B_l) < n_alive
+        new_member = child_s[sel_l]
+        new_states_f = states_s[sel_l]
+        explored = explored + n_alive
+        return (
+            new_member, new_states_f, new_alive,
+            accepted, incomplete, explored, it + 1, n_alive,
+        )
+
+    def block_local(member, states, alive, iters, *tables):
+        def cond(carry):
+            _, _, _, accepted, _, _, it, n_alive = carry
+            return (~accepted) & (n_alive > 0) & (it < iters)
+
+        def body(carry):
+            return level_step(carry, tables)
+
+        n_alive0 = jax.lax.psum(alive.sum(), axis)
+        carry = (
+            member, states, alive,
+            jnp.bool_(False), jnp.bool_(False),
+            jnp.int32(0), jnp.int32(0), n_alive0,
+        )
+        out = jax.lax.while_loop(cond, body, carry)
+        return out[:7]  # drop the internal n_alive
+
+    pb = P(axis)
+    pr = P()
+    sharded = shard_map(
+        block_local, mesh=mesh,
+        in_specs=(P(axis, None), P(axis, None), pb, pr) + (pr,) * 12,
+        out_specs=(P(axis, None), P(axis, None), pb, pr, pr, pr, pr),
+        **rep_kw,
+    )
+    return jax.jit(sharded)
 
 
 def _bucket(x: int, lo: int = 256) -> int:
@@ -238,6 +385,7 @@ def check_wgl_device(
     time_limit_s: Optional[float] = None,
     witness: bool = True,
     width_hint: int = 0,
+    mesh: Any = None,
 ) -> WGLResult:
     """Decides linearizability of one packed history on the default JAX
     device.
@@ -250,11 +398,31 @@ def check_wgl_device(
     "unknown" (valid verdicts remain sound).  `max_beam` defaults low:
     beyond ~4096 the ladder's recompiles and frontier costs exceed the
     CPU fallback's (round-1 measurement: 65536 hung >280 s where 4096
-    finished in 12 s)."""
+    finished in 12 s).
+
+    `mesh`: a 1-D `jax.sharding.Mesh` shards the BFS *frontier* of this
+    single search across devices (_make_block_fn_sharded) — the
+    within-search parallel axis, complementing the across-keys axis of
+    ops/wgl_batched.py.  The witness tier stays single-device (its
+    frontier is a handful of lanes)."""
     import jax
     import jax.numpy as jnp
 
     t0 = time.monotonic()
+    if mesh is not None:
+        # Validate up front, before any search work: the frontier and
+        # candidate budget shard evenly only over power-of-two mesh
+        # sizes (beam sizes are power-of-two buckets).  NOTE the
+        # sharded path also assumes a single-host mesh — the
+        # window-boundary re-gather pulls the frontier to the host.
+        n_dev = int(mesh.devices.size)
+        b0 = _bucket(beam)
+        if n_dev < 1 or b0 % n_dev or (cand_factor * b0) % n_dev:
+            raise ValueError(
+                f"mesh size {n_dev} must evenly divide the beam "
+                f"bucket {b0} and its candidate budget"
+            )
+
     N = packed.n
     if N == 0 or packed.n_ok == 0:
         return WGLResult(valid=True, configs_explored=1, elapsed_s=time.monotonic() - t0)
@@ -360,10 +528,15 @@ def check_wgl_device(
             # The step fn itself keys the cache (strong ref): an
             # id() key can collide after GC address reuse and serve
             # the wrong model's transition kernel.
-            key = (B, W, SW, Cmax, pm.jax_step)
+            key = (B, W, SW, Cmax, pm.jax_step, mesh)
             fn = _block_fn_cache.get(key)
             if fn is None:
-                fn = _make_block_fn(B, W, SW, Cmax, pm.jax_step)
+                if mesh is not None:
+                    fn = _make_block_fn_sharded(
+                        B, W, SW, Cmax, pm.jax_step, mesh
+                    )
+                else:
+                    fn = _make_block_fn(B, W, SW, Cmax, pm.jax_step)
                 _block_fn_cache[key] = fn
             targs = [
                 jnp.asarray(tables["ret_w"]),

@@ -231,16 +231,9 @@ def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None):
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
 
-        try:
-            from jax import shard_map  # jax >= 0.8
-            # Replication checking was renamed check_rep -> check_vma
-            # with the stable API; disabled either way (outputs are
-            # fully sharded over keys, nothing is replicated).
-            rep_kw = {"check_vma": False}
-        except ImportError:  # pragma: no cover - older jax
-            from jax.experimental.shard_map import shard_map
+        from ..parallel.mesh import shard_map_compat
 
-            rep_kw = {"check_rep": False}
+        shard_map, rep_kw = shard_map_compat()
 
         pk = P("keys")
         in_specs = (pk, pk, pk, pk, pk, pk, P(None), pk)
