@@ -62,9 +62,15 @@ class Worker:
     """One logical worker: a thread pulling ops from a private queue and
     pushing completions to the shared queue (interpreter.clj:22-34)."""
 
-    def __init__(self, id: Any, completions: "queue.Queue[Op]"):
+    def __init__(self, id: Any, completions: "queue.SimpleQueue[Op]"):
         self.id = id
-        self.in_queue: "queue.Queue[Any]" = queue.Queue(maxsize=1)
+        # SimpleQueue: C-implemented, far lighter than queue.Queue's
+        # lock/condition machinery on the per-op handoff path.  The
+        # reference's capacity-1 bound (ArrayBlockingQueue 1) needs no
+        # enforcement here: the scheduler only hands an op to a FREE
+        # worker, so at most one op (plus the exit sentinel) is ever
+        # in flight.
+        self.in_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
         self.completions = completions
         self.thread = threading.Thread(
             target=self._run, name=f"jepsen-worker-{id}", daemon=True
@@ -118,7 +124,7 @@ class ClientWorker(Worker):
     (interpreter.clj:36-70)."""
 
     def __init__(
-        self, id: Any, completions: "queue.Queue[Op]", test: dict
+        self, id: Any, completions: "queue.SimpleQueue[Op]", test: dict
     ):
         super().__init__(id, completions)
         self.test = test
@@ -173,7 +179,8 @@ class NemesisWorker(Worker):
     """Applies ops to the test's nemesis; the nemesis object is shared
     and long-lived (interpreter.clj:92-100)."""
 
-    def __init__(self, id: Any, completions: "queue.Queue[Op]", test: dict):
+    def __init__(self, id: Any, completions: "queue.SimpleQueue[Op]",
+                 test: dict):
         super().__init__(id, completions)
         self.test = test
         self.nemesis: Nemesis = test["nemesis"]
@@ -191,7 +198,8 @@ class NemesisWorker(Worker):
         return out
 
 
-def spawn_worker(test: dict, completions: "queue.Queue[Op]", id: Any) -> Worker:
+def spawn_worker(test: dict, completions: "queue.SimpleQueue[Op]",
+                 id: Any) -> Worker:
     """interpreter.clj:102-167."""
     if id == NEMESIS:
         return NemesisWorker(id, completions, test)
@@ -211,7 +219,7 @@ def run(
     ctx = Context.for_test(test)
     gen = validate(friendly_exceptions(test["generator"]))
 
-    completions: "queue.Queue[Op]" = queue.Queue()
+    completions: "queue.SimpleQueue[Op]" = queue.SimpleQueue()
     workers: dict[Any, Worker] = {
         thread: spawn_worker(test, completions, thread)
         for thread in ctx.all_threads()

@@ -96,12 +96,13 @@ def _get_kernel(K: int, V: int, mesh=None):
 
         from ..parallel.mesh import shard_map_compat
 
-        shard_map, _rep_kw = shard_map_compat()
+        shard_map, rep_kw = shard_map_compat()
 
         fn = jax.jit(
             shard_map(
                 has_cycle, mesh=mesh,
                 in_specs=P("keys"), out_specs=P("keys"),
+                **rep_kw,
             )
         )
     else:
