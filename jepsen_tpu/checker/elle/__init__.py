@@ -28,6 +28,7 @@ __all__ = [
     "analyze_wr",
     "check_cycles",
     "graph",
+    "write_artifacts",
 ]
 
 
@@ -46,6 +47,62 @@ def _device_cycle_fn(device: str):
     return screened
 
 
+def write_artifacts(result: dict, opts: Optional[dict],
+                    subdir: str = "elle") -> None:
+    """Persists an invalid analysis into the store directory the way
+    elle writes its :directory artifacts (consumed by the reference at
+    tests/cycle/append.clj via the :directory option): a JSON anomaly
+    dump plus one Graphviz DOT file per reported cycle, so a human can
+    `dot -Tsvg` the dependency cycle that failed the test."""
+    import json
+    import logging
+    import os
+
+    directory = (opts or {}).get("dir")
+    if not directory or result.get("valid") is True:
+        return
+    try:
+        out = os.path.join(directory, subdir)
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, "anomalies.json"), "w") as f:
+            json.dump(
+                {
+                    "valid": result.get("valid"),
+                    "anomaly-types": result.get("anomaly-types"),
+                    "anomalies": result.get("anomalies"),
+                },
+                f, indent=2, default=repr,
+            )
+        cycles = result.get("anomalies")
+        if isinstance(cycles, dict):
+            cycles = [c for v in cycles.values() if isinstance(v, list)
+                      for c in v if isinstance(c, dict) and "cycle" in c]
+        elif isinstance(cycles, list):
+            cycles = [c for c in cycles
+                      if isinstance(c, dict) and "cycle" in c]
+        else:
+            cycles = []
+        for i, c in enumerate(cycles):
+            lines = ["digraph cycle {"]
+            for step in c.get("steps", []):
+                label = ",".join(step.get("types", []))
+                lines.append(
+                    f'  "T{step["from"]}" -> "T{step["to"]}" '
+                    f'[label="{label}"];'
+                )
+            lines.append("}")
+            name = f"cycle-{i}-{c.get('type', 'cycle')}.dot"
+            with open(os.path.join(out, name), "w") as f:
+                f.write("\n".join(lines) + "\n")
+    except OSError as e:
+        # A side-output failure (read-only/deleted store dir, full
+        # disk) must never escape and let check_safe downgrade an
+        # already-computed invalid verdict to "unknown".
+        logging.getLogger(__name__).warning(
+            "could not write elle artifacts to %s: %r", directory, e
+        )
+
+
 class AppendChecker(Checker):
     """checker for list-append workloads (append.clj:6-27).  `device`:
     "auto"/"on" screens cycle search on the accelerator, "off" keeps it
@@ -57,11 +114,13 @@ class AppendChecker(Checker):
         self.device = device
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
-        return analyze_append(
+        res = analyze_append(
             history.client_ops(),
             consistency_model=self.consistency_model,
             cycle_fn=_device_cycle_fn(self.device),
         )
+        write_artifacts(res, opts, "elle-append")
+        return res
 
 
 class WrChecker(Checker):
@@ -74,8 +133,10 @@ class WrChecker(Checker):
         self.device = device
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
-        return analyze_wr(
+        res = analyze_wr(
             history.client_ops(),
             consistency_model=self.consistency_model,
             cycle_fn=_device_cycle_fn(self.device),
         )
+        write_artifacts(res, opts, "elle-wr")
+        return res

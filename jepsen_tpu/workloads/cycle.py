@@ -110,13 +110,17 @@ class CycleChecker(Checker):
         graph = combine(a(h) for a in self.analyzers)
         cycles = (_device_cycle_fn(self.device) or check_cycles)(graph)
         anomaly_types = sorted({c["type"] for c in cycles})
-        return {
+        res = {
             "valid": not cycles,
             "anomaly-types": anomaly_types,
             "anomalies": cycles,
             "vertices": len(graph.vertices),
             "edges": graph.n_edges(),
         }
+        from ..checker.elle import write_artifacts
+
+        write_artifacts(res, opts, "elle-cycle")
+        return res
 
 
 def checker(*analyzers: Analyzer, device: str = "off") -> CycleChecker:

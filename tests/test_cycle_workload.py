@@ -97,6 +97,43 @@ def test_custom_edge_types_still_detected():
     assert set(c["cycle"]) == {0, 2}
 
 
+def test_invalid_run_writes_elle_artifacts(tmp_path):
+    # Like elle's :directory artifacts: anomalies.json + a DOT file
+    # per cycle land in the store dir on an invalid verdict.
+    import json
+    import os
+
+    hist = _h([
+        (0, INVOKE, "w", 1), (0, OK, "w", 1),
+        (1, INVOKE, "w", 2), (1, OK, "w", 2),
+    ])
+
+    def analyzer(h):
+        g = DepGraph()
+        g.add_edge(0, 2, "ww")
+        g.add_edge(2, 0, "wr")
+        return g
+
+    res = cycle.checker(analyzer).check(
+        {}, hist, {"dir": str(tmp_path)}
+    )
+    assert res["valid"] is False
+    out = tmp_path / "elle-cycle"
+    data = json.loads((out / "anomalies.json").read_text())
+    assert data["anomaly-types"] == ["G1c"]
+    [dot] = [p for p in os.listdir(out) if p.endswith(".dot")]
+    text = (out / dot).read_text()
+    assert '"T0" -> "T2"' in text or '"T2" -> "T0"' in text
+    assert "digraph" in text
+
+    # Valid runs write nothing.
+    res2 = cycle.checker(lambda h: DepGraph()).check(
+        {}, hist, {"dir": str(tmp_path / "clean")}
+    )
+    assert res2["valid"] is True
+    assert not (tmp_path / "clean").exists()
+
+
 # -- stock analyzers ------------------------------------------------------
 
 
