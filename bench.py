@@ -117,11 +117,23 @@ def run_bench() -> int:
         # overruns before the JSON line prints — round-1 rc=124).
         budget = max(30.0, budget - (time.monotonic() - warm_start))
 
-        t0 = time.monotonic()
-        res = check_wgl_device(packed, pm, time_limit_s=budget)
-        elapsed = time.monotonic() - t0
-
-        if res.valid is not True:
+        # Median of three measured reps: single-run wall time on the
+        # tunneled chip varies ~+-20% (round-2 observation), and the
+        # recorded round metric should reflect the kernel, not the
+        # tunnel's mood.  Budget still bounds the total; once ANY rep
+        # has a valid verdict, later reps are refinement only — a
+        # late-rep timeout keeps the measurements already in hand
+        # rather than discarding a decided run.
+        times = []
+        for _ in range(3):
+            t0 = time.monotonic()
+            res = check_wgl_device(packed, pm, time_limit_s=budget)
+            elapsed = time.monotonic() - t0
+            if res.valid is not True:
+                break
+            times.append(elapsed)
+            budget = max(15.0, budget - elapsed)
+        if not times:
             emit(
                 0.0,
                 0.0,
@@ -132,6 +144,8 @@ def run_bench() -> int:
                 platform=platform,
             )
             return 1
+        times.sort()
+        elapsed = times[len(times) // 2]
 
         ops_per_s = packed.n / elapsed
         emit(
