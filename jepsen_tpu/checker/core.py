@@ -404,6 +404,7 @@ class SetFull(Checker):
         attempts: set = set()
         reads: list[tuple[int, int, set]] = []  # (invoke idx, complete idx, values)
         pending_reads: dict[Any, int] = {}
+        failed: set = set()
         for o in history:
             if not o.is_client_op:
                 continue
@@ -413,6 +414,8 @@ class SetFull(Checker):
                     attempts.add(v)
                 elif o.is_ok:
                     add_done[v] = o.index
+                elif o.is_fail:
+                    failed.add(v)
             elif o.f == "read":
                 if o.is_invoke:
                     pending_reads[o.process] = o.index
@@ -424,14 +427,43 @@ class SetFull(Checker):
         if not reads:
             return {"valid": UNKNOWN, "error": "no read completed"}
 
+        # A :fail add definitely never happened: it neither needs a
+        # witnessing read nor legitimizes one — a sighting of a failed
+        # value is a phantom.
+        attempts -= failed
+
+        # One pass over reads: element -> completion index of the
+        # first read that saw it (the O(attempts x reads) per-element
+        # rescan dominated large checks).
+        first_seen: dict[Any, int] = {}
+        for _, c, vals in reads:
+            for v in vals:
+                if v not in first_seen or c < first_seen[v]:
+                    first_seen[v] = c
+
         lost, stale, never_read, ok_els = [], [], [], []
         unexpected: set = set()
         for _, _, vals in reads:
             unexpected |= vals - attempts
-        for v, done_idx in add_done.items():
-            later = [r for r in reads if r[0] > done_idx]
-            if not later:
+        for v in attempts:
+            done_idx = add_done.get(v)
+            # Visibility point: the earliest moment the element
+            # provably exists — its ack, or the completion of the
+            # first read that SAW it (a sighting proves even an
+            # unacked add happened).  Reads invoked after that point
+            # must keep showing it.
+            seen = first_seen.get(v)
+            points = [p for p in (done_idx, seen) if p is not None]
+            if not points:
                 never_read.append(v)
+                continue
+            vis = min(points)
+            later = [r for r in reads if r[0] > vis]
+            if not later:
+                if seen is not None:
+                    ok_els.append(v)  # witnessed, never contradicted
+                else:
+                    never_read.append(v)
                 continue
             present = [v in vals for _, _, vals in later]
             if not any(present) or not present[-1]:
@@ -444,8 +476,19 @@ class SetFull(Checker):
             else:
                 ok_els.append(v)
         stale_invalid = self.linearizable and bool(stale)
+        # Validity mirrors set-full's three-way verdict
+        # (checker_test.clj:631-730): any lost/phantom element is
+        # false; elements whose fate no read can witness (concurrent
+        # or trailing adds) leave the check "unknown"; true needs
+        # every attempt accounted for.
+        if lost or unexpected or stale_invalid:
+            valid: Any = False
+        elif never_read:
+            valid = UNKNOWN
+        else:
+            valid = True
         return {
-            "valid": not lost and not unexpected and not stale_invalid,
+            "valid": valid,
             "lost": _sorted_sample(set(lost)),
             "lost-count": len(lost),
             "stale": _sorted_sample(set(stale)),

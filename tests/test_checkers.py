@@ -218,6 +218,123 @@ class TestSetFull:
         assert r["valid"] is False
         assert 1 in r["lost"]
 
+    def test_never_read_is_unknown(self):
+        # checker_test.clj:635-649 "never read": an acked add no read
+        # can witness leaves the verdict unknown, not true.
+        r = SetFull().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0), (0, OK, "add", 0),
+                (1, INVOKE, "read", None), (1, OK, "read", [0]),
+                (0, INVOKE, "add", 1), (0, OK, "add", 1),  # after last read
+            ]),
+            {},
+        )
+        assert r["valid"] == "unknown"
+        assert r["never-read"] == [1]
+
+    def test_unacked_never_seen_is_unknown(self):
+        # checker_test.clj:657-668 "never confirmed, never read".
+        r = SetFull().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0),
+                (1, INVOKE, "read", None), (1, OK, "read", []),
+            ]),
+            {},
+        )
+        assert r["valid"] == "unknown"
+        assert r["never-read"] == [0]
+
+    def test_concurrent_read_interleavings_valid(self):
+        # checker_test.clj:669-688: a successful read concurrent with
+        # or after the add settles the element in every interleaving.
+        a = (0, INVOKE, "add", 0)
+        a_ = (0, OK, "add", 0)
+        r = (1, INVOKE, "read", None)
+        rp = (1, OK, "read", [0])
+        for rows in (
+            [r, a, rp, a_],
+            [r, a, a_, rp],
+            [a, r, rp, a_],
+            [a, r, a_, rp],
+            [a, a_, r, rp],
+        ):
+            res = SetFull().check({}, h(rows), {})
+            assert res["valid"] is True, rows
+
+    def test_absent_read_concurrent_is_unknown(self):
+        # checker_test.clj:707-724: an empty read CONCURRENT with the
+        # add proves nothing — unknown, not lost.
+        a = (0, INVOKE, "add", 0)
+        a_ = (0, OK, "add", 0)
+        r = (1, INVOKE, "read", None)
+        rm = (1, OK, "read", [])
+        for rows in (
+            [r, a, rm, a_],
+            [r, a, a_, rm],
+            [a, r, rm, a_],
+            [a, r, a_, rm],
+        ):
+            res = SetFull().check({}, h(rows), {})
+            assert res["valid"] == "unknown", rows
+            assert res["never-read"] == [0]
+
+    def test_absent_read_after_is_lost(self):
+        # checker_test.clj:690-705: an empty read invoked AFTER the ack
+        # is a lost element.
+        res = SetFull().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0), (0, OK, "add", 0),
+                (1, INVOKE, "read", None), (1, OK, "read", []),
+            ]),
+            {},
+        )
+        assert res["valid"] is False
+        assert res["lost"] == [0]
+
+    def test_unacked_but_witnessed_then_vanished_is_lost(self):
+        # An indeterminate add a read once SAW definitely happened; a
+        # later read omitting it is a lost update.
+        res = SetFull().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0),           # never acked
+                (1, INVOKE, "read", None), (1, OK, "read", [0]),
+                (1, INVOKE, "read", None), (1, OK, "read", []),
+            ]),
+            {},
+        )
+        assert res["valid"] is False
+        assert res["lost"] == [0]
+
+    def test_failed_add_excluded_and_phantom_flagged(self):
+        # A :fail add definitely never happened: it must not degrade
+        # the verdict to unknown, and a read that shows it anyway is a
+        # phantom (review finding).
+        res = SetFull().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0), (0, OK, "add", 0),
+                (1, INVOKE, "add", 1), (1, FAIL, "add", 1),
+                (2, INVOKE, "read", None), (2, OK, "read", [0]),
+            ]),
+            {},
+        )
+        assert res["valid"] is True, res
+        res2 = SetFull().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0), (0, OK, "add", 0),
+                (1, INVOKE, "add", 1), (1, FAIL, "add", 1),
+                (2, INVOKE, "read", None), (2, OK, "read", [0, 1]),
+            ]),
+            {},
+        )
+        assert res2["valid"] is False
+        assert res2["unexpected"] == [1]
+
     def test_stale_read_tolerated_by_default(self):
         rows = [
             (0, INVOKE, "add", 1),
