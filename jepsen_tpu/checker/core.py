@@ -404,7 +404,8 @@ class SetFull(Checker):
         attempts: set = set()
         reads: list[tuple[int, int, set]] = []  # (invoke idx, complete idx, values)
         pending_reads: dict[Any, int] = {}
-        failed: set = set()
+        invoke_count: MultiSet = MultiSet()
+        fail_count: MultiSet = MultiSet()
         for o in history:
             if not o.is_client_op:
                 continue
@@ -412,10 +413,11 @@ class SetFull(Checker):
                 v = _hashable(o.value)
                 if o.is_invoke:
                     attempts.add(v)
+                    invoke_count[v] += 1
                 elif o.is_ok:
                     add_done[v] = o.index
                 elif o.is_fail:
-                    failed.add(v)
+                    fail_count[v] += 1
             elif o.f == "read":
                 if o.is_invoke:
                     pending_reads[o.process] = o.index
@@ -427,17 +429,31 @@ class SetFull(Checker):
         if not reads:
             return {"valid": UNKNOWN, "error": "no read completed"}
 
-        # A :fail add definitely never happened: it neither needs a
-        # witnessing read nor legitimizes one — a sighting of a failed
-        # value is a phantom.
-        attempts -= failed
+        # A value whose EVERY attempt failed definitely never entered
+        # the set: it neither needs a witnessing read nor legitimizes
+        # one — a sighting of it is a phantom.  A value that failed
+        # once but was acked (or left indeterminate) on another
+        # attempt is still tracked normally.
+        attempts -= {
+            v for v, n in fail_count.items()
+            if n >= invoke_count[v] and v not in add_done
+        }
 
-        # One pass over reads: element -> completion index of the
-        # first read that saw it (the O(attempts x reads) per-element
-        # rescan dominated large checks).
+        # Index the reads once (the naive per-element rescans were
+        # O(attempts x reads) and dominated large checks): sort by
+        # invoke index, then record for each value the sorted read
+        # positions that contained it, plus its first sighting's
+        # completion index.
+        import bisect
+
+        reads_sorted = sorted(reads, key=lambda r: r[0])
+        invs = [r[0] for r in reads_sorted]
+        n_reads = len(reads_sorted)
+        pos_of: dict[Any, list[int]] = {}
         first_seen: dict[Any, int] = {}
-        for _, c, vals in reads:
+        for pos, (_, c, vals) in enumerate(reads_sorted):
             for v in vals:
+                pos_of.setdefault(v, []).append(pos)
                 if v not in first_seen or c < first_seen[v]:
                     first_seen[v] = c
 
@@ -458,18 +474,21 @@ class SetFull(Checker):
                 never_read.append(v)
                 continue
             vis = min(points)
-            later = [r for r in reads if r[0] > vis]
-            if not later:
+            i0 = bisect.bisect_right(invs, vis)  # first read invoked after vis
+            n_later = n_reads - i0
+            if n_later == 0:
                 if seen is not None:
                     ok_els.append(v)  # witnessed, never contradicted
                 else:
                     never_read.append(v)
                 continue
-            present = [v in vals for _, _, vals in later]
-            if not any(present) or not present[-1]:
+            pos = pos_of.get(v, [])
+            n_present = len(pos) - bisect.bisect_left(pos, i0)
+            in_last = bool(pos) and pos[-1] == n_reads - 1
+            if n_present == 0 or not in_last:
                 # never seen, or vanished without reappearing: lost
                 lost.append(v)
-            elif False in present:
+            elif n_present < n_later:
                 # dipped out but recovered: a stale/nonmonotonic read
                 stale.append(v)
                 ok_els.append(v)

@@ -335,6 +335,26 @@ class TestSetFull:
         assert res2["valid"] is False
         assert res2["unexpected"] == [1]
 
+    def test_failed_then_retried_add_still_tracked(self):
+        # Review finding: one failed attempt must not untrack a value
+        # that another attempt acked.
+        rows_lost = [
+            (0, INVOKE, "add", 5), (0, FAIL, "add", 5),
+            (1, INVOKE, "add", 5), (1, OK, "add", 5),
+            (2, INVOKE, "read", None), (2, OK, "read", []),
+        ]
+        res = SetFull().check({}, h(rows_lost), {})
+        assert res["valid"] is False
+        assert res["lost"] == [5]
+        rows_ok = [
+            (0, INVOKE, "add", 5), (0, FAIL, "add", 5),
+            (1, INVOKE, "add", 5), (1, OK, "add", 5),
+            (2, INVOKE, "read", None), (2, OK, "read", [5]),
+        ]
+        res2 = SetFull().check({}, h(rows_ok), {})
+        assert res2["valid"] is True
+        assert res2["unexpected"] == []
+
     def test_stale_read_tolerated_by_default(self):
         rows = [
             (0, INVOKE, "add", 1),
@@ -367,6 +387,47 @@ class TestUniqueIds:
 
 
 class TestCounter:
+    def test_empty_and_initial_read(self):
+        # checker_test.clj:242-256.
+        assert CounterChecker().check({}, h([]), {})["valid"] is True
+        r = CounterChecker().check(
+            {}, h([(0, INVOKE, "read", None), (0, OK, "read", 0)]), {}
+        )
+        assert r["valid"] is True
+
+    def test_failed_add_ignored(self):
+        # checker_test.clj:258-268: a :fail add never happened.
+        r = CounterChecker().check(
+            {},
+            h([
+                (0, INVOKE, "add", 1), (0, FAIL, "add", 1),
+                (1, INVOKE, "read", None), (1, OK, "read", 0),
+            ]),
+            {},
+        )
+        assert r["valid"] is True
+
+    def test_incomplete_add_widens(self):
+        # checker_test.clj:270-281: an add with no completion may or
+        # may not have happened — reads of 0 and 1 are both fine.
+        r = CounterChecker().check(
+            {},
+            h([
+                (0, INVOKE, "add", 1),
+                (1, INVOKE, "read", None), (1, OK, "read", 0),
+                (1, INVOKE, "read", None), (1, OK, "read", 1),
+            ]),
+            {},
+        )
+        assert r["valid"] is True
+
+    def test_initial_invalid_read(self):
+        # checker_test.clj:283-290.
+        r = CounterChecker().check(
+            {}, h([(0, INVOKE, "read", None), (0, OK, "read", 1)]), {}
+        )
+        assert r["valid"] is False
+
     def test_valid_reads(self):
         r = CounterChecker().check(
             {},
