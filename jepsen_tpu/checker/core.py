@@ -366,7 +366,10 @@ class SetChecker(Checker):
         recovered = (final_read & attempts) - adds
         return {
             "valid": not lost and not unexpected,
-            "ok-count": len(final_read & adds),
+            # ok = attempted values the read confirmed (the reference
+            # counts recovered indeterminate/failed attempts here too,
+            # checker_test.clj:141-152).
+            "ok-count": len(final_read & attempts),
             "lost-count": len(lost),
             "lost": _sorted_sample(lost),
             "unexpected-count": len(unexpected),

@@ -160,6 +160,34 @@ class TestTotalQueue:
 
 
 class TestSet:
+    def test_reference_literal_case(self):
+        # checker_test.clj:121-152 verbatim: ok/info/fail writes and a
+        # final read mixing confirmed, recovered, lost, and phantom
+        # elements.
+        r = SetChecker().check(
+            {},
+            h([
+                (0, INVOKE, "add", 0), (0, OK, "add", 0),
+                (0, INVOKE, "add", 1), (0, OK, "add", 1),
+                (1, INVOKE, "add", 10), (1, INFO, "add", 10),
+                (1, INVOKE, "add", 11), (1, INFO, "add", 11),
+                (2, INVOKE, "add", 20), (2, FAIL, "add", 20),
+                (2, INVOKE, "add", 21), (2, FAIL, "add", 21),
+                (4, INVOKE, "read", None),
+                (4, OK, "read", [0, 10, 20, 30]),
+            ]),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["ok-count"] == 3           # 0, 10, 20
+        assert r["lost"] == [1]
+        assert r["lost-count"] == 1
+        assert r["acknowledged-count"] == 2
+        assert r["recovered-count"] == 2    # 10, 20
+        assert sorted(r["recovered"]) == [10, 20]
+        assert r["attempt-count"] == 6
+        assert r["unexpected"] == [30]
+
     def test_set_ok(self):
         r = SetChecker().check(
             {},
