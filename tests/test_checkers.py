@@ -121,6 +121,52 @@ class TestQueue:
 
 
 class TestTotalQueue:
+    def test_reference_sane_case(self):
+        # checker_test.clj:159-181 verbatim.
+        r = TotalQueue().check(
+            {},
+            h([
+                (1, INVOKE, "enqueue", 1),
+                (2, INVOKE, "enqueue", 2), (2, OK, "enqueue", 2),
+                (3, INVOKE, "dequeue", 1), (3, OK, "dequeue", 1),
+                (3, INVOKE, "dequeue", 2), (3, OK, "dequeue", 2),
+            ]),
+            {},
+        )
+        assert r["valid"] is True
+        assert r["attempt-count"] == 2
+        assert r["acknowledged-count"] == 1
+        assert r["ok-count"] == 2
+        assert r["recovered"] == {1} and r["recovered-count"] == 1
+        assert r["lost-count"] == r["unexpected-count"] == 0
+        assert r["duplicated-count"] == 0
+
+    def test_reference_pathological_case(self):
+        # checker_test.clj:183-210 verbatim: hung, lost, phantom, and
+        # duplicated elements in one history.
+        r = TotalQueue().check(
+            {},
+            h([
+                (1, INVOKE, "enqueue", "hung"),
+                (2, INVOKE, "enqueue", "enqueued"),
+                (2, OK, "enqueue", "enqueued"),
+                (3, INVOKE, "enqueue", "dup"), (3, OK, "enqueue", "dup"),
+                (4, INVOKE, "dequeue", None),  # never returns
+                (5, INVOKE, "dequeue", None), (5, OK, "dequeue", "wtf"),
+                (6, INVOKE, "dequeue", None), (6, OK, "dequeue", "dup"),
+                (7, INVOKE, "dequeue", None), (7, OK, "dequeue", "dup"),
+            ]),
+            {},
+        )
+        assert r["valid"] is False
+        assert r["lost"] == {"enqueued"} and r["lost-count"] == 1
+        assert r["unexpected"] == {"wtf"} and r["unexpected-count"] == 1
+        assert r["duplicated"] == {"dup"} and r["duplicated-count"] == 1
+        assert r["recovered-count"] == 0
+        assert r["acknowledged-count"] == 2
+        assert r["attempt-count"] == 3
+        assert r["ok-count"] == 1
+
     def test_lost_and_unexpected(self):
         r = TotalQueue().check(
             {},
