@@ -266,17 +266,38 @@ def rand_exp(rate: float, rng: random.Random | None = None) -> float:
 
 def nemesis_intervals(history: Iterable[Any], start_fs=("start",), stop_fs=("stop",)) -> list[tuple[Any, Any]]:
     """Pairs of [start-op stop-op] for nemesis activity windows
-    (util.clj:780-816).  Ops are any objects with .f attributes; unclosed
-    intervals pair with None."""
+    (util.clj:780-826).  Like the reference: consecutive ops pair up as
+    (invoke, completion) — pairs with mismatched :f are dropped — every
+    open start pair is closed by the next stop pair (start1 start2
+    start3 start4 stop1 stop2 yields [s1 e1] [s2 e2] [s3 e1] [s4 e2]),
+    and unclosed intervals pair with None.
+
+    Like the reference (util.clj:803-805), the input is filtered to
+    nemesis ops first — the strict stride-2 pairing would misalign on
+    any interleaved client op."""
+    ops = [
+        o for o in history
+        if getattr(o, "process", None) == "nemesis"
+    ]
+    pairs = [
+        (ops[i], ops[i + 1])
+        for i in range(0, len(ops) - 1, 2)
+        if getattr(ops[i], "f", None) == getattr(ops[i + 1], "f", None)
+    ]
     intervals: list[tuple[Any, Any]] = []
-    current: list[Any] = []
-    for op in history:
-        f = getattr(op, "f", None)
+    open_starts: list[tuple[Any, Any]] = []
+    for a, b in pairs:
+        f = getattr(a, "f", None)
         if f in start_fs:
-            current.append(op)
-        elif f in stop_fs and current:
-            intervals.append((current.pop(), op))
-    intervals.extend((op, None) for op in current)
+            open_starts.append((a, b))
+        elif f in stop_fs:
+            for s1, s2 in open_starts:
+                intervals.append((s1, a))
+                intervals.append((s2, b))
+            open_starts = []
+    for s1, s2 in open_starts:
+        intervals.append((s1, None))
+        intervals.append((s2, None))
     return intervals
 
 

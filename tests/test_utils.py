@@ -1,0 +1,107 @@
+"""Utility function parity with the reference's util_test.clj."""
+
+import pytest
+
+from jepsen_tpu.history import Op
+from jepsen_tpu.utils import (
+    Forgettable,
+    JepsenTimeout,
+    integer_interval_set_str,
+    majority,
+    nemesis_intervals,
+    rand_exp,
+    timeout,
+)
+
+
+def test_majority():
+    # util_test.clj:9-15.
+    assert majority(0) == 1
+    assert majority(1) == 1
+    assert majority(2) == 2
+    assert majority(3) == 2
+    assert majority(4) == 3
+    assert majority(5) == 3
+
+
+def test_integer_interval_set_str():
+    # util_test.clj:17-34.
+    assert integer_interval_set_str([]) == "#{}"
+    assert integer_interval_set_str([1]) == "#{1}"
+    assert integer_interval_set_str([1, 2]) == "#{1..2}"
+    assert integer_interval_set_str([1, 2, 3]) == "#{1..3}"
+    assert integer_interval_set_str([1, 3, 5]) == "#{1 3 5}"
+    assert integer_interval_set_str([1, 2, 3, 5, 7, 8, 9]) == "#{1..3 5 7..9}"
+
+
+def test_nemesis_intervals():
+    # util_test.clj:159-167: starts s1..s4 (two invoke/complete pairs)
+    # all close against the one stop pair e1 e2.
+    s = [Op(type="info", f="start", value=i, process="nemesis")
+         for i in range(1, 5)]
+    e = [Op(type="info", f="stop", value=i, process="nemesis")
+         for i in range(1, 3)]
+    out = nemesis_intervals(s + e)
+    assert out == [
+        (s[0], e[0]), (s[1], e[1]),
+        (s[2], e[0]), (s[3], e[1]),
+    ]
+
+
+def test_nemesis_intervals_filters_client_ops(Op=Op):
+    # util.clj:803-805: interleaved client ops must not misalign the
+    # stride-2 pairing (review finding).
+    s1 = Op(type="info", f="start", process="nemesis")
+    s2 = Op(type="info", f="start", process="nemesis")
+    e1 = Op(type="info", f="stop", process="nemesis")
+    e2 = Op(type="info", f="stop", process="nemesis")
+    client = Op(type="invoke", f="read", process=0)
+    out = nemesis_intervals([client, s1, client, s2, client, e1, e2])
+    assert out == [(s1, e1), (s2, e2)]
+
+
+def test_nemesis_intervals_unclosed():
+    s1 = Op(type="info", f="start", process="nemesis")
+    s2 = Op(type="info", f="start", process="nemesis")
+    out = nemesis_intervals([s1, s2])
+    assert out == [(s1, None), (s2, None)]
+
+
+def test_nemesis_intervals_mismatched_pair_dropped():
+    # A pair whose halves carry different :fs is not an interval
+    # boundary (util.clj:808-811).
+    a = Op(type="info", f="start", process="nemesis")
+    b = Op(type="info", f="stop", process="nemesis")
+    assert nemesis_intervals([a, b]) == []
+
+
+def test_rand_exp_mean():
+    # util_test.clj:169-178 (theirs parameterizes by mean; ours by
+    # rate = 1/mean).
+    import random
+
+    rng = random.Random(42)
+    n, target_mean = 500, 30.0
+    mean = sum(rand_exp(1.0 / target_mean, rng) for _ in range(n)) / n
+    assert target_mean * 0.7 < mean < target_mean * 1.3
+
+
+def test_forgettable():
+    # util_test.clj:180-191.
+    f = Forgettable("foo")
+    assert f.deref() == "foo"
+    f.forget()
+    with pytest.raises(ValueError, match="forgotten"):
+        f.deref()
+
+
+def test_timeout():
+    # util_test.clj:117-137: body value inside the window, default on
+    # overrun.
+    assert timeout(1000, lambda: "ok") == "ok"
+    import time as _t
+
+    assert timeout(30, lambda: _t.sleep(1.0) or "late",
+                   default="gave-up") == "gave-up"
+    with pytest.raises(JepsenTimeout):
+        timeout(30, lambda: _t.sleep(1.0))
