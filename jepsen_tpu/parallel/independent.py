@@ -121,6 +121,7 @@ class IndependentChecker(Checker):
 
         valid = merge_valid(r.get("valid") for r in results.values())
         failures = [k for k, r in results.items() if r.get("valid") is False]
+        self._write_key_artifacts(opts, subs, results)
         return {
             "valid": valid,
             "key-count": len(keys),
@@ -128,6 +129,66 @@ class IndependentChecker(Checker):
             "failure-count": len(failures),
             "results": results,
         }
+
+    #: Per-key artifact budget: failed keys always write; passing keys
+    #: only up to this many (the reference writes every key's dir,
+    #: independent.clj:355-364, but per-key workloads here can carry
+    #: tens of thousands of keys).
+    MAX_OK_KEY_DIRS = 256
+
+    def _write_key_artifacts(self, opts: dict, subs: dict,
+                             results: dict) -> None:
+        """store/<test>/independent/<key>/{results.json,history.txt}
+        per key, like the reference's per-key dirs.  Failures never
+        raise: a side-output must not change the verdict."""
+        import json
+        import logging
+        import os
+        import re
+
+        import hashlib
+
+        directory = (opts or {}).get("dir")
+        if not directory:
+            return
+        log = logging.getLogger(__name__)
+        ok_written = 0
+        used: set = set()
+        for k, res in results.items():
+            # Only fully-passing keys count against the budget:
+            # False AND "unknown" verdicts are exactly the ones a
+            # maintainer must inspect, so they always write.
+            if res.get("valid") is True:
+                if ok_written >= self.MAX_OK_KEY_DIRS:
+                    continue
+                ok_written += 1
+            safe = re.sub(r"[^A-Za-z0-9._-]", "_", str(k))[:80]
+            if safe in used:
+                # Disambiguate truncation collisions with a stable
+                # digest of the full key, keeping names bounded.
+                digest = hashlib.sha1(
+                    repr(k).encode()
+                ).hexdigest()[:10]
+                safe = f"{safe[:69]}-{digest}"
+            used.add(safe)
+            # Per-key isolation: one key's write failure (quota,
+            # unserializable value, hostile op repr) must neither
+            # skip later keys nor — via check_safe — replace the
+            # computed verdict with "unknown".
+            try:
+                d = os.path.join(directory, "independent", safe)
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "results.json"), "w") as f:
+                    json.dump(res, f, indent=2, default=repr,
+                              skipkeys=True)
+                with open(os.path.join(d, "history.txt"), "w",
+                          errors="replace") as f:
+                    for o in subs.get(k, ()):
+                        f.write(str(o) + "\n")
+            except Exception as e:  # noqa: BLE001 — side output only
+                log.warning(
+                    "could not write artifacts for key %r: %r", k, e
+                )
 
     # -- batched device path ------------------------------------------------
 
