@@ -17,7 +17,6 @@ from __future__ import annotations
 import contextlib
 import json
 import os
-import re
 import shutil
 import tempfile
 import threading
@@ -38,8 +37,9 @@ class Cache:
     # -- path encoding ----------------------------------------------------
 
     def _encode_part(self, part: Any) -> str:
-        s = str(part)
-        return re.sub(r"[^A-Za-z0-9._-]", "_", s) or "_"
+        from .utils import sanitize_path_part
+
+        return sanitize_path_part(part)
 
     def file_path(self, path: Sequence[Any]) -> str:
         """The file backing a logical path."""

@@ -144,25 +144,39 @@ class IndependentChecker(Checker):
         import json
         import logging
         import os
-        import re
 
         import hashlib
+
+        from ..utils import sanitize_path_part
 
         directory = (opts or {}).get("dir")
         if not directory:
             return
         log = logging.getLogger(__name__)
+
+        def jsonable_keys(x):
+            # json.dump coerces dict VALUES via default=, never KEYS;
+            # skipkeys would silently drop diagnostic entries.
+            if isinstance(x, dict):
+                return {
+                    k if isinstance(k, str) else repr(k):
+                        jsonable_keys(v)
+                    for k, v in x.items()
+                }
+            if isinstance(x, (list, tuple)):
+                return [jsonable_keys(v) for v in x]
+            return x
+
         ok_written = 0
         used: set = set()
         for k, res in results.items():
             # Only fully-passing keys count against the budget:
             # False AND "unknown" verdicts are exactly the ones a
             # maintainer must inspect, so they always write.
-            if res.get("valid") is True:
-                if ok_written >= self.MAX_OK_KEY_DIRS:
-                    continue
-                ok_written += 1
-            safe = re.sub(r"[^A-Za-z0-9._-]", "_", str(k))[:80]
+            budgeted = res.get("valid") is True
+            if budgeted and ok_written >= self.MAX_OK_KEY_DIRS:
+                continue
+            safe = sanitize_path_part(k)[:80]
             if safe in used:
                 # Disambiguate truncation collisions with a stable
                 # digest of the full key, keeping names bounded.
@@ -179,12 +193,14 @@ class IndependentChecker(Checker):
                 d = os.path.join(directory, "independent", safe)
                 os.makedirs(d, exist_ok=True)
                 with open(os.path.join(d, "results.json"), "w") as f:
-                    json.dump(res, f, indent=2, default=repr,
-                              skipkeys=True)
+                    json.dump(jsonable_keys(res), f, indent=2,
+                              default=repr)
                 with open(os.path.join(d, "history.txt"), "w",
                           errors="replace") as f:
                     for o in subs.get(k, ()):
                         f.write(str(o) + "\n")
+                if budgeted:
+                    ok_written += 1  # only successful writes consume budget
             except Exception as e:  # noqa: BLE001 — side output only
                 log.warning(
                     "could not write artifacts for key %r: %r", k, e

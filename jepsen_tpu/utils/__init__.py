@@ -352,3 +352,17 @@ class Forgettable:
 def fraction(num: float, denom: float) -> float:
     """num/denom, but 0 when denom is 0 (checker.clj fraction helper)."""
     return num / denom if denom else 0.0
+
+
+def sanitize_path_part(part: Any) -> str:
+    """One safe filesystem path component from an arbitrary value:
+    hostile characters become underscores, and names that are empty or
+    all dots (".", "..", "" — which would escape or collapse the
+    parent directory) are fully underscored.  Shared by the fs cache
+    and per-key artifact writers."""
+    import re
+
+    s = re.sub(r"[^A-Za-z0-9._-]", "_", str(part))
+    if not s or set(s) <= {"."}:
+        return "_" * max(1, len(s))
+    return s

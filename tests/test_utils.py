@@ -95,6 +95,19 @@ def test_forgettable():
         f.deref()
 
 
+def test_sanitize_path_part():
+    from jepsen_tpu.utils import sanitize_path_part
+
+    assert sanitize_path_part("a/b c") == "a_b_c"
+    assert sanitize_path_part(3) == "3"
+    # Names that would escape/collapse the parent directory.
+    assert sanitize_path_part("..") == "__"
+    assert sanitize_path_part(".") == "_"
+    assert sanitize_path_part("") == "_"
+    assert sanitize_path_part("...") == "___"
+    assert sanitize_path_part("x.y") == "x.y"  # interior dots fine
+
+
 def test_timeout():
     # util_test.clj:117-137: body value inside the window, default on
     # overrun.
