@@ -94,10 +94,12 @@ def write_artifacts(result: dict, opts: Optional[dict],
             name = f"cycle-{i}-{c.get('type', 'cycle')}.dot"
             with open(os.path.join(out, name), "w") as f:
                 f.write("\n".join(lines) + "\n")
-    except OSError as e:
+    except Exception as e:
         # A side-output failure (read-only/deleted store dir, full
-        # disk) must never escape and let check_safe downgrade an
-        # already-computed invalid verdict to "unknown".
+        # disk, or a malformed anomaly payload that json.dump / the
+        # DOT writer chokes on) must never escape and let check_safe
+        # downgrade an already-computed invalid verdict to "unknown".
+        # Same policy as IndependentChecker._write_key_artifacts.
         logging.getLogger(__name__).warning(
             "could not write elle artifacts to %s: %r", directory, e
         )

@@ -274,11 +274,24 @@ def nemesis_intervals(history: Iterable[Any], start_fs=("start",), stop_fs=("sto
 
     Like the reference (util.clj:803-805), the input is filtered to
     nemesis ops first — the strict stride-2 pairing would misalign on
-    any interleaved client op."""
+    any interleaved client op.  Contract note: callers passing
+    synthetic ops without a `process` field (pre-round-2 behavior
+    accepted "any objects with .f attributes") fall back to unfiltered
+    pairing, so a nemesis-only synthetic history keeps yielding
+    intervals instead of silently returning []."""
+    history = list(history)
     ops = [
         o for o in history
         if getattr(o, "process", None) == "nemesis"
     ]
+    if not ops:
+        # Only the process-less ops join the fallback: client ops with
+        # real process ids must never enter the stride-2 pairing (the
+        # misalignment the nemesis filter exists to prevent).
+        ops = [
+            o for o in history
+            if getattr(o, "process", None) is None and hasattr(o, "f")
+        ]
     pairs = [
         (ops[i], ops[i + 1])
         for i in range(0, len(ops) - 1, 2)
