@@ -21,9 +21,13 @@ from ..models.base import Model, PackedModel
 from .core import Checker
 from .wgl_cpu import WGLResult, check_wgl_cpu, check_wgl_host_model
 
-#: Histories at most this many ops get a CPU fallback pass when the device
-#: search returns unknown under "wgl-tpu".
-CPU_FALLBACK_MAX_OPS = 5_000
+#: Budget for the exact settling pass when the device search returns
+#: unknown and the checker has no configured time limit.  The round-2
+#: gate (CPU_FALLBACK_MAX_OPS = 5_000: histories above it were NEVER
+#: handed to the exact engine and stayed "unknown" forever) is gone —
+#: the event-walk engine exists precisely for large info-heavy
+#: histories, and budgets, not op counts, bound its cost.
+DEFAULT_SETTLE_BUDGET_S = 120.0
 
 
 class Linearizable(Checker):
@@ -77,8 +81,37 @@ class Linearizable(Checker):
                 )
 
         if algorithm in ("wgl", "linear", "cpu", "event"):
+            # An explicitly named engine is exercised as asked (tests
+            # and debugging depend on it); the screens only join the
+            # strategy-picking paths below.
             res, engine = self._cpu_exact(packed, pm, algorithm)
             return self._render(res, packed, engine, model, pm, opts=opts)
+
+        # Sound non-linearizability screens (checker/refute.py) run
+        # first on the device-first paths: O(n log n), exact-when-they-
+        # fire, and the only engine that settles the invalid families
+        # the exact searches can't reach at scale (the WGL closure is
+        # exponential in concurrency once info ops unlock every state —
+        # knossos hits the same wall).  knossos.competition races its
+        # solvers the same way (checker.clj:214-233).
+        import time as _time
+
+        from .refute import check_refute
+
+        t_start = _time.monotonic()
+        ref = check_refute(packed, pm, time_limit_s=self.time_limit_s)
+        if ref is not None:
+            return self._render(ref, packed, "refute-screen", model, pm,
+                                opts=opts)
+        # One budget for the whole strategy chain: the screen's cost
+        # (and everything after) comes out of the configured limit, so
+        # per-key callers (parallel/independent.py) see at most ~1x
+        # time_limit_s, not screen+device+settle each spending it anew.
+        budget_left = None
+        if self.time_limit_s is not None:
+            budget_left = max(
+                1.0, self.time_limit_s - (_time.monotonic() - t_start)
+            )
 
         # Device-first paths.
         from ..ops.wgl import check_wgl_device
@@ -90,7 +123,7 @@ class Linearizable(Checker):
                 beam=self.beam,
                 max_beam=self.max_beam,
                 block=self.block,
-                time_limit_s=self.time_limit_s,
+                time_limit_s=budget_left,
                 # "search-mesh" shards this ONE search's BFS frontier
                 # across devices (the within-search axis).  It is a
                 # distinct key from "mesh", which already means the
@@ -108,9 +141,7 @@ class Linearizable(Checker):
             return self._render(res, packed, f"{engine}-nobackend", model,
                                 pm, opts=opts)
         used = "wgl-tpu"
-        if res.valid is False and not res.final_configs and (
-            packed.n <= CPU_FALLBACK_MAX_OPS
-        ):
+        if res.valid is False and not res.final_configs:
             # The device BFS settles the verdict but carries no
             # counterexample detail; re-derive final configs on the CPU
             # for reporting + linear.svg (checker.clj:223-229).  This
@@ -118,19 +149,42 @@ class Linearizable(Checker):
             # configured budget (capped when none is set) rather than a
             # fresh full one — the verdict stands either way.
             remaining = 30.0
-            if self.time_limit_s is not None:
-                remaining = max(1.0, self.time_limit_s - res.elapsed_s)
+            if budget_left is not None:
+                remaining = max(1.0, budget_left - res.elapsed_s)
             cpu, _ = self._cpu_exact(packed, pm, time_limit_s=remaining)
             if cpu.valid is False:
                 res = cpu
                 used = "wgl-tpu+cpu-report"
-        if res.valid == "unknown" and (
-            algorithm == "competition" or packed.n <= CPU_FALLBACK_MAX_OPS
-        ):
-            cpu, _ = self._cpu_exact(packed, pm)
+        if res.valid == "unknown":
+            # Settle with the exact engine regardless of history size
+            # (knossos competition decides both directions,
+            # checker.clj:214-233).  Governance is the time budget: the
+            # configured limit's remainder, a default when none is set,
+            # or — under "competition" — no limit at all, matching the
+            # reference's race-to-a-verdict semantics.
+            if algorithm == "competition":
+                remaining = (
+                    None if budget_left is None
+                    else max(1.0, budget_left - res.elapsed_s)
+                )
+            elif budget_left is not None:
+                remaining = max(1.0, budget_left - res.elapsed_s)
+            else:
+                remaining = DEFAULT_SETTLE_BUDGET_S
+            cpu, _ = self._cpu_exact(packed, pm, time_limit_s=remaining)
             if cpu.valid != "unknown":
                 res = cpu
                 used = "wgl-tpu+cpu-fallback"
+            else:
+                budget_txt = (
+                    "unbounded" if remaining is None
+                    else f"{remaining:.1f}s"
+                )
+                reason = cpu.reason or res.reason or "search exhausted"
+                res.reason = (
+                    f"{reason} (exact settling pass budget "
+                    f"{budget_txt} also exhausted)"
+                )
         return self._render(res, packed, used, model, pm, opts=opts)
 
     def _host_fallback(self, history, model, label: str, opts,

@@ -128,6 +128,11 @@ class PackedModel:
     #: sub-32-bit / lane<->sublane relayouts.  Models without one
     #: simply stay on the XLA-scan sweep.
     jax_step_rows: Optional[Callable[..., Any]] = None
+    #: optional columnar facets for the sound non-linearizability
+    #: screens (checker/refute.py): PackedOps -> RefuteView.  Models
+    #: without a register-like assert/produce structure leave it None
+    #: and skip the screens.
+    refute_view: Optional[Callable[..., Any]] = None
 
 
 def intern_value(interner: Interner, v: Any) -> int:

@@ -136,6 +136,26 @@ def _register_packed(model: Register, allow_cas: bool) -> PackedModel:
             return f"write {interner.value(a0)!r}"
         return f"cas {interner.value(a0)!r} -> {interner.value(a1)!r}"
 
+    def refute_view(packed):
+        import numpy as np
+
+        from ..checker.refute import RefuteView
+        from ..history.packed import NIL as _NIL
+
+        f = packed.f
+        return RefuteView(
+            key=np.zeros(packed.n, dtype=np.int32),
+            # reads assert the returned value; ok cas asserts the
+            # expected old value at its linearization point
+            asserts=np.where(f == F_READ, packed.a0,
+                             np.where(f == F_CAS, packed.a0, _NIL)),
+            # writes force their value; an :ok cas's new value is a
+            # forced effect (it returned success)
+            produces=np.where(f == F_WRITE, packed.a0,
+                              np.where(f == F_CAS, packed.a1, _NIL)),
+            init=np.array(init, dtype=np.int32),
+        )
+
     return PackedModel(
         name="cas-register" if allow_cas else "register",
         state_width=1,
@@ -146,6 +166,7 @@ def _register_packed(model: Register, allow_cas: bool) -> PackedModel:
         interner=interner,
         describe_op=describe_op,
         jax_step_rows=jax_step_rows,
+        refute_view=refute_view,
     )
 
 
@@ -242,6 +263,20 @@ class MultiRegister(Model):
             verb = "read" if f == F_READ else "write"
             return f"{verb} {keys[a0]!r} {interner.value(a1)!r}"
 
+        def refute_view(packed):
+            import numpy as np
+
+            from ..checker.refute import RefuteView
+            from ..history.packed import NIL as _NIL
+
+            f = packed.f
+            return RefuteView(
+                key=packed.a0.astype(np.int32),
+                asserts=np.where(f == F_READ, packed.a1, _NIL),
+                produces=np.where(f == F_WRITE, packed.a1, _NIL),
+                init=np.array(init, dtype=np.int32),
+            )
+
         return PackedModel(
             name="multi-register",
             state_width=len(keys),
@@ -252,6 +287,7 @@ class MultiRegister(Model):
             interner=interner,
             describe_op=describe_op,
             jax_step_rows=jax_step_rows,
+            refute_view=refute_view,
         )
 
 

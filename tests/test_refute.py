@@ -1,0 +1,276 @@
+"""Sound non-linearizability screens (checker/refute.py) + the
+invalid-at-scale routing they close (VERDICT r2 "missing" #2).
+
+Reference bar: knossos competition decides both directions
+(checker.clj:214-233) but times out on large histories; the screens
+settle the practical invalid families at any scale, and the checker
+now routes device-unknown verdicts to the exact event-walk engine
+regardless of history size (the round-2 CPU_FALLBACK_MAX_OPS=5_000
+gate is gone).
+"""
+
+from __future__ import annotations
+
+import random
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.checker.refute import check_refute
+from jepsen_tpu.checker.wgl_event import check_wgl_event
+from jepsen_tpu.history.core import Op, history
+from jepsen_tpu.history.packed import pack_history
+from jepsen_tpu.models import cas_register, multi_register
+from jepsen_tpu.utils.histgen import (
+    random_register_history,
+    stale_read_history,
+)
+
+
+@pytest.fixture(scope="module")
+def pm():
+    return cas_register().packed()
+
+
+# ---------------------------------------------------------------- screens
+
+
+def test_silent_on_valid_histories(pm):
+    """No false positives on linearizable-by-construction histories,
+    across seeds, concurrency, and info rates."""
+    for seed in range(6):
+        h = random_register_history(
+            4_000, procs=8 + seed, info_rate=0.02 * seed, seed=seed
+        )
+        assert check_refute(pack_history(h, pm.encode), pm) is None
+
+
+def test_unsupported_read_certificate(pm):
+    h = random_register_history(
+        2_000, procs=8, info_rate=0.05, seed=3, bad_at=0.5
+    )
+    res = check_refute(pack_history(h, pm.encode), pm)
+    assert res is not None and res.valid is False
+    cert = res.final_configs[0]
+    assert cert["screen"] == "unsupported-read"
+    assert cert["producers-considered"] == []
+    assert res.crashed_at is not None
+
+
+def test_stale_read_certificate(pm):
+    h = stale_read_history(2_000, procs=8, info_rate=0.05, seed=4)
+    res = check_refute(pack_history(h, pm.encode), pm)
+    assert res is not None and res.valid is False
+    cert = res.final_configs[0]
+    assert cert["screen"] == "stale-read"
+    assert cert["asserted-value"] == 5  # the retired value S
+    assert cert["producers-considered"]  # the early producer, killed
+
+
+def test_info_producer_blocks_refutation(pm):
+    """An :info write of the asserted value invoked before the read
+    returns may linearize arbitrarily late — the screen must stay
+    silent, because the history is genuinely linearizable."""
+    ops = [
+        Op(type="invoke", f="write", value=1, process=0),
+        Op(type="ok", f="write", value=1, process=0),
+        Op(type="invoke", f="write", value=9, process=2),  # info: may
+        Op(type="invoke", f="write", value=2, process=1),  # float late
+        Op(type="ok", f="write", value=2, process=1),
+        Op(type="invoke", f="read", value=None, process=3),
+        Op(type="ok", f="read", value=9, process=3),
+    ]
+    res = check_refute(pack_history(history(ops), pm.encode), pm)
+    assert res is None
+    out = Linearizable(cas_register(), algorithm="event").check(
+        {}, history(ops), {}
+    )
+    assert out["valid"] is True
+
+
+def test_concurrent_fence_blocks_refutation(pm):
+    """A fence whose window overlaps the producer's or the reader's is
+    NOT a proof — the read may linearize before the fence."""
+    ops = [
+        Op(type="invoke", f="write", value=1, process=0),
+        Op(type="ok", f="write", value=1, process=0),
+        Op(type="invoke", f="write", value=2, process=1),
+        Op(type="invoke", f="read", value=None, process=2),  # overlaps w2
+        Op(type="ok", f="read", value=1, process=2),
+        Op(type="ok", f="write", value=2, process=1),
+    ]
+    res = check_refute(pack_history(history(ops), pm.encode), pm)
+    assert res is None
+    out = Linearizable(cas_register(), algorithm="event").check(
+        {}, history(ops), {}
+    )
+    assert out["valid"] is True
+
+
+def test_sequential_stale_read_refuted(pm):
+    """The minimal stale-read: w(1) ack, w(2) ack, read -> 1."""
+    ops = [
+        Op(type="invoke", f="write", value=1, process=0),
+        Op(type="ok", f="write", value=1, process=0),
+        Op(type="invoke", f="write", value=2, process=0),
+        Op(type="ok", f="write", value=2, process=0),
+        Op(type="invoke", f="read", value=None, process=1),
+        Op(type="ok", f="read", value=1, process=1),
+    ]
+    res = check_refute(pack_history(history(ops), pm.encode), pm)
+    assert res is not None and res.valid is False
+    assert res.final_configs[0]["screen"] == "stale-read"
+
+
+def test_cas_assert_screened(pm):
+    """An :ok cas asserts its expected value like a read does."""
+    ops = [
+        Op(type="invoke", f="write", value=1, process=0),
+        Op(type="ok", f="write", value=1, process=0),
+        Op(type="invoke", f="write", value=2, process=0),
+        Op(type="ok", f="write", value=2, process=0),
+        Op(type="invoke", f="cas", value=(1, 3), process=1),
+        Op(type="ok", f="cas", value=(1, 3), process=1),
+    ]
+    res = check_refute(pack_history(history(ops), pm.encode), pm)
+    assert res is not None and res.valid is False
+
+
+def test_multi_register_screens():
+    m = multi_register({"x": 0, "y": 0})
+    pm2 = m.packed()
+    ops = [
+        Op(type="invoke", f="write", value=("x", 1), process=0),
+        Op(type="ok", f="write", value=("x", 1), process=0),
+        Op(type="invoke", f="write", value=("x", 2), process=0),
+        Op(type="ok", f="write", value=("x", 2), process=0),
+        # y's writes must not fence x's — per-key independence
+        Op(type="invoke", f="write", value=("y", 7), process=0),
+        Op(type="ok", f="write", value=("y", 7), process=0),
+        Op(type="invoke", f="read", value=("x", 1), process=1),
+        Op(type="ok", f="read", value=("x", 1), process=1),
+    ]
+    res = check_refute(pack_history(history(ops), pm2.encode), pm2)
+    assert res is not None and res.valid is False
+    ops_ok = ops[:-2] + [
+        Op(type="invoke", f="read", value=("y", 7), process=1),
+        Op(type="ok", f="read", value=("y", 7), process=1),
+    ]
+    assert check_refute(pack_history(history(ops_ok), pm2.encode), pm2) is None
+
+
+def test_oracle_agreement_on_random_mutations(pm):
+    """Adversarial soundness check: mutate random valid histories by
+    corrupting one read's returned value; wherever the screen fires,
+    the exact event-walk engine must agree the history is invalid.
+    (The reverse need not hold — the screen is incomplete.)"""
+    rng = random.Random(7)
+    fired = 0
+    for trial in range(40):
+        h = list(
+            random_register_history(
+                120, procs=4, info_rate=0.08, n_values=3,
+                seed=1000 + trial,
+            )
+        )
+        # corrupt one completed read
+        reads = [
+            i for i, o in enumerate(h)
+            if o.type == "ok" and o.f == "read" and o.value is not None
+        ]
+        if not reads:
+            continue
+        i = rng.choice(reads)
+        h[i] = h[i].replace(value=(h[i].value + 1 + rng.randrange(3)) % 4)
+        packed = pack_history(history(h), pm.encode)
+        res = check_refute(packed, pm)
+        if res is not None:
+            fired += 1
+            exact = check_wgl_event(packed, pm, time_limit_s=30)
+            assert exact.valid is False, (
+                f"screen fired on trial {trial} but exact engine says "
+                f"{exact.valid}"
+            )
+    assert fired >= 5  # the corruption should be catchable fairly often
+
+
+# ------------------------------------------------- invalid-at-scale routing
+
+
+def test_regression_50k_invalid_settles_false(pm, tmp_path):
+    """VERDICT r2 'next round' #1: a ~50k-op high-info genuinely
+    invalid cas-register history settles False — with final-configs
+    and a linviz artifact — inside CI time on CPU."""
+    h = random_register_history(
+        50_000, procs=16, info_rate=0.05, seed=9, bad_at=0.6
+    )
+    chk = Linearizable(cas_register(), algorithm="wgl-tpu",
+                       time_limit_s=60.0)
+    out = chk.check({}, h, {"dir": str(tmp_path)})
+    assert out["valid"] is False
+    assert out["final-configs"]
+    assert (tmp_path / "linear.svg").exists()
+    assert out["counterexample-file"]
+
+
+def test_regression_50k_stale_read_settles_false(pm, tmp_path):
+    h = stale_read_history(50_000, procs=16, info_rate=0.05, seed=11)
+    chk = Linearizable(cas_register(), algorithm="wgl-tpu",
+                       time_limit_s=60.0)
+    out = chk.check({}, h, {"dir": str(tmp_path)})
+    assert out["valid"] is False
+    assert out["algorithm"] == "refute-screen"
+
+
+def test_unknown_routes_to_exact_regardless_of_size(pm, monkeypatch):
+    """The round-2 5k-op gate is gone: a device 'unknown' on a large
+    history is settled by the exact engine under the time budget."""
+    from jepsen_tpu.checker.wgl_cpu import WGLResult
+    import jepsen_tpu.ops.wgl as wgl_mod
+
+    calls = {}
+
+    def fake_device(packed, pm_, **kw):
+        calls["n"] = packed.n
+        return WGLResult(valid="unknown", reason="beam-overflow",
+                         elapsed_s=0.1)
+
+    monkeypatch.setattr(wgl_mod, "check_wgl_device", fake_device)
+    # 8k ops: over the old gate; valid, low-info — the event engine
+    # settles it quickly.
+    h = random_register_history(8_000, procs=8, info_rate=0.0, seed=2)
+    chk = Linearizable(cas_register(), algorithm="wgl-tpu",
+                       time_limit_s=60.0)
+    out = chk.check({}, h, {})
+    assert calls["n"] > 5_000
+    assert out["valid"] is True
+    assert out["algorithm"] == "wgl-tpu+cpu-fallback"
+
+
+def test_unknown_budget_exhaustion_reports_budget(pm, monkeypatch):
+    """When the settling pass also can't finish, the unknown verdict
+    names the budget it exhausted."""
+    from jepsen_tpu.checker.wgl_cpu import WGLResult
+    import jepsen_tpu.ops.wgl as wgl_mod
+
+    monkeypatch.setattr(
+        wgl_mod, "check_wgl_device",
+        lambda packed, pm_, **kw: WGLResult(
+            valid="unknown", reason="beam-overflow", elapsed_s=0.1
+        ),
+    )
+    monkeypatch.setattr(
+        Linearizable, "_cpu_exact",
+        lambda self, packed, pm_, algorithm="auto", time_limit_s=None: (
+            WGLResult(valid="unknown", reason="time-limit",
+                      elapsed_s=time_limit_s or 0.0),
+            "event",
+        ),
+    )
+    h = random_register_history(2_000, procs=8, info_rate=0.08, seed=5)
+    chk = Linearizable(cas_register(), algorithm="wgl-tpu",
+                       time_limit_s=10.0)
+    out = chk.check({}, h, {})
+    assert out["valid"] == "unknown"
+    assert "settling pass budget" in out["unknown-reason"]
