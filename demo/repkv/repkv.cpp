@@ -24,9 +24,29 @@
 //   BLOCK <id>           -> OK  (drop replication to/from peer <id> —
 //   UNBLOCK <id> | *     -> OK   app-level partition injection, used
 //                                by the suite's Net implementation)
+// Membership (grow/shrink; the target of the membership nemesis,
+// reference design nemesis/membership.clj:1-47):
+//   VIEW                 -> VIEW <view_id> <id@host:port,...>
+//   JOIN <id> <host:port>-> OK | ERR notprimary | ERR member
+//   LEAVE <id>           -> OK | ERR notprimary | ERR nomember|self
+// View changes are decided by the primary and PROPAGATE over the
+// ordered replication stream (REPL ... VIEW lines), so backups learn
+// with replication lag — and a node removed by LEAVE is deliberately
+// never told: it keeps its stale view and keeps serving reads from
+// data frozen at removal time.  That removed-but-unaware replica is
+// the membership suite's checker-visible violation.
+// Known limitation (deliberate — repkv is a fault playground, not a
+// consensus system): views live only in memory.  A killed-and-
+// restarted node reboots with its static --peers membership at view 1
+// and, if it is the primary, its next view change is rejected by
+// backups holding a higher view id (install_view ignores stale ids) —
+// the suite's resolve_op abandons such ops rather than wedging.  Real
+// systems persist membership in their log; repkv's whole point is to
+// show what happens when pieces like that go missing.
 // Peer protocol (on the same port):
 //   REPL <from> <seq> SET <k> <v>   -> ACK <seq>   (unless blocked)
 //   REPL <from> <seq> CAS ... same shape.
+//   REPL <from> <seq> VIEW <view_id> <id@host:port,...> -> ACK <seq>.
 //
 // Fresh implementation for this framework's demo suite.
 
@@ -73,9 +93,19 @@ struct Peer {
   bool stop = false;
 };
 
-std::vector<Peer*> g_peers;
+std::vector<Peer*> g_peers;   // channels to current members (guarded
+                              // by g_peers_mu; stopped peers stay in
+                              // the vector with stop=true — never
+                              // freed, so replicate() can't race a
+                              // delete)
+std::mutex g_peers_mu;
 std::mutex g_ack_mu;
 std::condition_variable g_ack_cv;
+
+// Membership view: id -> "host:port" for every member INCLUDING self.
+long long g_view_id = 1;
+std::map<int, std::string> g_members;
+std::string g_self_addr;
 
 bool blocked(int id) {
   std::lock_guard<std::mutex> l(g_mu);
@@ -151,6 +181,85 @@ void peer_loop(Peer* p) {
   else if (fd >= 0) close(fd);
 }
 
+// Starts (or restarts) the replication channel to member <id>.
+// Caller must NOT hold g_peers_mu.
+void ensure_peer(int id, const std::string& hostport) {
+  std::lock_guard<std::mutex> l(g_peers_mu);
+  for (Peer* p : g_peers) {
+    if (p->id == id) {
+      std::lock_guard<std::mutex> pl(p->mu);
+      if (!p->stop) return;  // already live
+    }
+  }
+  auto colon = hostport.rfind(':');
+  Peer* p = new Peer();
+  p->id = id;
+  p->host = hostport.substr(0, colon);
+  p->port = atoi(hostport.substr(colon + 1).c_str());
+  g_peers.push_back(p);
+  std::thread(peer_loop, p).detach();
+}
+
+void retire_peer(int id) {
+  std::lock_guard<std::mutex> l(g_peers_mu);
+  for (Peer* p : g_peers) {
+    if (p->id == id) {
+      std::lock_guard<std::mutex> pl(p->mu);
+      p->stop = true;
+      p->cv.notify_one();
+    }
+  }
+}
+
+// "id@host:port,id@host:port" for the current members, sorted by id.
+// Caller holds g_mu.
+std::string view_members_str() {
+  std::ostringstream out;
+  bool first = true;
+  for (auto& m : g_members) {
+    if (!first) out << ",";
+    out << m.first << "@" << m.second;
+    first = false;
+  }
+  return out.str();
+}
+
+// Installs a view received over replication (or decided locally).
+// Caller holds g_mu; peer channel reconciliation happens lazily by the
+// caller OUTSIDE g_mu via the returned flag.
+bool install_view(long long view_id, const std::string& members) {
+  if (view_id <= g_view_id) return false;
+  g_view_id = view_id;
+  g_members.clear();
+  std::stringstream ms(members);
+  std::string item;
+  while (std::getline(ms, item, ',')) {
+    if (item.empty()) continue;
+    auto at = item.find('@');
+    g_members[atoi(item.substr(0, at).c_str())] = item.substr(at + 1);
+  }
+  return true;
+}
+
+// Brings replication channels in line with g_members: channels only
+// for members other than self; removed members' channels retire.
+void reconcile_peers() {
+  std::map<int, std::string> members;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    members = g_members;
+  }
+  std::vector<int> live;
+  {
+    std::lock_guard<std::mutex> l(g_peers_mu);
+    for (Peer* p : g_peers) live.push_back(p->id);
+  }
+  for (int id : live)
+    if (!members.count(id)) retire_peer(id);
+  for (auto& m : members)
+    if (m.first != g_id) ensure_peer(m.first, m.second);
+}
+
 // Applies a mutation under g_mu; returns the response for the client.
 std::string apply(const std::string& op, const std::string& k,
                   const std::string& a, const std::string& b,
@@ -169,11 +278,19 @@ std::string apply(const std::string& op, const std::string& k,
   return "OK";
 }
 
-// Ship an already-applied mutation to every peer; in --sync mode wait
-// for acks from unblocked peers (timeout degrades to async — the bug).
+// Ship an already-applied mutation to every live peer channel; in
+// --sync mode wait for acks from unblocked peers (timeout degrades to
+// async — the bug).  Retired channels (members removed by LEAVE) are
+// skipped: the removed node silently stops receiving updates.
 void replicate(long long seq, const std::string& line) {
-  for (Peer* p : g_peers) {
+  std::vector<Peer*> peers;
+  {
+    std::lock_guard<std::mutex> l(g_peers_mu);
+    peers = g_peers;
+  }
+  for (Peer* p : peers) {
     std::lock_guard<std::mutex> l(p->mu);
+    if (p->stop) continue;
     p->queue.push_back(line);
     p->cv.notify_one();
   }
@@ -182,9 +299,10 @@ void replicate(long long seq, const std::string& line) {
                   std::chrono::milliseconds(g_ack_timeout_ms);
   std::unique_lock<std::mutex> l(g_ack_mu);
   g_ack_cv.wait_until(l, deadline, [&] {
-    for (Peer* p : g_peers) {
+    for (Peer* p : peers) {
       if (blocked(p->id)) continue;
       std::lock_guard<std::mutex> pl(p->mu);
+      if (p->stop) continue;
       if (p->acked < seq) return false;
     }
     return true;
@@ -239,6 +357,7 @@ void serve(int fd) {
         // out, like a dropped packet.
         continue;
       }
+      bool views_changed = false;
       {
         // Idempotent apply: a slow ack (> the sender's recv timeout)
         // makes the sender re-ship the line on a fresh connection, so
@@ -247,12 +366,57 @@ void serve(int fd) {
         std::lock_guard<std::mutex> l(g_mu);
         long long& applied = g_applied_from[from];
         if (seq > applied) {
-          g_kv[k] = v;
+          if (op == "VIEW") {
+            views_changed = install_view(atoll(k.c_str()), v);
+          } else {
+            g_kv[k] = v;
+          }
           applied = seq;
           if (seq > g_seq) g_seq = seq;
         }
       }
+      if (views_changed) reconcile_peers();
       resp = "ACK " + std::to_string(seq);
+    } else if (cmd == "VIEW") {
+      std::lock_guard<std::mutex> l(g_mu);
+      resp = "VIEW " + std::to_string(g_view_id) + " " +
+             view_members_str();
+    } else if (cmd == "JOIN" || cmd == "LEAVE") {
+      int id;
+      std::string hostport;
+      in >> id;
+      if (cmd == "JOIN") in >> hostport;
+      long long seq = 0;
+      std::string line;
+      {
+        std::lock_guard<std::mutex> l(g_mu);
+        if (!g_primary) {
+          resp = "ERR notprimary";
+        } else if (cmd == "JOIN" && g_members.count(id)) {
+          resp = "ERR member";
+        } else if (cmd == "LEAVE" &&
+                   (id == g_id || !g_members.count(id))) {
+          resp = id == g_id ? "ERR self" : "ERR nomember";
+        } else {
+          if (cmd == "JOIN") g_members[id] = hostport;
+          else g_members.erase(id);
+          g_view_id++;
+          resp = "OK";
+          seq = ++g_seq;
+          std::ostringstream repl;
+          repl << "REPL " << g_id << " " << seq << " VIEW " << g_view_id
+               << " " << view_members_str() << "\n";
+          line = repl.str();
+        }
+      }
+      if (!line.empty()) {
+        // Channels first: a joined member needs one to hear anything;
+        // a removed member's channel retires BEFORE the view ships, so
+        // the leaver never learns it left (the membership suite's
+        // stale-replica physics).
+        reconcile_peers();
+        replicate(seq, line);
+      }
     } else if (cmd == "ROLE") {
       std::lock_guard<std::mutex> l(g_mu);
       resp = g_primary ? "PRIMARY" : "BACKUP";
@@ -292,12 +456,14 @@ void serve(int fd) {
 int main(int argc, char** argv) {
   int port = 7100;
   std::string listen_addr = "127.0.0.1";
+  std::string advertise;  // routable self-address for views
   std::string peers;  // "id@host:port,id@host:port"
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
     auto next = [&]() { return std::string(argv[++i]); };
     if (a == "--port") port = atoi(next().c_str());
     else if (a == "--listen") listen_addr = next();
+    else if (a == "--advertise") advertise = next();
     else if (a == "--id") g_id = atoi(next().c_str());
     else if (a == "--peers") peers = next();
     else if (a == "--primary") g_primary = true;
@@ -306,19 +472,21 @@ int main(int argc, char** argv) {
   }
   signal(SIGPIPE, SIG_IGN);
 
+  // The advertised self-address enters membership views and is what
+  // OTHER nodes dial after a failover: it must be routable, so a
+  // wildcard --listen needs an explicit --advertise.
+  g_self_addr = advertise.empty()
+                    ? listen_addr + ":" + std::to_string(port)
+                    : advertise;
+  g_members[g_id] = g_self_addr;
   std::stringstream ps(peers);
   std::string item;
   while (std::getline(ps, item, ',')) {
     if (item.empty()) continue;
     auto at = item.find('@');
-    auto colon = item.rfind(':');
-    Peer* p = new Peer();
-    p->id = atoi(item.substr(0, at).c_str());
-    p->host = item.substr(at + 1, colon - at - 1);
-    p->port = atoi(item.substr(colon + 1).c_str());
-    g_peers.push_back(p);
-    std::thread(peer_loop, p).detach();
+    g_members[atoi(item.substr(0, at).c_str())] = item.substr(at + 1);
   }
+  reconcile_peers();
 
   int srv = socket(AF_INET, SOCK_STREAM, 0);
   int one = 1;

@@ -112,7 +112,13 @@ class RepkvDB(jdb.DB):
             "--peers", peers,
         ]
         if not test.get("repkv-local", True):
-            args += ["--listen", "0.0.0.0"]
+            # Wildcard listen needs a routable advertised address for
+            # membership views (what peers dial after failover).
+            args += [
+                "--listen", "0.0.0.0",
+                "--advertise",
+                f"{node_host(test, node)}:{node_port(test, node)}",
+            ]
         if node == primary_node(test):
             args.append("--primary")
         if test.get("repkv-sync", True):
@@ -249,6 +255,124 @@ class RepkvMembership:
         pass
 
 
+class RepkvGrowShrink:
+    """Grow/shrink membership state machine over repkv's real
+    JOIN/LEAVE (the reference's core membership use,
+    nemesis/membership.clj:1-47 + membership/state.clj:20-57): node
+    views are each node's VIEW+ROLE response; the merged view is the
+    highest view id seen; ops alternate naturally — re-join whichever
+    node the group lost, else shrink by removing a live backup.
+
+    The physics this drives (demo/repkv/repkv.cpp): a LEAVEd backup is
+    never told, keeps its stale view, and serves reads frozen at
+    removal time — under unsafe reads the checker convicts those, and
+    the stale-read screen (checker/refute.py) names the exact read."""
+
+    def __init__(self, min_members: int = 2):
+        self.min_members = min_members
+
+    # -- MembershipState protocol -----------------------------------------
+
+    def setup(self, test):
+        return self
+
+    def node_view(self, test, session, node):
+        try:
+            resp = _admin_round_trip(test, node, "VIEW")
+            parts = resp.split()
+            if not parts or parts[0] != "VIEW":
+                return None
+            members = parts[2] if len(parts) > 2 else ""
+            role = _admin_round_trip(test, node, "ROLE")
+            return {
+                "view-id": int(parts[1]),
+                "members": tuple(sorted(m for m in members.split(",") if m)),
+                "role": role,
+            }
+        except (OSError, ValueError):
+            return None
+
+    def merge_views(self, test):
+        best = None
+        for v in self.node_views.values():
+            if v and (best is None or v["view-id"] > best["view-id"]):
+                best = v
+        return best
+
+    def fs(self):
+        return {"join", "leave"}
+
+    def _member_ids(self, view) -> dict:
+        return {
+            m.split("@", 1)[0]: m.split("@", 1)[1]
+            for m in (view.get("members") or ())
+            if m
+        }
+
+    def op(self, test):
+        from ..generator.core import PENDING
+
+        view = self.view
+        if view is None or self.pending:
+            return PENDING
+        members = self._member_ids(view)
+        nodes = test.get("nodes") or []
+        all_ids = {str(i): n for i, n in enumerate(nodes)}
+        removed = sorted(i for i in all_ids if i not in members)
+        if removed:
+            i = removed[0]
+            node = all_ids[i]
+            addr = f"{node_host(test, node)}:{node_port(test, node)}"
+            return {"type": "info", "f": "join", "value": (int(i), addr)}
+        if len(members) <= self.min_members:
+            return PENDING
+        primary_ids = {
+            str(node_index(test, n))
+            for n, v in self.node_views.items()
+            if v and v.get("role") == "PRIMARY"
+        }
+        cands = sorted(i for i in members if i not in primary_ids)
+        if not cands:
+            return PENDING
+        return {"type": "info", "f": "leave", "value": int(cands[-1])}
+
+    def invoke(self, test, op):
+        primary = discover_primary(test)
+        try:
+            if op.f == "join":
+                i, addr = op.value
+                resp = _admin_round_trip(test, primary,
+                                         f"JOIN {i} {addr}", timeout=2.0)
+            else:
+                resp = _admin_round_trip(test, primary,
+                                         f"LEAVE {op.value}", timeout=2.0)
+        except OSError as e:
+            resp = f"error: {e}"
+        return op.replace(ext=dict(op.ext, resp=resp))
+
+    def resolve(self, test):
+        return False
+
+    def resolve_op(self, test, pair):
+        inv, comp = pair
+        resp = (comp.ext or {}).get("resp", "")
+        if not resp or resp.startswith("error") or resp.startswith("ERR"):
+            # Rejected, unreachable, or the server died mid-round-trip
+            # (empty reply): the change never applied, so no future
+            # view can confirm it — abandon rather than wedge pending.
+            return True
+        view = self.view
+        if view is None:
+            return False
+        members = self._member_ids(view)
+        if inv.f == "join":
+            return str(inv.value[0]) in members
+        return str(inv.value) not in members
+
+    def teardown(self, test):
+        pass
+
+
 class RepkvClient(jc.Client):
     """One connection to the client's own node (reads) and one to the
     primary (writes), unless safe-reads routes everything primary-ward.
@@ -361,6 +485,23 @@ def repkv_test(opts: dict) -> dict:
             "view-interval": opts.get("view-interval", 0.5),
         }
     pkg = nemesis_package(pkg_opts)
+    if "grow-shrink" in faults:
+        # Real JOIN/LEAVE against the process group, composed with
+        # whatever other faults run (membership.clj's core use).
+        from ..nemesis.combined import compose_packages
+        from ..nemesis.membership import membership_package
+
+        gs = membership_package({
+            "faults": {"membership"},
+            "interval": opts.get("interval", 3.0),
+            "membership": {
+                "state": RepkvGrowShrink(
+                    min_members=opts.get("min-members", 2)
+                ),
+                "view-interval": opts.get("view-interval", 0.5),
+            },
+        })
+        pkg = compose_packages([pkg, gs])
     generator = time_limit(
         opts.get("time-limit", 15.0),
         gen_nemesis(
@@ -400,7 +541,8 @@ def repkv_test(opts: dict) -> dict:
 
 def _extra_opts(p) -> None:
     p.add_argument("--faults", action="append", default=None,
-                   choices=["partition", "kill", "pause"])
+                   choices=["partition", "kill", "pause", "membership",
+                            "grow-shrink"])
     p.add_argument("--rate", type=float, default=100.0)
     p.add_argument("--interval", type=float, default=3.0)
     p.add_argument("--no-sync", dest="sync", action="store_false",
