@@ -278,23 +278,34 @@ std::string apply(const std::string& op, const std::string& k,
   return "OK";
 }
 
-// Ship an already-applied mutation to every live peer channel; in
-// --sync mode wait for acks from unblocked peers (timeout degrades to
-// async — the bug).  Retired channels (members removed by LEAVE) are
-// skipped: the removed node silently stops receiving updates.
-void replicate(long long seq, const std::string& line) {
+// Enqueues an already-applied mutation onto every live peer channel.
+// MUST be called while still holding g_mu (the lock that assigned the
+// line's seq): releasing between seq assignment and enqueue lets a
+// racing higher-seq line enqueue first, and the receiver's per-sender
+// watermark then drops the lower-seq line forever — survivable for a
+// SET, fatal for a VIEW change (a backup stuck on stale membership).
+// Lock order g_mu -> g_peers_mu is used consistently.  Retired
+// channels (members removed by LEAVE) are skipped: the removed node
+// silently stops receiving updates.
+void enqueue_all_g_mu_held(const std::string& line) {
+  std::lock_guard<std::mutex> l(g_peers_mu);
+  for (Peer* p : g_peers) {
+    std::lock_guard<std::mutex> pl(p->mu);
+    if (p->stop) continue;
+    p->queue.push_back(line);
+    p->cv.notify_one();
+  }
+}
+
+// In --sync mode, wait for acks from unblocked live peers (timeout
+// degrades to async — the bug).  Called WITHOUT g_mu.
+void await_acks(long long seq) {
+  if (!g_sync) return;
   std::vector<Peer*> peers;
   {
     std::lock_guard<std::mutex> l(g_peers_mu);
     peers = g_peers;
   }
-  for (Peer* p : peers) {
-    std::lock_guard<std::mutex> l(p->mu);
-    if (p->stop) continue;
-    p->queue.push_back(line);
-    p->cv.notify_one();
-  }
-  if (!g_sync) return;
   auto deadline = std::chrono::steady_clock::now() +
                   std::chrono::milliseconds(g_ack_timeout_ms);
   std::unique_lock<std::mutex> l(g_ack_mu);
@@ -338,15 +349,16 @@ void serve(int fd) {
           resp = "ERR notprimary";
         } else {
           resp = apply(cmd, k, a, b, &mutated);
-          if (mutated) seq = ++g_seq;
+          if (mutated) {
+            seq = ++g_seq;
+            std::ostringstream repl;
+            repl << "REPL " << g_id << " " << seq << " SET " << k << " "
+                 << (cmd == "SET" ? a : b) << "\n";
+            enqueue_all_g_mu_held(repl.str());
+          }
         }
       }
-      if (mutated) {
-        std::ostringstream repl;
-        repl << "REPL " << g_id << " " << seq << " SET " << k << " "
-             << (cmd == "SET" ? a : b) << "\n";
-        replicate(seq, repl.str());
-      }
+      if (mutated) await_acks(seq);
     } else if (cmd == "REPL") {
       int from;
       long long seq;
@@ -387,11 +399,14 @@ void serve(int fd) {
       in >> id;
       if (cmd == "JOIN") in >> hostport;
       long long seq = 0;
-      std::string line;
+      bool changed = false;
       {
         std::lock_guard<std::mutex> l(g_mu);
         if (!g_primary) {
           resp = "ERR notprimary";
+        } else if (cmd == "JOIN" &&
+                   hostport.find(':') == std::string::npos) {
+          resp = "ERR badaddr";
         } else if (cmd == "JOIN" && g_members.count(id)) {
           resp = "ERR member";
         } else if (cmd == "LEAVE" &&
@@ -402,21 +417,23 @@ void serve(int fd) {
           else g_members.erase(id);
           g_view_id++;
           resp = "OK";
+          changed = true;
           seq = ++g_seq;
+          // Channel changes and the view line's enqueue happen under
+          // the SAME g_mu hold that assigned seq (see
+          // enqueue_all_g_mu_held): a joined member's channel exists
+          // before the line ships so it hears the view; a removed
+          // member's channel retires first so the leaver never learns
+          // it left (the membership suite's stale-replica physics).
+          if (cmd == "JOIN") ensure_peer(id, hostport);
+          else retire_peer(id);
           std::ostringstream repl;
           repl << "REPL " << g_id << " " << seq << " VIEW " << g_view_id
                << " " << view_members_str() << "\n";
-          line = repl.str();
+          enqueue_all_g_mu_held(repl.str());
         }
       }
-      if (!line.empty()) {
-        // Channels first: a joined member needs one to hear anything;
-        // a removed member's channel retires BEFORE the view ships, so
-        // the leaver never learns it left (the membership suite's
-        // stale-replica physics).
-        reconcile_peers();
-        replicate(seq, line);
-      }
+      if (changed) await_acks(seq);
     } else if (cmd == "ROLE") {
       std::lock_guard<std::mutex> l(g_mu);
       resp = g_primary ? "PRIMARY" : "BACKUP";

@@ -18,6 +18,17 @@ from ..utils import await_fn
 from . import Session
 from .core import NonzeroExit, lit
 
+
+def hashed_base_port(store_root: str, base: int, stride: int = 10,
+                     buckets: int = 2000) -> int:
+    """Deterministic per-store-dir port base so concurrently-running
+    suites (different tmp dirs, one machine) rarely collide.  One
+    implementation for every demo suite — the CRC expression used to
+    be copy-pasted per suite with drifting strides."""
+    import zlib
+
+    return base + (zlib.crc32(store_root.encode()) % buckets) * stride
+
 log = logging.getLogger(__name__)
 
 

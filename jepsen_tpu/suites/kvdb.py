@@ -21,7 +21,6 @@ from __future__ import annotations
 import os
 import random
 import socket
-import zlib
 from typing import Any, Optional
 
 from .. import client as jc
@@ -286,9 +285,8 @@ def kvdb_test(opts: dict) -> dict:
     test["kvdb-dir"] = opts.get("kvdb-dir") or os.path.join(
         store_root, "kvdb-data"
     )
-    test["kvdb-base-port"] = BASE_PORT + (
-        zlib.crc32(store_root.encode()) % 2000
-    ) * 10
+    test["kvdb-base-port"] = cutil.hashed_base_port(store_root,
+                                                    BASE_PORT)
     if "model" in wl:
         test["model"] = wl["model"]
     if wl.get("final-generator") is not None:

@@ -23,7 +23,6 @@ from __future__ import annotations
 
 import os
 import socket
-import zlib
 from typing import Any, Optional
 
 from .. import cli as jcli
@@ -532,9 +531,8 @@ def repkv_test(opts: dict) -> dict:
         "repkv-dir": opts.get("repkv-dir") or os.path.join(
             store_root, "repkv-data"
         ),
-        "repkv-base-port": BASE_PORT + (
-            zlib.crc32(store_root.encode()) % 2000
-        ) * 10,
+        "repkv-base-port": cutil.hashed_base_port(store_root,
+                                                  BASE_PORT),
     }
     return test
 
