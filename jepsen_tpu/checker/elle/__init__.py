@@ -34,8 +34,11 @@ __all__ = [
 
 def _device_cycle_fn(device: str):
     """None (host Tarjan) or the device-screened search (ops/scc.py):
-    the MXU closure kernel settles acyclic graphs; only flagged graphs
-    get the exact host layered extraction — same records either way."""
+    the MXU closure kernel settles acyclic graphs; small flagged
+    graphs get the exact host layered extraction, large flagged ones
+    extract their witness cycles on device too — same anomaly-type
+    verdicts, but the device path emits one certificate per layer
+    rather than the host's one per SCC per layer."""
     if device == "off":
         return None
 

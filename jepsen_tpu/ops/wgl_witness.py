@@ -380,22 +380,28 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                 new_states = jnp.where(surv_dir[:, None], ns, states)
                 return member, new_states, new_alive
 
-            def targeted(member, states, alive):
-                avail = helper_avail(member, alive)
+            def targeted_or_expand(member, states, alive):
+                """One fused escalation: the (W·B) helper pair-step is
+                evaluated ONCE and feeds both the targeted test
+                (helper+barrier legal -> done) and the expand-any
+                fallback (any productive helper -> keep searching).
+                Round-2's split version recomputed pair_steps and ran
+                select_children twice behind an extra lax.cond — the
+                chain rounds are ~88% of witness time (see
+                tools/profile_witness.py), so the duplicated work was
+                the engine's single hottest redundancy."""
+                avail = helper_avail(member, alive).reshape(-1)
                 states_rep = jnp.tile(states, (W, 1))
                 s1, legal1 = pair_steps(states_rep)
                 s2, legal2 = jax.vmap(step_bar)(s1)
-                good = avail.reshape(-1) & legal1 & legal2
-                cm, cs, ca = select_children(member, s2, good)
-                return cm, cs, ca, ca.any()
-
-            def expand_any(member, states, alive):
-                avail = helper_avail(member, alive)
-                states_rep = jnp.tile(states, (W, 1))
-                s1, legal1 = pair_steps(states_rep)
+                good_t = avail & legal1 & legal2
+                ok2 = good_t.any()
                 productive = legal1 & (s1 != states_rep).any(axis=1)
-                good = avail.reshape(-1) & productive
-                return select_children(member, s1, good)
+                good_e = avail & productive
+                child = jnp.where(ok2, s2, s1)
+                good = jnp.where(ok2, good_t, good_e)
+                cm, cs, ca = select_children(member, child, good)
+                return cm, cs, ca, ok2
 
             def cond(c):
                 _, _, alive, done, d = c
@@ -409,16 +415,7 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                     return m1, s1, al1, True
 
                 def no_direct(_):
-                    m2, s2, al2, ok2 = targeted(member, states, alive)
-
-                    def on_targeted(_):
-                        return m2, s2, al2, True
-
-                    def escalate(_):
-                        m3, s3, al3 = expand_any(member, states, alive)
-                        return m3, s3, al3, False
-
-                    return jax.lax.cond(ok2, on_targeted, escalate, None)
+                    return targeted_or_expand(member, states, alive)
 
                 mN, sN, alN, done = jax.lax.cond(
                     al1.any(), on_direct, no_direct, None

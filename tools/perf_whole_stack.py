@@ -10,6 +10,10 @@ reference measures 1e6 ops on the JVM at concurrency 100 with no
 asserted threshold; this prints the same two numbers for comparison.
 
 Usage: python tools/perf_whole_stack.py [n_ops] [concurrency]
+
+`measure()` is importable (tests/test_whole_stack_perf.py asserts a
+floor on the CI shape), so the numbers CI guards and the numbers this
+prints are the same code path.
 """
 
 from __future__ import annotations
@@ -23,10 +27,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
 
-def main() -> int:
-    n_ops = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
-    concurrency = int(sys.argv[2]) if len(sys.argv) > 2 else 100
-
+def measure(n_ops: int, concurrency: int) -> dict:
+    """Runs the whole stack; returns {"run_rate", "check_rate",
+    "valid", "n_run"} (ops/s)."""
     import jepsen_tpu.generator as gen
     from jepsen_tpu.checker import checker as mk_checker
     from jepsen_tpu.core import run as run_test
@@ -61,12 +64,24 @@ def main() -> int:
     t_check = time.monotonic() - t1
     valid = checked.get("valid")
 
+    return {
+        "run_rate": n_run / t_run,
+        "check_rate": n_run / t_check,
+        "valid": valid,
+        "n_run": n_run,
+    }
+
+
+def main() -> int:
+    n_ops = int(sys.argv[1]) if len(sys.argv) > 1 else 1_000_000
+    concurrency = int(sys.argv[2]) if len(sys.argv) > 2 else 100
+    m = measure(n_ops, concurrency)
     print(
-        f"ran {n_run} ops in {t_run:.1f}s ({n_run / t_run:,.0f} ops/s); "
-        f"checked in {t_check:.1f}s ({n_run / t_check:,.0f} ops/s); "
-        f"valid={valid}"
+        f"ran {m['n_run']} ops ({m['run_rate']:,.0f} ops/s); "
+        f"checked at {m['check_rate']:,.0f} ops/s; "
+        f"valid={m['valid']}"
     )
-    return 0 if valid is True else 1
+    return 0 if m["valid"] is True else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,119 @@
+#!/usr/bin/env python
+"""Witness-engine cost decomposition: sweep vs heavy chain rounds.
+
+VERDICT r2 #6 asked either for the heavy chain search to move into the
+Pallas kernel or for a measured profile showing the easy sweep
+dominates end-to-end time.  This tool produces that profile on the
+bench configs (BASELINE.json north star: 100k and 1M ops):
+
+  total   — check_wgl_device wall time on the real bench history
+            (info_rate as configured: heavy rounds fire at barriers
+            the easy path cannot survive).
+  sweep   — the same history shape with info_rate=0: identical barrier
+            count, zero heavy rounds, so the whole run is the barrier
+            sweep (Pallas kernel on TPU, lax.scan on CPU).
+  chain   — total - sweep: the marginal cost of every heavy round
+            (targeted + expand escalations AND their lax.cond
+            scheduling overhead), i.e. the most the chain search could
+            save if it were free.
+
+Method note: info-free histories have slightly fewer packed rows (the
+same op count, but no indeterminate rows widening the window), so
+`sweep` is measured per-barrier and scaled to the real history's
+barrier count before subtraction.  Each figure is the best of
+`--reps` runs after a compile warm-up.
+
+Usage: python tools/profile_witness.py [--ops 100000] [--reps 3]
+       [--platform cpu|default]
+Prints one JSON line per config; paste into
+doc/design-notes/witness-profile.md.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def measure(n_ops: int, reps: int, info_rate: float = 0.05,
+            procs: int = 16) -> dict:
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops.wgl import check_wgl_device
+    from jepsen_tpu.ops.wgl_witness import plan_width
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    pm = cas_register().packed()
+
+    def packed_for(rate, seed):
+        h = random_register_history(
+            n_ops, procs=procs, info_rate=rate, seed=seed
+        )
+        return pack_history(h, pm.encode)
+
+    real = packed_for(info_rate, 45100)
+    easy = packed_for(0.0, 45100)
+    width = plan_width(real)
+
+    def timed(packed, label):
+        best = None
+        # warm-up compiles the kernel shape for this bucket
+        check_wgl_device(packed, pm, time_limit_s=600.0,
+                         width_hint=width)
+        for _ in range(reps):
+            t0 = time.monotonic()
+            res = check_wgl_device(packed, pm, time_limit_s=600.0,
+                                   width_hint=width)
+            dt = time.monotonic() - t0
+            assert res.valid is True, (label, res.valid, res.reason)
+            best = dt if best is None else min(best, dt)
+        return best
+
+    t_total = timed(real, "real")
+    t_sweep_raw = timed(easy, "sweep-only")
+    # scale the sweep cost to the real history's barrier count
+    scale = real.n_ok / max(1, easy.n_ok)
+    t_sweep = t_sweep_raw * scale
+    return {
+        "n_ops": n_ops,
+        "info_rate": info_rate,
+        "barriers": int(real.n_ok),
+        "total_s": round(t_total, 3),
+        "sweep_s": round(t_sweep, 3),
+        "chain_s": round(max(0.0, t_total - t_sweep), 3),
+        "sweep_pct": round(100.0 * t_sweep / t_total, 1),
+        "ops_per_s": round(n_ops / t_total),
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, nargs="*",
+                    default=[100_000, 1_000_000])
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--info", type=float, default=0.05)
+    ap.add_argument("--platform", default="default",
+                    help='"cpu" pins the CPU backend')
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    platform = jax.devices()[0].platform
+
+    for n in args.ops:
+        rec = measure(n, args.reps, info_rate=args.info)
+        rec["platform"] = platform
+        print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
