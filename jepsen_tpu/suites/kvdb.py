@@ -253,7 +253,12 @@ def kvdb_test(opts: dict) -> dict:
     workload_name = opts.get("workload", "register")
     wl = (register_workload if workload_name == "register"
           else set_workload)(opts)
-    faults = set(opts.get("faults") or ["kill"])
+    # NB: an explicit empty list means "no faults" — `or` would
+    # silently substitute the default (the logd bug, round 3).
+    faults = set(
+        opts["faults"] if opts.get("faults") is not None
+        else ["kill"]
+    )
     pkg = nemesis_package({
         "faults": faults,
         "interval": opts.get("interval", 3.0),

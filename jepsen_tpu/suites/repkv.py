@@ -452,7 +452,12 @@ def repkv_test(opts: dict) -> dict:
     import random
 
     nodes = (opts.get("nodes") or ["n1", "n2", "n3"])[:5]
-    faults = set(opts.get("faults") or ["partition"])
+    # NB: an explicit empty list means "no faults" — `or` would
+    # silently substitute the default (the logd bug, round 3).
+    faults = set(
+        opts["faults"] if opts.get("faults") is not None
+        else ["partition"]
+    )
     rng = random.Random(opts.get("seed"))
     # Unique, monotonically increasing write values: a stale read of an
     # old value is then unambiguous — with a small value space a
