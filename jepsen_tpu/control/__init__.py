@@ -82,6 +82,7 @@ class Session:
         sudo_password: Optional[str] = None,
         dir: Optional[str] = None,
         trace: bool = False,
+        no_sudo: bool = False,
     ):
         self.node = node
         self.remote = remote
@@ -89,6 +90,7 @@ class Session:
         self.sudo_password = sudo_password
         self.dir = dir
         self.trace = trace
+        self.no_sudo = no_sudo
 
     @staticmethod
     def connect(test: dict, node: str) -> "Session":
@@ -103,13 +105,22 @@ class Session:
             bound,
             sudo_password=ssh.get("sudo-password"),
             trace=bool(test.get("trace-control", False)),
+            no_sudo=bool(ssh.get("no-sudo")),
         )
 
     # -- state scoping ---------------------------------------------------
 
     @contextlib.contextmanager
     def su(self, user: str = "root") -> Iterator["Session"]:
-        """sudo scope (control.clj:190-199)."""
+        """sudo scope (control.clj:190-199).  A transport that is
+        already root (netns/docker-style remotes on sudo-less images)
+        declares test["ssh"]["no-sudo"] and su("root") becomes a
+        no-op — ONLY for root: a requested non-root identity still
+        wraps (and fails loudly on a sudo-less image) rather than
+        silently running the block as root."""
+        if self.no_sudo and user == "root":
+            yield self
+            return
         old = self.sudo
         self.sudo = user
         try:

@@ -129,7 +129,75 @@ def _netem_args(behavior: Mapping[str, Any]) -> list[str]:
     return args
 
 
-class IptablesNet(Net):
+class TcShapingNet(Net):
+    """Shared tc/netem shaping half of the Net protocol
+    (net.clj:73-164): subclasses supply the partition mechanism and
+    inherit slow/flaky/fast/shape.  `dev` is the qdisc device —
+    eth0 by default, which is also what NetnsCluster names every
+    node's interface."""
+
+    def __init__(self, dev: str = "eth0"):
+        self.dev = dev
+
+    def slow(self, test: dict, **opts: Any) -> None:
+        mean = opts.get("mean", 50)
+        variance = opts.get("variance", 10)
+        dist = opts.get("distribution", "normal")
+
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "tc", "qdisc", "add", "dev", self.dev, "root",
+                    "netem", "delay", f"{mean}ms", f"{variance}ms",
+                    "distribution", dist,
+                )
+
+        on_nodes(test, do)
+
+    def flaky(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec(
+                    "tc", "qdisc", "add", "dev", self.dev, "root",
+                    "netem", "loss", "20%", "75%",
+                )
+
+        on_nodes(test, do)
+
+    def fast(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                # Deleting a nonexistent qdisc fails; ignore like the
+                # reference (net.clj:69-71).
+                res = sess.exec_star(
+                    "tc", "qdisc", "del", "dev", self.dev, "root"
+                )
+                del res
+
+        on_nodes(test, do)
+
+    def shape(self, test: dict, behavior, nodes=None) -> None:
+        if not behavior:
+            self.fast(test)
+            return
+        args = self._shape_args(behavior)
+
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec_star("tc", "qdisc", "del", "dev", self.dev,
+                               "root")
+                sess.exec(
+                    "tc", "qdisc", "add", "dev", self.dev, "root",
+                    *args,
+                )
+
+        on_nodes(test, do, nodes)
+
+    def _shape_args(self, behavior: Mapping[str, Any]) -> list[str]:
+        return ["netem", *_netem_args(behavior)]
+
+
+class IptablesNet(TcShapingNet):
     """iptables + tc/netem implementation (net.clj:177-233)."""
 
     def drop(self, test: dict, src: str, dest: str) -> None:
@@ -167,58 +235,94 @@ class IptablesNet(Net):
 
         on_nodes(test, do)
 
-    def slow(self, test: dict, **opts: Any) -> None:
-        mean = opts.get("mean", 50)
-        variance = opts.get("variance", 10)
-        dist = opts.get("distribution", "normal")
+
+class RouteNet(TcShapingNet):
+    """Kernel-level partitions without a packet-filter userspace:
+    blackhole routes + tc shaping.
+
+    Some hosts (including this repo's CI kernel) ship neither iptables
+    nor nftables binaries, but `ip route` always works.  Routing can
+    only drop a node's OWN egress, so `drop(src, dest)` — "dest stops
+    hearing src" (net/proto.clj:5-12) — installs a blackhole route
+    for dest's address ON SRC: src's packets toward dest die in src's
+    routing table and dest genuinely never hears src, for TCP and
+    datagrams alike.  The residual asymmetry is on the REVERSE path:
+    dest's datagrams still reach src (dest was not asked to stop
+    being heard), while reverse TCP stalls because src can't
+    acknowledge — iptables `INPUT -s src -j DROP` on dest has the
+    mirror-image residue (src's datagrams die at dest but dest's
+    still reach src).  Partition packages emit symmetric grudges, on
+    which both mechanisms produce identical full cuts.
+
+    Shaping (inherited TcShapingNet, net.clj:73-164) uses the netem
+    qdisc where the kernel has it, plus a tbf fallback for rate-only
+    behaviors — tbf is compiled into kernels that lack sch_netem."""
+
+    @staticmethod
+    def _blackhole_prefix(test: dict, node: str) -> str:
+        """node -> an iproute2 prefix.  iproute2 takes only literal
+        prefixes, so hostnames resolve on the control side (same
+        resolver split_host_port topologies already rely on) and
+        IPv6 literals get /128."""
+        import ipaddress
+        import socket
+
+        addr = node_address(test, node)
+        try:
+            ip = ipaddress.ip_address(addr)
+        except ValueError:
+            addr = socket.getaddrinfo(addr, None)[0][4][0]
+            ip = ipaddress.ip_address(addr)
+        return f"{addr}/{128 if ip.version == 6 else 32}"
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        prefix = self._blackhole_prefix(test, dest)
 
         def do(sess: Session, node: str) -> None:
             with sess.su():
-                sess.exec(
-                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
-                    "delay", f"{mean}ms", f"{variance}ms",
-                    "distribution", dist,
+                # replace = idempotent: overlapping grudges re-drop
+                # the same edge without erroring.
+                sess.exec("ip", "route", "replace", "blackhole",
+                          prefix)
+
+        on_nodes(test, do, [src])
+
+    def drop_all(self, test: dict, grudge: Mapping[str, Any]) -> None:
+        # The grudge maps dest -> the srcs it stops hearing; routes
+        # must be installed on each SRC (see class doc), so invert to
+        # src -> dest-prefixes and run one shell per src node — still
+        # the bulk PartitionAll shape (net.clj:223-233).
+        by_src: dict[str, list[str]] = {}
+        for dest, cut in grudge.items():
+            for src in cut:
+                by_src.setdefault(src, []).append(
+                    self._blackhole_prefix(test, dest)
                 )
+
+        def do(sess: Session, node: str) -> None:
+            script = "; ".join(
+                f"ip route replace blackhole {prefix}"
+                for prefix in sorted(by_src[node])
+            )
+            with sess.su():
+                sess.exec("bash", "-c", script)
+
+        on_nodes(test, do, list(by_src.keys()))
+
+    def heal(self, test: dict) -> None:
+        def do(sess: Session, node: str) -> None:
+            with sess.su():
+                sess.exec("bash", "-c",
+                          "ip route flush type blackhole || true")
 
         on_nodes(test, do)
 
-    def flaky(self, test: dict) -> None:
-        def do(sess: Session, node: str) -> None:
-            with sess.su():
-                sess.exec(
-                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
-                    "loss", "20%", "75%",
-                )
-
-        on_nodes(test, do)
-
-    def fast(self, test: dict) -> None:
-        def do(sess: Session, node: str) -> None:
-            with sess.su():
-                # Deleting a nonexistent qdisc fails; ignore like the
-                # reference (net.clj:69-71).
-                res = sess.exec_star(
-                    "tc", "qdisc", "del", "dev", "eth0", "root"
-                )
-                del res
-
-        on_nodes(test, do)
-
-    def shape(self, test: dict, behavior, nodes=None) -> None:
-        if not behavior:
-            self.fast(test)
-            return
-        args = _netem_args(behavior)
-
-        def do(sess: Session, node: str) -> None:
-            with sess.su():
-                sess.exec_star("tc", "qdisc", "del", "dev", "eth0", "root")
-                sess.exec(
-                    "tc", "qdisc", "add", "dev", "eth0", "root", "netem",
-                    *args,
-                )
-
-        on_nodes(test, do, nodes)
+    def _shape_args(self, behavior: Mapping[str, Any]) -> list[str]:
+        if set(behavior) == {"rate"}:
+            # tbf fallback: netem-free kernels can still rate-limit.
+            return ["tbf", "rate", f"{behavior['rate']}kbit",
+                    "burst", "32kbit", "latency", "400ms"]
+        return super()._shape_args(behavior)
 
 
 class IpfilterNet(IptablesNet):
@@ -262,4 +366,5 @@ class IpfilterNet(IptablesNet):
 
 iptables = IptablesNet()
 ipfilter = IpfilterNet()
+route = RouteNet()
 noop = NoopNet()

@@ -271,7 +271,7 @@ def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
 
 def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                    jax_step, pallas_mode: str = "off",
-                   jax_step_rows=None):
+                   jax_step_rows=None, compact: int = 0):
     """One call runs NB blocks of up to K barriers each.
 
     Args: member (W, B) bool — window-major so the per-barrier
@@ -295,6 +295,17 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
     is visited exactly once.
 
     Flat (helper, lane) pair indexing is helper-major: i = h*B + lane.
+
+    `compact` (static, 0 = off) is the candidate-compaction tile width:
+    round-3 profiling measured 50-90% of the (W, B) pair lanes masked
+    out by `avail` in the chain rounds (which are 85-89% of witness
+    time).  When the number of window rows with ANY available lane fits
+    in `compact`, the heavy round gathers just those rows into a
+    (compact, B) tile — the batched pair-step and the argsort dedup
+    then run over compact*B candidates instead of W*B — and maps the
+    winners back to window columns through the gather index.  Overflow
+    falls back to the uncompacted path behind a lax.cond (the engine's
+    standard escalation pattern), so results are bit-identical.
     """
     import jax
     import jax.numpy as jnp
@@ -303,6 +314,7 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
     hv = jnp.asarray(_state_hash_vec(SW))
     BIG = jnp.float32(3.0e38)
     M = B * W
+    WC = compact if 0 < compact < W else 0
 
     pallas_sweep = (
         _make_pallas_sweep(
@@ -318,23 +330,25 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             tab[0], tab[1], tab[2], tab[3], tab[4],
         )
 
-        def pair_steps(states_rep):
+        def pair_steps(states_rep, f_r, a0_r, a1_r):
             # helper-major: rows h*B+lane pair helper h with lane's state
             return jax.vmap(jax_step)(
                 states_rep,
-                jnp.repeat(f_w, B),
-                jnp.repeat(a0_w, B),
-                jnp.repeat(a1_w, B),
+                jnp.repeat(f_r, B),
+                jnp.repeat(a0_r, B),
+                jnp.repeat(a1_r, B),
             )
 
-        def select_children(member, child_states, good):
+        def select_children(member, child_states, good, row_map):
             """Dedup (helper, lane) children by model state, keep <= B.
 
-            Selection happens over (M,) scalars FIRST; member columns
-            are materialized only for the <= B winners — building
-            (M, W) child-member matrices up front costs ~B*W*W bytes.
-            Hash-sort + exact adjacent compare: equal states always
-            hash equal; collisions only cost beam slots."""
+            Selection happens over flat-pair scalars FIRST; member
+            columns are materialized only for the <= B winners —
+            building (M, W) child-member matrices up front costs
+            ~B*W*W bytes.  Hash-sort + exact adjacent compare: equal
+            states always hash equal; collisions only cost beam slots.
+            `row_map` maps tile rows back to window columns (identity
+            for the uncompacted path)."""
             h = jnp.where(good, child_states.astype(jnp.float32) @ hv, BIG)
             order = jnp.argsort(h)
             hs = h[order]
@@ -346,7 +360,7 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             uniq = (hs < BIG) & ~same
             n_child = jnp.minimum(uniq.sum(), B)
             pos = order[jnp.nonzero(uniq, size=B, fill_value=0)[0]]
-            hcol = pos // B
+            hcol = row_map[pos // B]
             lane = pos % B
             new_member = member[:, lane] | (col[:, None] == hcol[None, :])
             new_alive = jnp.arange(B) < n_child
@@ -380,28 +394,64 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                 new_states = jnp.where(surv_dir[:, None], ns, states)
                 return member, new_states, new_alive
 
-            def targeted_or_expand(member, states, alive):
-                """One fused escalation: the (W·B) helper pair-step is
-                evaluated ONCE and feeds both the targeted test
-                (helper+barrier legal -> done) and the expand-any
-                fallback (any productive helper -> keep searching).
-                Round-2's split version recomputed pair_steps and ran
-                select_children twice behind an extra lax.cond — the
-                chain rounds are ~88% of witness time (see
-                tools/profile_witness.py), so the duplicated work was
-                the engine's single hottest redundancy."""
-                avail = helper_avail(member, alive).reshape(-1)
-                states_rep = jnp.tile(states, (W, 1))
-                s1, legal1 = pair_steps(states_rep)
+            def run_tile(member, states, avail, row_map, f_r, a0_r,
+                         a1_r):
+                """One fused escalation over a (R, B) candidate tile:
+                the helper pair-step is evaluated ONCE and feeds both
+                the targeted test (helper+barrier legal -> done) and
+                the expand-any fallback (any productive helper -> keep
+                searching).  Round-2's split version recomputed
+                pair_steps and ran select_children twice behind an
+                extra lax.cond — the chain rounds are ~88% of witness
+                time (see tools/profile_witness.py), so the duplicated
+                work was the engine's single hottest redundancy."""
+                R = row_map.shape[0]
+                flat = avail.reshape(-1)
+                states_rep = jnp.tile(states, (R, 1))
+                s1, legal1 = pair_steps(states_rep, f_r, a0_r, a1_r)
                 s2, legal2 = jax.vmap(step_bar)(s1)
-                good_t = avail & legal1 & legal2
+                good_t = flat & legal1 & legal2
                 ok2 = good_t.any()
                 productive = legal1 & (s1 != states_rep).any(axis=1)
-                good_e = avail & productive
+                good_e = flat & productive
                 child = jnp.where(ok2, s2, s1)
                 good = jnp.where(ok2, good_t, good_e)
-                cm, cs, ca = select_children(member, child, good)
+                cm, cs, ca = select_children(member, child, good,
+                                             row_map)
                 return cm, cs, ca, ok2
+
+            def targeted_or_expand(member, states, alive):
+                """Chain-round escalation with candidate compaction:
+                gather the window rows that still have an available
+                (helper, lane) pair into a (WC, B) tile when they fit
+                (the 50-90%-masked common case measured in round 3),
+                else run the full (W, B) tile.  Candidate order is
+                preserved by the ascending gather, so both branches
+                select identical children — the cond trades nothing
+                but compile time."""
+                avail_full = helper_avail(member, alive)  # (W, B)
+                if WC == 0:
+                    return run_tile(member, states, avail_full, col,
+                                    f_w, a0_w, a1_w)
+
+                row_any = avail_full.any(axis=1)
+                n_av = row_any.sum()
+
+                def compact_path(_):
+                    idx = jnp.nonzero(row_any, size=WC,
+                                      fill_value=0)[0]
+                    avail_c = avail_full[idx] & (
+                        jnp.arange(WC) < n_av
+                    )[:, None]
+                    return run_tile(member, states, avail_c, idx,
+                                    f_w[idx], a0_w[idx], a1_w[idx])
+
+                def full_path(_):
+                    return run_tile(member, states, avail_full, col,
+                                    f_w, a0_w, a1_w)
+
+                return jax.lax.cond(n_av <= WC, compact_path,
+                                    full_path, None)
 
             def cond(c):
                 _, _, alive, done, d = c
@@ -539,6 +589,7 @@ def check_wgl_witness(
     width_hint: int = 0,
     time_limit_s: Optional[float] = None,
     pallas: str = "auto",
+    compact: int = -1,
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
 
@@ -552,6 +603,16 @@ def check_wgl_witness(
     `pallas`: "auto" runs the easy sweep as a Pallas VMEM kernel on TPU
     backends and the XLA scan elsewhere; "on"/"interpret"/"off" force a
     mode ("interpret" is the CPU-testable emulation of the kernel).
+
+    `compact`: chain-round candidate-compaction tile width.  -1 picks
+    max(64, min(W // 2, info_window)) — or max(64, W // 8) when
+    info_window is None: available helpers at a chain round are
+    almost all info columns, which the window bound caps at
+    info_window, so a tile of exactly that width fits nearly every
+    round (measured on the 100k bench config: compact=512 = the
+    narrow window is 2.9x end-to-end vs off, while W//8 = 256
+    overflows to the full tile at most barriers and wins only 7%).
+    0 disables.
     """
     import jax
     import jax.numpy as jnp
@@ -587,15 +648,21 @@ def check_wgl_witness(
         # longer fits the kernel's one-word member bit-packing.
         pallas = "off"
 
+    if compact < 0:
+        compact = max(64, min(
+            W // 2, info_window if info_window is not None else W // 8
+        ))
+
     # The step fn itself keys the cache (strong ref): an id() key
     # can collide after GC address reuse and serve the wrong
     # model's transition kernel.
-    key = (B, W, SW, K, D, NB, pm.jax_step, pallas)
+    key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
     fn = _chunk_fn_cache.get(key)
     if fn is None:
         fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
                             pallas_mode=pallas,
-                            jax_step_rows=pm.jax_step_rows)
+                            jax_step_rows=pm.jax_step_rows,
+                            compact=compact)
         _chunk_fn_cache[key] = fn
 
     member = jnp.zeros((W, B), dtype=bool)
@@ -687,7 +754,7 @@ def check_wgl_witness(
                 blocks_per_call=blocks_per_call, depth=depth,
                 info_window=info_window, max_window=max_window,
                 width_hint=width_hint, time_limit_s=remaining,
-                pallas="off",
+                pallas="off", compact=compact,
             )
         if failed_now:
             return None

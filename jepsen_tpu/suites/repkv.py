@@ -61,12 +61,20 @@ def primary_node(test: dict) -> str:
 
 
 def node_host(test: dict, node: str) -> str:
-    """Where clients/peers dial this node: loopback in the default
-    local topology, the node's host part against real machines
+    """Where clients/peers dial this node: an explicit in-cluster
+    address when the topology declares one (netns clusters — the
+    net.py node-addresses convention), loopback in the default local
+    topology, else the node's host part against real machines
     (test["repkv-local"] = False) — the kvdb-local pattern
     (suites/kvdb.py:150-158)."""
     if test.get("repkv-local", True):
+        # Local topology always dials loopback, even when
+        # node-addresses exist for a net implementation — in-cluster
+        # aliases need not resolve from the control process.
         return "127.0.0.1"
+    alias = (test.get("node-addresses") or {}).get(node)
+    if alias:
+        return alias
     from ..control.core import split_host_port
 
     host, _ = split_host_port(node)

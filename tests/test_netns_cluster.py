@@ -1,0 +1,213 @@
+"""Real kernel-enforced network partitions in CI (VERDICT r3 #3).
+
+A network-namespace micro-cluster (control/netns.py) gives every node
+its own kernel network stack and a real IP on a shared bridge; the
+RouteNet implementation of the Net protocol (net.py) installs
+blackhole routes INSIDE a node's namespace.  These tests prove, in
+order of increasing stack depth:
+
+1. the environment can create namespaces (skip everything if not);
+2. RouteNet.drop/heal sever and restore a real TCP connection between
+   two namespaces — the kernel, not the application, drops traffic;
+3. the full suite bar (reference nemesis.clj:158-184 + net.clj:177-233):
+   repkv running across three namespaces, the partition nemesis driving
+   RouteNet, backup reads going stale because the KERNEL cut
+   replication, and the checker convicting — plus the safe-reads
+   control group staying valid under identical faults.
+
+No docker, no sshd, no iptables userspace: namespaces + routes are
+enough for the partitioner's whole job.
+"""
+
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+from jepsen_tpu.control import with_sessions
+from jepsen_tpu.control.netns import NetnsCluster, netns_available
+
+pytestmark = pytest.mark.skipif(
+    not netns_available(),
+    reason="network namespaces unavailable (needs root + ip binary)",
+)
+
+
+@pytest.fixture
+def cluster():
+    c = NetnsCluster(n_nodes=3, tag="jtt%05d" % (time.time_ns() % 90000))
+    with c:
+        yield c
+
+
+def base_test(cluster) -> dict:
+    return cluster.test_overlay()
+
+
+def test_cluster_topology(cluster):
+    """Every node sees its own eth0 with its own address — distinct
+    network identities on one host."""
+    test = base_test(cluster)
+    with with_sessions(test):
+        for node in cluster.nodes:
+            sess = test["sessions"][node]
+            out = sess.exec("ip", "-o", "-4", "addr", "show", "eth0")
+            assert cluster.address_of(node) in out
+            # and each node reaches a peer over real TCP (below).
+
+
+def _spawn_server(cluster, node: str, port: int) -> subprocess.Popen:
+    """A TCP echo server inside `node`'s namespace."""
+    code = (
+        "import socket\n"
+        f"s = socket.socket()\n"
+        "s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)\n"
+        f"s.bind(('0.0.0.0', {port}))\n"
+        "s.listen(8)\n"
+        "print('up', flush=True)\n"
+        "while True:\n"
+        "    c, _ = s.accept()\n"
+        "    c.sendall(b'pong\\n')\n"
+        "    c.close()\n"
+    )
+    proc = subprocess.Popen(
+        ["ip", "netns", "exec", cluster.netns_of(node),
+         sys.executable, "-u", "-c", code],
+        stdout=subprocess.PIPE,
+    )
+    assert proc.stdout.readline().strip() == b"up"
+    return proc
+
+
+def _dial_from(cluster, src: str, dest_addr: str, port: int,
+               timeout: float = 1.5) -> str:
+    """TCP round-trip from inside src's namespace to dest_addr."""
+    code = (
+        "import socket\n"
+        f"s = socket.create_connection(('{dest_addr}', {port}), "
+        f"timeout={timeout})\n"
+        "print(s.makefile().readline().strip())\n"
+    )
+    proc = subprocess.run(
+        ["ip", "netns", "exec", cluster.netns_of(src),
+         sys.executable, "-c", code],
+        capture_output=True, text=True, timeout=timeout + 5,
+    )
+    if proc.returncode != 0:
+        raise ConnectionError(proc.stderr.strip()[-200:])
+    return proc.stdout.strip()
+
+
+def test_routenet_drop_heal_severs_real_tcp(cluster):
+    """net.py RouteNet (not an app-level block): drop makes the kernel
+    refuse the path, heal restores it — verified by real sockets."""
+    test = base_test(cluster)
+    server = _spawn_server(cluster, "n2", 7799)
+    try:
+        with with_sessions(test):
+            addr2 = cluster.address_of("n2")
+            assert _dial_from(cluster, "n1", addr2, 7799) == "pong"
+
+            # n1 stops hearing n2 AND n2 stops hearing n1 — the
+            # symmetric grudge a partitioner emits.
+            test["net"].drop_all(
+                test, {"n1": ["n2"], "n2": ["n1"]}
+            )
+            with pytest.raises(ConnectionError):
+                _dial_from(cluster, "n1", addr2, 7799, timeout=1.0)
+            # A third node is unaffected (it's a partition, not an
+            # outage).
+            assert _dial_from(cluster, "n3", addr2, 7799) == "pong"
+
+            test["net"].heal(test)
+            assert _dial_from(cluster, "n1", addr2, 7799) == "pong"
+    finally:
+        server.kill()
+
+
+def test_routenet_rate_shape(cluster):
+    """shape({'rate': ...}) installs a tbf qdisc inside the namespace
+    (the netem-free kernel path)."""
+    test = base_test(cluster)
+    with with_sessions(test):
+        test["net"].shape(test, {"rate": 1024}, nodes=["n1"])
+        sess = test["sessions"]["n1"]
+        out = sess.exec("tc", "qdisc", "show", "dev", "eth0")
+        assert "tbf" in out
+        test["net"].fast(test)
+        out = sess.exec("tc", "qdisc", "show", "dev", "eth0")
+        assert "tbf" not in out
+
+
+def run_repkv_netns(cluster, tmp_path, **opts):
+    from jepsen_tpu import core
+    from jepsen_tpu.suites import repkv
+
+    o = {
+        "nodes": cluster.nodes,
+        "store-dir": str(tmp_path / "store"),
+        "time-limit": 10.0,
+        "rate": 120.0,
+        "interval": 1.0,
+        "algorithm": "wgl-tpu",
+    }
+    o.update(opts)
+    test = repkv.repkv_test(o)
+    # The overlay binds the netns transport AND the kernel-level
+    # RouteNet — overriding repkv's app-level BLOCK net.
+    test.update(cluster.test_overlay())
+    test["repkv-local"] = False  # listen 0.0.0.0, advertise real IP
+    test["concurrency"] = o.get("concurrency", 6)
+    test["store-dir"] = o["store-dir"]
+    return core.run(test)
+
+
+@pytest.mark.slow
+def test_repkv_kernel_partition_stale_read_conviction(tmp_path):
+    """The VERDICT r3 #3 'done' bar: a partition injected by
+    net.py's kernel-level path (blackhole routes inside the
+    namespaces) — NOT repkv's app-level BLOCK — cuts replication for
+    real, a backup serves stale reads, and the device checker
+    convicts.  Control group below proves the conviction is the
+    fault's doing."""
+    last = None
+    for attempt in range(3):
+        c = NetnsCluster(
+            n_nodes=3, tag="jtp%05d" % (time.time_ns() % 90000)
+        )
+        with c:
+            done = run_repkv_netns(
+                c, tmp_path / f"a{attempt}",
+                **{"safe-reads": False, "faults": ["partition"],
+                   "sync": False, "seed": attempt},
+            )
+        last = done["results"]
+        h = done["history"]
+        parts = [op for op in h
+                 if op.process == "nemesis"
+                 and op.f == "start-partition" and op.type == "info"]
+        assert parts, "the nemesis never partitioned"
+        if last["valid"] is False:
+            return
+    pytest.fail(f"3 kernel-partitioned runs never convicted: {last}")
+
+
+@pytest.mark.slow
+def test_repkv_kernel_partition_safe_reads_control(tmp_path):
+    """Identical kernel faults, reads routed to the primary: valid —
+    the conviction above is caused by the partition, not the
+    harness."""
+    c = NetnsCluster(n_nodes=3, tag="jtc%05d" % (time.time_ns() % 90000))
+    with c:
+        done = run_repkv_netns(
+            c, tmp_path,
+            **{"safe-reads": True, "faults": ["partition"],
+               "sync": True},
+        )
+    res = done["results"]
+    assert res["valid"] is True, res
+    parts = [op for op in done["history"]
+             if op.process == "nemesis" and op.f == "start-partition"]
+    assert parts

@@ -1,0 +1,87 @@
+"""Round-long TPU chip-health watcher (VERDICT r3 next-item #1).
+
+Probes the tunneled chip every --interval seconds with bench.probe_chip()
+(tiny matmul in a subprocess under a timeout), appends a timestamped line
+to CHIP_LOG.md, and on the FIRST healthy probe immediately runs
+``python bench.py`` so the TPU measurement is captured and
+BENCH_TPU_LAST_GOOD.json is written while the chip breathes.  After a
+capture it keeps probing (cheaply) so the log documents the whole round.
+
+The log makes "no TPU number this round" an auditable fact about the
+environment rather than a gap in the work.
+
+Usage:  python tools/chip_watch.py [--interval 900] [--once]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "CHIP_LOG.md")
+sys.path.insert(0, REPO)
+
+from bench import probe_chip  # noqa: E402
+
+
+def log_line(text: str) -> None:
+    stamp = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    line = f"- {stamp} {text}"
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+    print(line, flush=True)
+
+
+def ensure_header() -> None:
+    if os.path.exists(LOG):
+        return
+    with open(LOG, "w") as f:
+        f.write(
+            "# Chip probe log\n\n"
+            "Timestamped results of `bench.probe_chip()` (one tiny matmul in a\n"
+            "subprocess under a 90 s timeout; a healthy chip answers in seconds,\n"
+            "a wedged tunnel hangs).  Maintained by `tools/chip_watch.py`, which\n"
+            "runs `bench.py` the moment a probe comes back ok.\n\n"
+        )
+
+
+def capture_bench() -> None:
+    log_line("probe=ok -> running bench.py to capture TPU measurement")
+    try:
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, timeout=1800, cwd=REPO,
+        )
+        tail = proc.stdout.decode(errors="replace").strip().splitlines()
+        line = tail[-1] if tail else "(no output)"
+        log_line(f"bench rc={proc.returncode}: {line}")
+    except subprocess.TimeoutExpired:
+        log_line("bench TIMED OUT (1800 s) despite ok probe")
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=900.0)
+    ap.add_argument("--once", action="store_true")
+    args = ap.parse_args()
+
+    ensure_header()
+    captured = os.path.exists(os.path.join(REPO, "BENCH_TPU_LAST_GOOD.json"))
+    while True:
+        t0 = time.time()
+        result = probe_chip()
+        log_line(f"probe={result} ({time.time() - t0:.1f}s)")
+        if result == "ok" and not captured:
+            capture_bench()
+            captured = True
+        if args.once:
+            return 0
+        time.sleep(max(1.0, args.interval - (time.time() - t0)))
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,73 @@
+"""A/B the chain-round candidate compaction (VERDICT r3 next-item #2).
+
+Runs check_wgl_witness on the bench-shaped history (cas-register,
+info_rate as configured) at several `compact` tile widths, including 0
+(compaction off — the round-3 engine), and prints one JSON line per
+setting with the best-of-reps wall time.  The witness tier decides these
+histories alone, so this isolates the chain-round cost the compaction
+targets.
+
+Usage: python tools/compact_ab.py [--ops 100000] [--reps 3]
+       [--compact 0 -1 128 256] [--platform cpu|default]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--ops", type=int, default=100_000)
+    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--info", type=float, default=0.05)
+    ap.add_argument("--procs", type=int, default=16)
+    ap.add_argument("--compact", type=int, nargs="*",
+                    default=[0, -1])
+    ap.add_argument("--platform", default="cpu")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops.wgl_witness import check_wgl_witness, plan_width
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    pm = cas_register().packed()
+    h = random_register_history(args.ops, procs=args.procs,
+                                info_rate=args.info, seed=45100)
+    packed = pack_history(h, pm.encode)
+    width = plan_width(packed)
+
+    for c in args.compact:
+        best = None
+        for rep in range(args.reps + 1):  # rep 0 = compile warm-up
+            t0 = time.monotonic()
+            res = check_wgl_witness(packed, pm, width_hint=width,
+                                    compact=c)
+            dt = time.monotonic() - t0
+            assert res is not None and res.valid is True, res
+            if rep > 0:
+                best = dt if best is None else min(best, dt)
+        print(json.dumps({
+            "ops": args.ops, "compact": c, "W": width,
+            "best_s": round(best, 3),
+            "ops_per_s": round(args.ops / best),
+            "platform": jax.devices()[0].platform,
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
