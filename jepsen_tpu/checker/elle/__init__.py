@@ -130,18 +130,22 @@ class AppendChecker(Checker):
 
 class WrChecker(Checker):
     """checker for rw-register workloads (wr.clj:5-25).  `device` as in
-    AppendChecker."""
+    AppendChecker.  `sequential_keys` opts into the declared per-key
+    sequential-write version-order inference (see wr.analyze) for
+    systems that promise it."""
 
     def __init__(self, consistency_model: str = "serializable",
-                 device: str = "auto"):
+                 device: str = "auto", sequential_keys: bool = False):
         self.consistency_model = consistency_model
         self.device = device
+        self.sequential_keys = sequential_keys
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
         res = analyze_wr(
             history.client_ops(),
             consistency_model=self.consistency_model,
             cycle_fn=_device_cycle_fn(self.device),
+            sequential_keys=self.sequential_keys,
         )
         write_artifacts(res, opts, "elle-wr")
         return res

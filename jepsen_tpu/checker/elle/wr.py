@@ -20,6 +20,16 @@ saw v), ww edges along inferred v << v' pairs, and rw anti-dependency
 edges (external reader of v -> writer of any v' with v << v').
 Non-cycle anomalies: G1a (aborted read), G1b (intermediate read),
 unwritten reads.  Cycles classify as in graph.classify_cycle.
+
+`sequential_keys=True` is the declared-semantics strengthening Elle
+exposes for workloads that promise per-key sequential writes (the
+assumptions table of the Elle paper, consumed via wr.clj's workload
+options): when write(v)'s completion precedes write(v')'s invocation
+in realtime, v << v' joins the version order — recovering e.g.
+G-single cycles from stale reads that the base evidence (initial
+state + intra-txn sequencing) cannot see, because no transaction ever
+observed both values.  Opt in only when the system under test really
+applies each key's writes in realtime order.
 """
 
 from __future__ import annotations
@@ -38,6 +48,7 @@ def analyze(
     *,
     consistency_model: str = "serializable",
     cycle_fn=None,
+    sequential_keys: bool = False,
 ) -> dict:
     oks = [o for o in history if o.is_ok and o.f in ("txn", None)]
     infos = [o for o in history if o.is_info and o.f in ("txn", None)]
@@ -85,6 +96,39 @@ def analyze(
                 last_seen[k] = v
             elif f == "r":
                 last_seen.setdefault(k, v)
+
+    if sequential_keys:
+        # Declared per-key sequential writes: completion-before-
+        # invocation realtime order joins the version order (see
+        # module doc).  Realtime needs real invocation intervals — a
+        # bare completion list has none, and degrading to completion
+        # order would order CONCURRENT writes (a constraint the
+        # system never promised -> false convictions), so the paired
+        # History is required.  A completion op with no recorded
+        # invocation degrades to a point interval at its own index:
+        # it can gain an order only against ops wholly before/after
+        # it, never against an overlapping one.
+        inv_of = getattr(history, "invocation", None)
+        if not callable(inv_of):
+            raise ValueError(
+                "sequential_keys=True needs a paired History (with "
+                ".invocation), not a bare op list — realtime write "
+                "order cannot be recovered from completions alone"
+            )
+        by_key: dict[Any, list[tuple[int, int, Any]]] = defaultdict(list)
+        for op in oks:
+            inv = inv_of(op)
+            inv_idx = inv.index if inv is not None else op.index
+            for f, k, v in op.value or []:
+                if f == "w":
+                    by_key[k].append((inv_idx, op.index, v))
+        for k, ws in by_key.items():
+            # O(writes-per-key^2): per-key write counts are small in
+            # register workloads, and this path is opt-in.
+            for i1, o1, v1 in ws:
+                for i2, o2, v2 in ws:
+                    if o1 < i2 and v1 != v2:
+                        succ[k][v1].add(v2)
 
     g = DepGraph()
     for op in oks:

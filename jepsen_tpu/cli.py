@@ -76,6 +76,12 @@ def add_standard_opts(p: argparse.ArgumentParser) -> None:
         "--seed", type=int, default=None,
         help="RNG seed for reproducible generator schedules",
     )
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu"],
+        help="pin the JAX backend for the device checkers (use cpu "
+        "when no healthy accelerator is attached; site configs can "
+        "override the JAX_PLATFORMS env var, this flag cannot be)",
+    )
 
 
 def test_opts_to_map(opts: argparse.Namespace) -> dict:
@@ -95,7 +101,7 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
         "nodes", "nodes_csv", "nodes_file", "concurrency", "time_limit",
         "test_count", "username", "password", "private_key_path",
         "ssh_port", "dummy_ssh", "leave_db_running", "store_dir", "seed",
-        "command", "test_dir",
+        "command", "test_dir", "platform",
     }
     extra = {
         k.replace("_", "-"): v
@@ -297,6 +303,13 @@ def run(parser: argparse.ArgumentParser, argv: Optional[Sequence[str]] = None) -
         opts = parser.parse_args(argv)
     except SystemExit as e:
         return EXIT_USAGE if e.code not in (0, None) else 0
+    if getattr(opts, "platform", None):
+        # Before any backend touch: a wedged/absent accelerator hangs
+        # the first device call, and site config can re-pin the
+        # JAX_PLATFORMS env var (jax.config wins over both).
+        import jax
+
+        jax.config.update("jax_platforms", opts.platform)
     try:
         return opts._run(opts)
     except Exception:  # noqa: BLE001

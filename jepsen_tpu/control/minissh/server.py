@@ -473,3 +473,49 @@ class _ChannelIO(scp_proto.ScpIO):
             self.tr.write_packet(
                 bytes([MSG_CHANNEL_DATA]) + u32(self.peer) + sstr(chunk)
             )
+
+
+def main(argv=None) -> int:
+    """Standalone node daemon: `python -m jepsen_tpu.control.minissh.
+    server --host 10.x.y.z --authorized-keys id_ed25519.pub`.  Run
+    inside a network namespace (ip netns exec), this turns a namespace
+    into a full SSH-reachable cluster node — the netns analogue of the
+    docker harness's sshd containers (tools/cluster)."""
+    import argparse
+    import base64
+    import time
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=2200)
+    ap.add_argument("--authorized-keys", required=True,
+                    help="OpenSSH .pub file; each ssh-ed25519 line is "
+                    "accepted for any user")
+    ap.add_argument("--hostname", default=None)
+    ap.add_argument("--root-dir", default=None)
+    args = ap.parse_args(argv)
+
+    blobs = []
+    with open(args.authorized_keys, "rb") as f:
+        for line in f:
+            parts = line.split()
+            if len(parts) >= 2 and parts[0] == b"ssh-ed25519":
+                blobs.append(base64.b64decode(parts[1]))
+    if not blobs:
+        ap.error(f"no ssh-ed25519 keys in {args.authorized_keys}")
+
+    srv = MiniSshServer(
+        args.host, args.port, authorized_keys=blobs,
+        hostname=args.hostname, root_dir=args.root_dir,
+    ).start()
+    print(f"listening {args.host}:{srv.port}", flush=True)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -175,6 +175,96 @@ class NetnsCluster:
         }
 
 
+class NetnsSshCluster:
+    """NetnsCluster + one minissh daemon per namespace: a full
+    SSH-reachable micro-cluster on one root machine — the netns
+    analogue of the reference's docker harness (docker/bin/up boots
+    sshd containers; here each namespace runs
+    `python -m jepsen_tpu.control.minissh.server` bound to its own
+    IP).  The SshCliRemote then drives REAL ssh/scp wire traffic over
+    the veth network (via the tools/sshbin shims when OpenSSH isn't
+    installed), and kernel-level faults (RouteNet) apply to the
+    control plane's own packets exactly as they would on a physical
+    cluster."""
+
+    def __init__(self, n_nodes: int = 3, port: int = 2200,
+                 tag: Optional[str] = None,
+                 work_dir: Optional[str] = None):
+        import tempfile
+
+        self.net = NetnsCluster(n_nodes, tag)
+        self.port = port
+        self.work_dir = work_dir or tempfile.mkdtemp(prefix="jt-sshns-")
+        self.procs: list = []
+        self.key_path: Optional[str] = None
+
+    def create(self) -> "NetnsSshCluster":
+        import sys
+
+        from .minissh.server import generate_keypair
+
+        self.net.create()
+        try:
+            self.key_path, _ = generate_keypair(self.work_dir)
+            for node in self.net.nodes:
+                addr = self.net.address_of(node)
+                root = os.path.join(self.work_dir, node)
+                os.makedirs(root, exist_ok=True)
+                # -c instead of -m: the package imports .server, and
+                # runpy would warn about re-executing a loaded module.
+                code = ("from jepsen_tpu.control.minissh.server "
+                        "import main; raise SystemExit(main())")
+                proc = subprocess.Popen(
+                    [_IP, "netns", "exec", self.net.netns_of(node),
+                     sys.executable, "-c", code,
+                     "--host", addr, "--port", str(self.port),
+                     "--authorized-keys", self.key_path + ".pub",
+                     "--hostname", node, "--root-dir", root],
+                    stdout=subprocess.PIPE,
+                )
+                # Register before the handshake check: a daemon that
+                # printed garbage is still alive and must be killed
+                # by the destroy() below.
+                self.procs.append(proc)
+                line = proc.stdout.readline()
+                if not line.startswith(b"listening"):
+                    raise RemoteError(
+                        f"minissh on {node} failed to start: {line!r}"
+                    )
+        except Exception:
+            self.destroy()
+            raise
+        return self
+
+    def destroy(self) -> None:
+        for p in self.procs:
+            try:
+                p.kill()
+                p.wait(timeout=5)  # reap: no zombie per node
+            except (OSError, subprocess.TimeoutExpired):
+                pass
+        self.procs.clear()
+        self.net.destroy()
+        # The work dir holds the generated private key — remove it.
+        shutil.rmtree(self.work_dir, ignore_errors=True)
+
+    def __enter__(self) -> "NetnsSshCluster":
+        return self.create()
+
+    def __exit__(self, *exc) -> None:
+        self.destroy()
+
+    @property
+    def ssh_nodes(self) -> list[str]:
+        """host:port node names for the test map — the host part is
+        the node's real in-cluster IP, so Net implementations need no
+        node-addresses aliases."""
+        return [
+            f"{self.net.address_of(n)}:{self.port}"
+            for n in self.net.nodes
+        ]
+
+
 class NetnsRemote(Remote):
     """``ip netns exec`` transport: the node name resolves to its
     namespace through the cluster; commands run on this host but with

@@ -555,7 +555,14 @@ def analyze(history: History | list[Op]) -> dict:
 
 class KafkaChecker(Checker):
     def check(self, test: dict, history: History, opts: dict) -> dict:
-        return analyze(history.client_ops())
+        res = analyze(history.client_ops())
+        # Conviction trail into the store dir: unseen/lag plots always,
+        # anomalies.json + version orders + cycle DOTs when invalid
+        # (tests/kafka.clj:99-180; VERDICT r3 #6).
+        from .kafka_viz import write_artifacts
+
+        write_artifacts(res, opts, history.client_ops())
+        return res
 
 
 # ---------------------------------------------------------------------------

@@ -194,6 +194,57 @@ def test_wr_unwritten_read():
     assert "unwritten-read" in res["anomaly-types"]
 
 
+def test_wr_sequential_keys_catches_stale_read_cycle():
+    """Declared per-key sequential writes (VERDICT r3 #7; the Elle
+    paper's assumptions table via wr.clj workload options): x=1 and
+    x=2 are written by txns that never observe each other's value, so
+    the base inference has no x version order and passes; with
+    sequential_keys the realtime order of the two writes yields
+    1 << 2, the stale read of x=1 after x=2 becomes an rw edge, and a
+    G-single/G2 cycle convicts."""
+    ops = history([
+        # p0 writes x=1, completes, THEN writes x=2: realtime 1 << 2.
+        Op(type="invoke", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="ok", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="invoke", f="txn", value=[["w", "x", 2]], process=0),
+        Op(type="ok", f="txn", value=[["w", "x", 2]], process=0),
+        # p1 observes x=2 and writes y=1.
+        Op(type="invoke", f="txn",
+           value=[["r", "x", None], ["w", "y", 1]], process=1),
+        Op(type="ok", f="txn",
+           value=[["r", "x", 2], ["w", "y", 1]], process=1),
+        # p2 observes y=1 and a STALE x=1.
+        Op(type="invoke", f="txn",
+           value=[["r", "y", None], ["r", "x", None]], process=2),
+        Op(type="ok", f="txn",
+           value=[["r", "y", 1], ["r", "x", 1]], process=2),
+    ])
+    base = analyze_wr(ops)
+    assert base["valid"] is True, base  # the cycle is invisible
+    strict = analyze_wr(ops, sequential_keys=True)
+    assert strict["valid"] is False, strict
+    assert any(tp in strict["anomaly-types"]
+               for tp in ("G-single", "G2", "G2-item")), strict
+
+
+def test_wr_sequential_keys_overlapping_writes_unordered():
+    """Writes whose intervals overlap get NO declared order — the
+    strengthening must not invent constraints concurrency never
+    promised."""
+    ops = history([
+        Op(type="invoke", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="invoke", f="txn", value=[["w", "x", 2]], process=1),
+        Op(type="ok", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="ok", f="txn", value=[["w", "x", 2]], process=1),
+        # Either final value is legal; reading the "older" write is
+        # fine because no order was ever promised between them.
+        Op(type="invoke", f="txn", value=[["r", "x", None]], process=2),
+        Op(type="ok", f="txn", value=[["r", "x", 1]], process=2),
+    ])
+    res = analyze_wr(ops, sequential_keys=True)
+    assert res["valid"] is True, res
+
+
 def test_wr_g1c_cycle():
     # a writes x=1 and reads y=1 (written by b); b writes y=1, reads x=1.
     res = analyze_wr(h(

@@ -1,40 +1,50 @@
-"""Real-cluster integration suite: SshCliRemote against live sshd nodes.
+"""Real-cluster integration suite: SshCliRemote against live SSH nodes
+with their own network identities.
 
-Needs the compose cluster from tools/cluster/up (or any reachable
-nodes).  Configure with env vars:
+Two ways to get a cluster, picked automatically:
 
-    JEPSEN_TPU_SSH_NODES  comma-separated host[:port] list
-    JEPSEN_TPU_SSH_KEY    private key path
-    JEPSEN_TPU_SSH_USER   default root
+1. **External** (the reference's docker harness shape, docker/bin/up +
+   control_test.clj ^:integration): set
 
-Tests auto-skip when the first node is unreachable, so the file is safe
-in the default CI run; select explicitly with `-m integration`.
+       JEPSEN_TPU_SSH_NODES  comma-separated host[:port] list
+       JEPSEN_TPU_SSH_KEY    private key path
+       JEPSEN_TPU_SSH_USER   default root
 
-This is the layer the reference exercises with its docker harness
-(docker/bin/up + control_test.clj ^:integration): real exec round-trips
-with exit codes and stdin, real file upload/download, real iptables
-partitions through the Net protocol, and the whole kvdb suite compiling
-and breaking a real C++ server over SSH.
+   against real sshd nodes (e.g. tools/cluster compose).  Partitions
+   use iptables and ping, as those images provide them.
+
+2. **Built-in netns micro-cluster** (no env vars needed): when the
+   environment can create network namespaces, the fixture boots
+   control/netns.NetnsSshCluster — one namespace per node, a real IP
+   on a veth bridge, a minissh SSH-2 daemon inside each — and the
+   tools/sshbin shims stand in for absent OpenSSH binaries.  The SAME
+   ssh/scp wire traffic, exec round-trips, uploads, kernel-level
+   partitions (RouteNet blackhole routes — this CI kernel ships no
+   iptables userspace), and the whole kvdb C++ suite then execute in
+   the default CI run, which is how rounds 1-3's five perpetual skips
+   finally became executed tests.
+
+Tests only skip when NEITHER path is available.
 """
 
 from __future__ import annotations
 
 import os
 import socket
-import subprocess
 
 import pytest
 
 from jepsen_tpu.control import (
     NonzeroExit,
     SshCliRemote,
+    on_nodes,
     with_sessions,
 )
 
 pytestmark = pytest.mark.integration
 
 
-def _nodes() -> list[str]:
+def _env_nodes() -> list[str]:
     raw = os.environ.get("JEPSEN_TPU_SSH_NODES", "")
     return [n.strip() for n in raw.split(",") if n.strip()]
 
@@ -50,27 +60,76 @@ def _reachable(node: str) -> bool:
         return False
 
 
-def ssh_test(**kw) -> dict:
-    nodes = _nodes()
-    if not nodes:
-        pytest.skip("JEPSEN_TPU_SSH_NODES not set (run tools/cluster/up)")
-    if not _reachable(nodes[0]):
-        pytest.skip(f"{nodes[0]} unreachable")
+@pytest.fixture(scope="module")
+def cluster():
+    """{nodes, ssh, kind} for whichever cluster flavor exists."""
+    nodes = _env_nodes()
+    if nodes:
+        if not _reachable(nodes[0]):
+            pytest.skip(f"{nodes[0]} unreachable")
+        yield {
+            "kind": "env",
+            "nodes": nodes,
+            "ssh": {
+                "username": os.environ.get("JEPSEN_TPU_SSH_USER",
+                                           "root"),
+                "private-key-path": os.environ.get("JEPSEN_TPU_SSH_KEY"),
+            },
+        }
+        return
+
+    from jepsen_tpu.control.netns import (
+        NetnsSshCluster,
+        netns_available,
+    )
+
+    if not netns_available():
+        pytest.skip(
+            "no JEPSEN_TPU_SSH_NODES and no netns capability"
+        )
+    import shutil
+    import time
+
+    # Shims only when no real OpenSSH client exists — with one
+    # installed, the suite exercises genuine OpenSSH-to-minissh
+    # interop instead of shadowing it.
+    old_path = os.environ["PATH"]
+    if shutil.which("ssh") is None:
+        shims = os.path.abspath(
+            os.path.join(os.path.dirname(__file__), "..", "tools",
+                         "sshbin")
+        )
+        os.environ["PATH"] = shims + os.pathsep + old_path
+    c = NetnsSshCluster(
+        3, tag="jts%05d" % (time.time_ns() % 90000)
+    )
+    try:
+        with c:
+            yield {
+                "kind": "netns",
+                "nodes": c.ssh_nodes,
+                "ssh": {"username": "root",
+                        "private-key-path": c.key_path,
+                        "no-sudo": True},
+                "_cluster": c,
+            }
+    finally:
+        os.environ["PATH"] = old_path
+
+
+def ssh_test(cluster, **kw) -> dict:
     t = {
-        "nodes": nodes,
+        "nodes": cluster["nodes"],
         "remote": SshCliRemote(),
-        "ssh": {
-            "username": os.environ.get("JEPSEN_TPU_SSH_USER", "root"),
-            "private-key-path": os.environ.get("JEPSEN_TPU_SSH_KEY"),
-        },
+        "ssh": dict(cluster["ssh"]),
         "concurrency": 4,
     }
     t.update(kw)
     return t
 
 
-def test_exec_roundtrip():
-    test = ssh_test()
+def test_exec_roundtrip(cluster):
+    test = ssh_test(cluster)
     with with_sessions(test) as t:
         sess = t["sessions"][test["nodes"][0]]
         assert sess.exec("echo", "hello") == "hello"
@@ -80,13 +139,12 @@ def test_exec_roundtrip():
         # stdin + shell metacharacters survive escaping.
         out = sess.exec("cat", stdin="a b;c'd\ne")
         assert out == "a b;c'd\ne"
-        # hostname matches the compose service names n1..n5 when run
-        # against the bundled cluster.
+        # node identity: n1..nN hostnames on both cluster flavors.
         assert sess.exec("hostname")
 
 
-def test_upload_download(tmp_path):
-    test = ssh_test()
+def test_upload_download(cluster, tmp_path):
+    test = ssh_test(cluster)
     src = tmp_path / "artifact.bin"
     src.write_bytes(b"\x00\x01jepsen-tpu\xff")
     back = tmp_path / "roundtrip.bin"
@@ -100,68 +158,67 @@ def test_upload_download(tmp_path):
     assert back.read_bytes() == src.read_bytes()
 
 
-def test_on_nodes_fanout():
-    from jepsen_tpu.control import on_nodes
-
-    test = ssh_test()
+def test_on_nodes_fanout(cluster):
+    test = ssh_test(cluster)
     with with_sessions(test):
         res = on_nodes(test, lambda s, n: s.exec("hostname"))
     assert set(res) == set(test["nodes"])
     assert len(set(res.values())) == len(test["nodes"])
 
 
-def test_iptables_partition_and_heal():
-    """Drops links between the first two nodes with real iptables, then
-    heals — the net.clj:177-233 path that round 1 never exercised.
-
-    Against the bundled compose cluster the node names are host:port
-    views from the control machine; test["node-addresses"] maps them to
-    the in-cluster service hostnames (n1..n5) that iptables rules need.
-    """
+def test_partition_and_heal(cluster):
+    """Cuts the link between the first two nodes with the kernel
+    (iptables on docker-style images, blackhole routes on the netns
+    cluster), verifies node 1 can no longer reach node 2's SSH port
+    while a third node still can, then heals — the net.clj:177-233
+    path, executing for real."""
     from jepsen_tpu import net as jnet
+    from jepsen_tpu.control.core import split_host_port
 
-    test = ssh_test()
-    if len(test["nodes"]) < 2:
-        pytest.skip("needs >= 2 nodes")
-    n1, n2 = test["nodes"][0], test["nodes"][1]
-    net = jnet.iptables
+    test = ssh_test(cluster)
+    if len(test["nodes"]) < 3:
+        pytest.skip("needs >= 3 nodes")
+    n1, n2, n3 = test["nodes"][:3]
+    net = jnet.iptables if cluster["kind"] == "env" else jnet.route
+
+    host2, port2 = split_host_port(n2, 22)
+
+    def can_reach(t, frm) -> bool:
+        # TCP connect probe from inside `frm` toward n2's SSH port —
+        # works on any image (ping may not be installed).
+        res = t["sessions"][frm].exec_star(
+            "timeout", "2", "bash", "-c",
+            f"exec 3<>/dev/tcp/{host2}/{port2}",
+        )
+        return res.get("exit") == 0
+
     with with_sessions(test) as t:
-        sess1 = t["sessions"][n1]
-        if ":" in n1:
-            # host:port node names are the control machine's view; ask
-            # each node its own in-cluster hostname rather than
-            # assuming list order matches service numbering.
+        if cluster["kind"] == "env" and ":" in n1:
             test["node-addresses"] = {
                 node: t["sessions"][node].exec("hostname")
                 for node in test["nodes"]
             }
-        addr2 = jnet.node_address(test, n2)
+            host2 = test["node-addresses"][n2]
+            port2 = 22
+        assert can_reach(t, n1)
         try:
-            ping = ["ping", "-c", "1", "-W", "2", addr2]
-            assert sess1.exec_star(*ping).get("exit") == 0
-            net.drop(test, n2, n1)  # cut n2 -> n1... and reverse:
-            net.drop(test, n1, n2)
-            # n1 can still *send* pings, but n2's replies are dropped
-            # on n1's INPUT chain (and vice versa): no round trips.
-            assert sess1.exec_star(*ping).get("exit") != 0
+            # Symmetric cut between n1 and n2 only.
+            net.drop_all(test, {n1: [n2], n2: [n1]})
+            assert not can_reach(t, n1)
+            assert can_reach(t, n3)  # partition, not an outage
         finally:
             net.heal(test)
-        assert sess1.exec_star(*ping).get("exit") == 0
+        assert can_reach(t, n1)
 
 
-def test_kvdb_suite_over_ssh(tmp_path):
+def test_kvdb_suite_over_ssh(cluster, tmp_path):
     """Whole framework against real nodes: compiles the C++ kvdb server
     on the node over SSH, daemonizes it, kills it, checks the history.
     The reference's docker-harness kvdb-style smoke."""
-    from jepsen_tpu.suites import kvdb as kvdb_suite
     from jepsen_tpu import core
+    from jepsen_tpu.suites import kvdb as kvdb_suite
 
-    nodes = _nodes()
-    if not nodes:
-        pytest.skip("JEPSEN_TPU_SSH_NODES not set")
-    if not _reachable(nodes[0]):
-        pytest.skip(f"{nodes[0]} unreachable")
-
+    nodes = cluster["nodes"][:1]
     opts = {
         "workload": "register",
         "faults": ["kill"],
@@ -169,19 +226,16 @@ def test_kvdb_suite_over_ssh(tmp_path):
         "rate": 50.0,
         "interval": 2.0,
         "store-dir": str(tmp_path / "store"),
-        "nodes": nodes[:1],
+        "nodes": nodes,
         "concurrency": 4,
     }
     test = kvdb_suite.kvdb_test(opts)
-    test["nodes"] = nodes[:1]
+    test["nodes"] = nodes
     test["remote"] = SshCliRemote()
-    test["ssh"] = {
-        "username": os.environ.get("JEPSEN_TPU_SSH_USER", "root"),
-        "private-key-path": os.environ.get("JEPSEN_TPU_SSH_KEY"),
-    }
+    test["ssh"] = dict(cluster["ssh"])
     test["store-dir"] = str(tmp_path / "store")
-    # Real-cluster topology: one fixed port, published by the compose
-    # file for n1; clients dial the node's host part directly.
+    # Real-cluster topology: one fixed port; clients dial the node's
+    # host part directly (the netns node name's host part is its IP).
     test["kvdb-local"] = False
     test["kvdb-port"] = 7000
     done = core.run(test)

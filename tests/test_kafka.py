@@ -163,10 +163,61 @@ def test_wr_ww_cycle_detected():
     assert "G1c" in res["anomaly-types"]
 
 
+# -- artifacts (VERDICT r3 #6: tests/kafka.clj:99-180 parity) -----------
+
+
+def test_artifacts_written_for_invalid(tmp_path):
+    """An invalid analysis leaves the full conviction trail: unseen
+    series + plots always, anomalies.json + version orders + cycle
+    DOTs when invalid."""
+    from jepsen_tpu.workloads.kafka_viz import write_artifacts
+
+    h = lit(
+        # offset divergence on x at offset 1 (b vs c)
+        ok("send", [sent("x", 0, "a"), sent("x", 1, "b")]),
+        ok("poll", [polled({"x": [[0, "a"], [1, "c"]]})], process=1),
+        # G1c cycle: ww T3->T4 on w, wr T4->T3 on y
+        ok("txn", [sent("w", 0, "a"),
+                   polled({"y": [[0, "p"]]})], process=2),
+        ok("txn", [sent("w", 1, "b"), sent("y", 0, "p")], process=3),
+        # an unseen tail
+        ok("send", [sent("z", 0, "tail")], process=4),
+    )
+    res = kafka.analyze(h)
+    assert res["valid"] is False
+    write_artifacts(res, {"dir": str(tmp_path)}, list(h))
+    out = tmp_path / "kafka"
+    for name in ("unseen.json", "unseen.svg", "realtime-lag.svg",
+                 "anomalies.json", "version-orders.json"):
+        assert (out / name).exists(), name
+    assert list(out.glob("cycle-*.dot")), "no cycle DOT written"
+    import json as _json
+
+    vo = _json.loads((out / "version-orders.json").read_text())
+    assert "'x'" in vo or "x" in vo  # the divergent key's order
+    unseen = _json.loads((out / "unseen.json").read_text())
+    assert unseen["series"], "unseen time series empty"
+
+
+def test_artifacts_valid_run_writes_plots_only(tmp_path):
+    from jepsen_tpu.workloads.kafka_viz import write_artifacts
+
+    h = lit(
+        ok("send", [sent("x", 0, "a")]),
+        ok("poll", [polled({"x": [[0, "a"]]})], process=1),
+    )
+    res = kafka.analyze(h)
+    assert res["valid"] is True
+    write_artifacts(res, {"dir": str(tmp_path)}, list(h))
+    out = tmp_path / "kafka"
+    assert (out / "unseen.svg").exists()
+    assert not (out / "anomalies.json").exists()
+
+
 # -- whole stack against the in-memory log ------------------------------
 
 
-def run_workload(faults=None, n_ops=400):
+def run_workload(faults=None, n_ops=400, store_dir=None):
     from jepsen_tpu import core
     from jepsen_tpu.generator.core import limit, nemesis as on_nemesis
 
@@ -183,6 +234,8 @@ def run_workload(faults=None, n_ops=400):
         "sub-via": wl["sub-via"],
         "name": "kafka-test",
     }
+    if store_dir is not None:
+        test["store-dir"] = str(store_dir)
     result = core.run(test)
     return result["results"]
 
@@ -192,12 +245,16 @@ def test_clean_run_is_valid():
     assert res["valid"] is True, res.get("anomaly-types")
 
 
-def test_lose_acked_writes_detected():
-    res = run_workload(faults={"lose-acked"})
+def test_lose_acked_writes_detected(tmp_path):
+    res = run_workload(faults={"lose-acked"}, store_dir=tmp_path)
     assert res["valid"] is not True
     assert ("lost-write" in res["anomaly-types"]
             or "unseen" in (res.get("unseen") or res["anomaly-types"])
             or res["unseen"])
+    # The whole-stack run left a browsable conviction trail in the
+    # store dir through KafkaChecker (VERDICT r3 #6 'done' bar).
+    trails = list(tmp_path.rglob("kafka/unseen.svg"))
+    assert trails, f"no kafka artifacts under {tmp_path}"
 
 
 def test_duplicate_fault_detected():

@@ -1,0 +1,79 @@
+"""doc/tutorial.md runs verbatim (VERDICT r3 #8).
+
+The tutorial's promise is "every command and code block below runs
+verbatim in CI".  This test keeps that promise mechanically: it parses
+the fenced blocks out of the markdown and executes them, in document
+order, in one scratch directory —
+
+  ```python tutorial-ci-file <name>   -> written to <name> (the doc
+                                         tells the reader to save it)
+  ```bash tutorial-ci                 -> run with bash -e
+
+so a drifted import, CLI flag, artifact path, or exit-code claim in
+the doc fails CI instead of failing the next new user.
+"""
+
+import os
+import re
+import subprocess
+import sys
+
+import pytest
+
+DOC = os.path.join(os.path.dirname(__file__), "..", "doc",
+                   "tutorial.md")
+
+FENCE = re.compile(
+    r"^```(\w+) (tutorial-ci(?:-file)?)(?: (\S+))?\n(.*?)^```",
+    re.M | re.S,
+)
+
+
+def blocks():
+    with open(DOC) as f:
+        text = f.read()
+    out = []
+    for m in FENCE.finditer(text):
+        lang, kind, arg, body = m.groups()
+        out.append((lang, kind, arg, body))
+    return out
+
+
+def test_tutorial_has_executable_blocks():
+    kinds = [b[1] for b in blocks()]
+    assert kinds.count("tutorial-ci-file") >= 1
+    assert kinds.count("tutorial-ci") >= 5
+
+
+@pytest.mark.slow
+def test_tutorial_runs_verbatim(tmp_path):
+    env = dict(os.environ)
+    # The tutorial's suite commands pin --platform cpu themselves; the
+    # first_test.py block uses the pure-CPU checker.  Nothing here may
+    # touch a (possibly wedged) accelerator: fail fast if it tries.
+    repo = os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+    for lang, kind, arg, body in blocks():
+        if kind == "tutorial-ci-file":
+            (tmp_path / arg).write_text(body)
+            continue
+        assert lang == "bash", f"unsupported block {lang} {kind}"
+        proc = subprocess.run(
+            ["bash", "-e", "-c", body],
+            cwd=tmp_path, env=env, capture_output=True, text=True,
+            timeout=420,
+        )
+        assert proc.returncode == 0, (
+            f"tutorial block failed:\n{body}\n--- stdout\n"
+            f"{proc.stdout[-2000:]}\n--- stderr\n{proc.stderr[-2000:]}"
+        )
+
+    # The doc's central claims, re-asserted from the artifacts the
+    # blocks left behind:
+    assert (tmp_path / "store" / "tutorial-register").exists()
+    trail = tmp_path / "logd-store" / "logd-kafka" / "latest" / "kafka"
+    assert (trail / "anomalies.json").exists(), (
+        "the unsafe logd run did not leave a conviction trail"
+    )
+    assert (trail / "unseen.svg").exists()
