@@ -1,0 +1,314 @@
+"""txnd suite: the framework against a real TRANSACTIONAL system.
+
+The fourth demo system (after kvdb: durability, repkv: replication,
+logd: logs) and the one that aims the elle-equivalent transactional
+checkers at a real server — the reference project's headline use of
+elle against tidb/cockroachdb/yugabyte (SURVEY.md §2.5), in the
+canonical zookeeper.clj suite shape.
+
+The physics under test: txnd (demo/txnd/txnd.cpp) implements textbook
+snapshot isolation — MVCC versions, snapshot reads, first-committer-
+wins on write-write conflicts.  SI admits *write skew* (Adya's G2):
+two transactions read overlapping keys, write disjoint ones, and both
+commit even though no serial order explains them.  No nemesis is
+needed; the anomaly is the isolation level itself, surfaced by plain
+concurrency.  The rw-register workload (checker/elle/wr.py) with
+`sequential_keys=True` convicts it — per-key write order IS realtime
+order under first-committer-wins, so the declared-semantics inference
+is sound here.  `--serializable` makes txnd validate read sets too
+(backward OCC), closing the window: the control group passes under
+the identical workload.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import socket
+from typing import Any, Optional
+
+from .. import cli as jcli
+from .. import client as jc
+from .. import db as jdb
+from ..checker import core as chk
+from ..checker.elle import WrChecker
+from ..checker.elle.wr import WrGen
+from ..checker.timeline import Timeline
+from ..control import Session
+from ..control import util as cutil
+from ..generator.core import FnGen, clients, stagger, time_limit
+from ..generator import nemesis as gen_nemesis
+from ..history import FAIL, INFO, OK, Op
+from ..nemesis.combined import nemesis_package
+
+TXND_SRC = os.path.join(
+    os.path.dirname(__file__), "..", "..", "demo", "txnd", "txnd.cpp"
+)
+
+BASE_PORT = 7550
+
+
+def node_port(test: dict, node: str) -> int:
+    nodes = test.get("nodes") or []
+    if test.get("txnd-local", True):
+        return test.get("txnd-base-port", BASE_PORT) + 1 + nodes.index(node)
+    return test.get("txnd-port", BASE_PORT)
+
+
+def node_dir(test: dict, node: str) -> str:
+    root = test.get("txnd-dir", "/tmp/jepsen-txnd")
+    return f"{root}/{node}"
+
+
+class TxndDB(jdb.DB):
+    """Compile-from-source lifecycle (zookeeper.clj:40-73 shape)."""
+
+    def _paths(self, test: dict, node: str) -> dict:
+        d = node_dir(test, node)
+        return {
+            "dir": d,
+            "src": f"{d}/txnd.cpp",
+            "bin": f"{d}/txnd",
+            "pid": f"{d}/txnd.pid",
+            "log": f"{d}/txnd.log",
+        }
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec("mkdir", "-p", p["dir"])
+        sess.upload(os.path.abspath(TXND_SRC), p["src"])
+        sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        self.start(test, sess, node)
+        cutil.await_tcp_port(
+            sess, node_port(test, node), timeout_s=30, interval_s=0.1
+        )
+
+    def start(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        args = ["--port", str(node_port(test, node)),
+                "--think-us", str(test.get("txnd-think-us", 2000))]
+        if not test.get("txnd-local", True):
+            args += ["--listen", "0.0.0.0"]
+        if test.get("txnd-serializable"):
+            args.append("--serializable")
+        cutil.start_daemon(
+            sess, p["bin"], *args, pidfile=p["pid"], logfile=p["log"]
+        )
+        try:
+            cutil.await_tcp_port(
+                sess, node_port(test, node), timeout_s=10,
+                interval_s=0.05,
+            )
+        except Exception:  # noqa: BLE001 — best-effort, like kvdb
+            pass
+
+    def kill(self, test: dict, sess: Session, node: str) -> None:
+        cutil.stop_daemon(sess, self._paths(test, node)["pid"],
+                          signal="KILL")
+
+    def pause(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec_star("bash", "-c", f"kill -STOP $(cat {p['pid']})")
+
+    def resume(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec_star("bash", "-c", f"kill -CONT $(cat {p['pid']})")
+
+    def teardown(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        cutil.stop_daemon(sess, p["pid"])
+        if not test.get("leave-db-running"):
+            sess.exec("rm", "-rf", p["dir"])
+
+    def log_files(self, test: dict, sess: Session, node: str):
+        return [self._paths(test, node)["log"]]
+
+
+class TxndClient(jc.Client):
+    """One-shot transactions over the line protocol.  op.value is the
+    elle micro-op list [["r", k, None]|["w", k, v], ...]; reads come
+    back filled in protocol order."""
+
+    def __init__(self):
+        self.sock: Optional[socket.socket] = None
+        self.f: Optional[Any] = None
+
+    def open(self, test: dict, node: Any) -> "TxndClient":
+        c = TxndClient()
+        if test.get("txnd-local", True):
+            host = "127.0.0.1"
+        else:
+            from ..control.core import split_host_port
+
+            host, _ = split_host_port(node)
+        c.sock = socket.create_connection(
+            (host, node_port(test, node)), timeout=5.0
+        )
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        c.f = c.sock.makefile("rw", encoding="utf-8", newline="\n")
+        return c
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        parts = ["TXN"]
+        for mop in op.value or []:
+            if mop[0] == "r":
+                parts += ["r", f"k{mop[1]}"]
+            else:
+                parts += ["w", f"k{mop[1]}", str(mop[2])]
+        try:
+            self.f.write(" ".join(parts) + "\n")
+            self.f.flush()
+            resp = self.f.readline()
+        except (socket.timeout, TimeoutError, OSError) as e:
+            return op.complete(INFO, error=f"io: {e}")
+        if not resp:
+            return op.complete(INFO, error="connection closed")
+        resp = resp.strip()
+        if resp == "ABORT":
+            # First-committer-wins rejected the txn before applying
+            # anything: definitely did not happen.
+            return op.complete(FAIL)
+        if not resp.startswith("OK"):
+            return op.complete(INFO, error=resp)
+        reads = resp.split()[1:]
+        filled = []
+        i = 0
+        for mop in op.value or []:
+            if mop[0] == "r":
+                raw = reads[i] if i < len(reads) else "NIL"
+                i += 1
+                filled.append(
+                    ["r", mop[1], None if raw == "NIL" else int(raw)]
+                )
+            else:
+                filled.append(mop)
+        return op.complete(OK, value=filled)
+
+    def close(self, test: dict) -> None:
+        try:
+            if self.sock is not None:
+                self.sock.close()
+        except OSError:
+            pass
+
+
+def txnd_test(opts: dict) -> dict:
+    """Test-map assembly (zookeeper.clj:112-137 shape)."""
+    nodes = (opts.get("nodes") or ["n1"])[:1]  # single-node system
+    faults = set(
+        opts["faults"] if opts.get("faults") is not None else []
+    )
+    gen_txns = FnGen(WrGen(
+        key_count=opts.get("key-count", 4),
+        min_txn_length=2,
+        max_txn_length=opts.get("max-txn-length", 4),
+        rng=random.Random(opts.get("seed")),
+    ))
+    workload_gen = stagger(1.0 / opts.get("rate", 150), gen_txns)
+    if faults:
+        pkg = nemesis_package({
+            "faults": faults,
+            "interval": opts.get("interval", 3.0),
+        })
+        # Routes the fault schedule to the nemesis process and the
+        # workload to client processes only.
+        generator = time_limit(
+            opts.get("time-limit", 10.0),
+            gen_nemesis(pkg["generator"], workload_gen),
+        )
+        if pkg.get("final-generator"):
+            # Heal whatever the last interval broke (resume a paused
+            # server) before the run ends — the sibling-suite pattern.
+            from ..generator.core import phases
+
+            generator = phases(
+                generator, gen_nemesis(pkg["final-generator"])
+            )
+        nemesis = pkg["nemesis"]
+    else:
+        from ..nemesis.core import NoopNemesis
+
+        # clients(): without it a bare generator also feeds the
+        # nemesis process, which silently info-completes txns.
+        generator = time_limit(
+            opts.get("time-limit", 10.0), clients(workload_gen)
+        )
+        nemesis = NoopNemesis()
+
+    store_root = os.path.abspath(opts.get("store-dir") or "store")
+    return {
+        "name": "txnd-wr",
+        "nodes": nodes,
+        "db": TxndDB(),
+        "client": TxndClient(),
+        "nemesis": nemesis,
+        "generator": generator,
+        "checker": chk.compose({
+            "elle-wr": WrChecker(
+                consistency_model=opts.get("consistency-model",
+                                           "serializable"),
+                sequential_keys=True,
+            ),
+            "timeline": Timeline(),
+            "stats": chk.Stats(),
+        }),
+        "txnd-serializable": bool(opts.get("serializable")),
+        "txnd-think-us": opts.get("think-us", 2000),
+        "txnd-dir": opts.get("txnd-dir") or os.path.join(
+            store_root, "txnd-data"
+        ),
+        "txnd-base-port": cutil.hashed_base_port(store_root, BASE_PORT),
+    }
+
+
+def _extra_opts(p) -> None:
+    # NB: no "kill" — txnd keeps all state in memory (no WAL), so a
+    # SIGKILL wipes acked transactions and would convict even the
+    # serializable control group for a reason that has nothing to do
+    # with isolation.  Durability bugs are kvdb/logd's department.
+    p.add_argument("--faults", action="append", default=None,
+                   choices=["pause"])
+    p.add_argument("--rate", type=float, default=150.0)
+    p.add_argument("--interval", type=float, default=3.0)
+    p.add_argument("--key-count", type=int, default=4)
+    p.add_argument("--max-txn-length", type=int, default=4)
+    p.add_argument("--think-us", type=int, default=2000)
+    p.add_argument("--serializable", action="store_true",
+                   help="validate read sets at commit (the control "
+                   "group: closes the write-skew window)")
+    p.add_argument("--consistency-model", default="serializable",
+                   choices=["serializable", "repeatable-read",
+                            "read-committed", "read-uncommitted"])
+
+
+def main(argv=None) -> int:
+    def _localize(t: dict) -> dict:
+        from ..control import LocalRemote
+
+        t.setdefault("remote", LocalRemote())
+        return t
+
+    def suite(opt_map: dict) -> dict:
+        return _localize(txnd_test(opt_map))
+
+    def all_suites(opt_map: dict):
+        """test-all: the SI conviction run and its serializable
+        control group (cli.clj:501-529 pattern)."""
+        for serializable in (False, True):
+            o = dict(opt_map, serializable=serializable)
+            t = _localize(txnd_test(o))
+            t["name"] = ("txnd-wr-serializable" if serializable
+                         else "txnd-wr-si")
+            yield t
+
+    parser = jcli.single_test_cmd(
+        suite, name="txnd", extra_opts=_extra_opts,
+        tests_fn=all_suites,
+    )
+    return jcli.run(parser, argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
