@@ -38,8 +38,22 @@ FORBIDDEN = {
     "read-committed": {"G0", "G1c"},
     "repeatable-read": {"G0", "G1c"},
     "serializable": {"G0", "G1c", "G-single", "G2-item"},
+    # The stronger models forbid the same Adya classes; their extra
+    # power comes from the additional EDGES woven into the graph
+    # (realtime order for strict-*, per-process session order for
+    # strong-session-*), which create cycles the weaker graphs don't
+    # have.  A ww+realtime cycle still classifies G0, as in Elle's
+    # "-realtime" variants collapsing to the same forbidden class.
     "strict-serializable": {"G0", "G1c", "G-single", "G2-item"},
+    "strong-session-serializable": {"G0", "G1c", "G-single",
+                                    "G2-item"},
 }
+
+#: Models that weave extra edge sources into the dependency graph.
+#: (Realtime subsumes session order — a jepsen process completes each
+#: op before invoking the next — so strict-* needs no process edges.)
+REALTIME_MODELS = {"strict-serializable"}
+SESSION_MODELS = {"strong-session-serializable"}
 
 #: Non-cycle anomalies forbidden from read-committed up.
 DIRTY = {"G1a", "G1b", "dirty-update"}
@@ -206,8 +220,10 @@ def analyze(
                 if nxt is not None and nxt != op.index:
                     g.add_edge(op.index, nxt, "rw")
 
-    if consistency_model == "strict-serializable":
+    if consistency_model in REALTIME_MODELS:
         _add_realtime_edges(history, g)
+    if consistency_model in SESSION_MODELS:
+        _add_process_edges(history, g)
 
     cycles = (cycle_fn or check_cycles)(g)
     for c in cycles:
@@ -273,6 +289,20 @@ def _add_realtime_edges(history: History, g: DepGraph) -> None:
             # Entries below the max-inv bar are done forever.
             done = survivors + done[cut:]
         bisect.insort(done, (comp_idx, inv_idx, op_idx))
+
+
+def _add_process_edges(history: History, g: DepGraph) -> None:
+    """A -> B when B is the next committed txn of A's process (session
+    order; Elle's process graph for the strong-session-* models).
+    Consecutive pairs only — session order is total per process, so
+    the chain is its own transitive reduction."""
+    last_by_process: dict = {}
+    for o in history:
+        if o.is_ok and o.f in ("txn", None):
+            prev = last_by_process.get(o.process)
+            if prev is not None and prev != o.index:
+                g.add_edge(prev, o.index, "process")
+            last_by_process[o.process] = o.index
 
 
 # ---------------------------------------------------------------------------

@@ -40,7 +40,14 @@ from typing import Any, Optional
 
 from ...history.core import History, Op
 from .graph import DepGraph, check_cycles
-from .append import FORBIDDEN, DIRTY
+from .append import (
+    DIRTY,
+    FORBIDDEN,
+    REALTIME_MODELS,
+    SESSION_MODELS,
+    _add_process_edges,
+    _add_realtime_edges,
+)
 
 
 def analyze(
@@ -166,6 +173,18 @@ def analyze(
                 elif w != op.index:
                     g.add_edge(w, op.index, "wr")
 
+    # Initial-state rule (module doc: None precedes every written
+    # value): readers of the unwritten initial state anti-depend on
+    # every writer of that key.  Without this a stale read of the
+    # initial state could never join a cycle — e.g. a committed write
+    # followed in realtime by a read of None passed strict-
+    # serializable before round 4.
+    written_by_key: dict[Any, set] = defaultdict(set)
+    for (k, v), _w in writer.items():
+        written_by_key[k].add(v)
+    for k, vs in written_by_key.items():
+        succ[k][None] |= vs
+
     # ww and rw edges along inferred successor pairs.
     for k, pairs in succ.items():
         for v, nexts in pairs.items():
@@ -179,6 +198,13 @@ def analyze(
                 for rd in ext_reader.get((k, v), []):
                     if rd != wv2:
                         g.add_edge(rd, wv2, "rw")
+
+    if consistency_model in REALTIME_MODELS:
+        # Realtime order edges (strict serializability) — the same
+        # reduced sweep the list-append analyzer uses.
+        _add_realtime_edges(history, g)
+    if consistency_model in SESSION_MODELS:
+        _add_process_edges(history, g)
 
     cycles = (cycle_fn or check_cycles)(g)
     for c in cycles:

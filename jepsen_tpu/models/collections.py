@@ -197,9 +197,7 @@ def _queue_packed(initial, capacity: int, *, fifo: bool):
     def jax_step_rows(states, f, a0, a1):
         # Scatter-free lane-major FIFO step for the Pallas sweep
         # (states is (C, B), left-aligned): the enqueue slot is picked
-        # by a row-iota mask, dequeue is a static one-row shift.  The
-        # unordered variant needs a per-lane sort, which Mosaic has no
-        # cheap form for — it stays on the XLA-scan sweep.
+        # by a row-iota mask, dequeue is a static one-row shift.
         import jax
         import jax.numpy as jnp
 
@@ -220,6 +218,36 @@ def _queue_packed(initial, capacity: int, *, fifo: bool):
         new = jnp.where(
             is_enq, enq,
             jnp.where((head_ok != 0)[None, :], deq, states),
+        )
+        return new, legal
+
+    def jax_step_rows_unordered(states, f, a0, a1):
+        # Sort-free lane-major multiset step: enqueue fills the first
+        # zero row, dequeue clears the first row matching a0 — both
+        # picked with a cumulative-count mask instead of argmin/argmax
+        # gathers.  The resulting state is NOT kept sorted; that is
+        # sound because enqueue/dequeue legality is order-independent
+        # and canonical (sorted) form is only needed for the heavy
+        # rounds' state dedup — whose inputs are jax_step outputs,
+        # which re-sort unconditionally.  Unsorted states therefore
+        # only pass through the sweep, never reach a dedup compare.
+        import jax.numpy as jnp
+
+        is_enq = f == F_ENQ
+        zero_i = (states == 0).astype(jnp.int32)
+        first_zero = (jnp.cumsum(zero_i, axis=0) == 1) & (states == 0)
+        has_room = zero_i.max(axis=0)                     # (B,) 0/1
+        enq = jnp.where(first_zero, a0, states)
+        match_i = (states == a0).astype(jnp.int32)
+        first_match = (jnp.cumsum(match_i, axis=0) == 1) & (
+            states == a0
+        )
+        present = match_i.max(axis=0)                     # (B,) 0/1
+        deq = jnp.where(first_match, 0, states)
+        legal = jnp.where(is_enq, has_room, present)
+        new = jnp.where(
+            is_enq, enq,
+            jnp.where((present != 0)[None, :], deq, states),
         )
         return new, legal
 
@@ -259,7 +287,8 @@ def _queue_packed(initial, capacity: int, *, fifo: bool):
         interner=interner,
         describe_op=describe_op,
         validate_packed=validate_packed,
-        jax_step_rows=jax_step_rows if fifo else None,
+        jax_step_rows=(jax_step_rows if fifo
+                       else jax_step_rows_unordered),
     )
 
 

@@ -194,6 +194,65 @@ def test_wr_unwritten_read():
     assert "unwritten-read" in res["anomaly-types"]
 
 
+def test_wr_strict_serializable_realtime_stale_initial_read():
+    """A committed write followed in realtime by a read of the initial
+    state: legal under serializable (the read can linearize first),
+    a G-single realtime cycle under strict-serializable — requires
+    both the realtime edges and the initial-state rule (None precedes
+    every written value) materializing rw edges."""
+    ops = history([
+        Op(type="invoke", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="ok", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="invoke", f="txn", value=[["r", "x", None]], process=1),
+        Op(type="ok", f="txn", value=[["r", "x", None]], process=1),
+    ])
+    assert analyze_wr(ops)["valid"] is True
+    res = analyze_wr(ops, consistency_model="strict-serializable")
+    assert res["valid"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_wr_strong_session_read_your_writes():
+    """One process writes, then reads the initial state — fine for
+    plain serializable, a session-order violation for
+    strong-session-serializable (process edges)."""
+    ops = history([
+        Op(type="invoke", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="ok", f="txn", value=[["w", "x", 1]], process=0),
+        Op(type="invoke", f="txn", value=[["r", "x", None]], process=0),
+        Op(type="ok", f="txn", value=[["r", "x", None]], process=0),
+    ])
+    assert analyze_wr(ops)["valid"] is True
+    res = analyze_wr(
+        ops, consistency_model="strong-session-serializable"
+    )
+    assert res["valid"] is False
+    assert "G-single" in res["anomaly-types"]
+
+
+def test_append_strong_session_lost_own_append():
+    """A process appends, another process observes [1] (so the
+    version order is known), then the first process reads [] — its
+    own append is missing from its session.  Valid under serializable
+    (the empty read can linearize first), convicted under
+    strong-session (process edge + rw)."""
+    ops = history([
+        Op(type="invoke", f="txn", value=[["append", "x", 1]],
+           process=0),
+        Op(type="ok", f="txn", value=[["append", "x", 1]], process=0),
+        Op(type="invoke", f="txn", value=[["r", "x", None]], process=1),
+        Op(type="ok", f="txn", value=[["r", "x", [1]]], process=1),
+        Op(type="invoke", f="txn", value=[["r", "x", None]], process=0),
+        Op(type="ok", f="txn", value=[["r", "x", []]], process=0),
+    ])
+    assert analyze_append(ops)["valid"] is True
+    res = analyze_append(
+        ops, consistency_model="strong-session-serializable"
+    )
+    assert res["valid"] is False, res
+    assert "G-single" in res["anomaly-types"]
+
+
 def test_wr_sequential_keys_catches_stale_read_cycle():
     """Declared per-key sequential writes (VERDICT r3 #7; the Elle
     paper's assumptions table via wr.clj workload options): x=1 and

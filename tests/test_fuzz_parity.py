@@ -1,0 +1,298 @@
+"""Randomized differential soak: device checker vs exact CPU checker.
+
+The hand-picked parity cases in test_wgl_device.py cover known shapes;
+this soak covers the input *distribution*: seeded random concurrent
+histories across four model families — sizes that exercise the
+witness tier (candidate compaction, window rolls), the refutation
+screens, and the exact settling tiers — each decided by BOTH
+`check_wgl_device` (witness -> screens -> frontier BFS) and the
+memoized CPU DFS (`check_wgl_cpu`), which must agree exactly.  Any
+disagreement is a soundness bug in one of the engines; historically
+this class of test is what catches a masked-lane or gather-index slip
+in a kernel change (e.g. round 4's compaction) that the curated cases
+happen to miss.
+
+Histories are linearizable by construction (effects apply atomically
+at completion, inside the op's interval) unless corruption flips an
+observed value — corrupt runs may still be valid (the flip can be
+explainable), which is exactly why both engines decide and compare.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from jepsen_tpu.checker.wgl_cpu import check_wgl_cpu
+from jepsen_tpu.history import pack_history
+from jepsen_tpu.history.core import Op, history
+from jepsen_tpu.models import (
+    cas_register,
+    fifo_queue,
+    multi_register,
+    mutex,
+    unordered_queue,
+)
+from jepsen_tpu.ops.wgl import check_wgl_device
+from jepsen_tpu.utils.histgen import random_register_history
+
+
+def _interleave(rng, n_ops, procs, plan_op, apply_op, info_rate=0.0,
+                corrupt_rate=0.0, corrupt_fn=None):
+    """Generic linearizable-by-construction interleaver: each process
+    invokes, then later completes; the op's effect applies atomically
+    at completion.  plan_op(rng, state) -> (f, value) or None (no op
+    currently legal for this process); apply_op(state, f, value) ->
+    (ok, completion_value).  corrupt_fn(rng, f, value) perturbs an
+    observed completion value."""
+    state: dict = {"_": None}
+    ops: list[Op] = []
+    pending: dict[int, tuple] = {}
+    started = 0
+    while started < n_ops or pending:
+        p = rng.randrange(procs)
+        if p in pending:
+            f, value = pending.pop(p)
+            if info_rate and rng.random() < info_rate:
+                # Indeterminate: effect maybe happened.
+                if rng.random() < 0.5:
+                    apply_op(state, f, value)
+                ops.append(Op(type="info", f=f, value=value, process=p))
+                continue
+            ok, out = apply_op(state, f, value)
+            if ok and corrupt_fn and rng.random() < corrupt_rate:
+                out = corrupt_fn(rng, f, out)
+            ops.append(Op(
+                type="ok" if ok else "fail", f=f,
+                value=out, process=p,
+            ))
+        elif started < n_ops:
+            planned = plan_op(rng, state, p)
+            if planned is None:
+                continue
+            f, value = planned
+            ops.append(Op(type="invoke", f=f, value=value, process=p))
+            pending[p] = (f, value)
+            started += 1
+    return history(ops)
+
+
+# -- per-family generators ----------------------------------------------
+
+
+def mutex_history(rng, n_ops, procs, corrupt=False):
+    """Processes contend for one lock; a process invokes acquire when
+    it doesn't hold it and release when it does.  Corruption flips
+    exactly ONE early failed acquire to ok — a double-hold, early so
+    the exact oracle contradicts on a short prefix."""
+    holding: set = set()
+    armed = [corrupt]
+    completions = [0]
+
+    ops: list[Op] = []
+    pending: dict[int, str] = {}
+    started = 0
+    while started < n_ops or pending:
+        p = rng.randrange(procs)
+        if p in pending:
+            f = pending.pop(p)
+            completions[0] += 1
+            if f == "acquire":
+                if not holding:
+                    holding.add(p)
+                    ops.append(Op(type="ok", f=f, value=None, process=p))
+                elif armed[0] and completions[0] > max(4, n_ops // 20):
+                    # corrupt: claim the held lock anyway (once)
+                    armed[0] = False
+                    ops.append(Op(type="ok", f=f, value=None, process=p))
+                else:
+                    ops.append(Op(type="fail", f=f, value=None,
+                                  process=p))
+            else:
+                holding.discard(p)
+                ops.append(Op(type="ok", f=f, value=None, process=p))
+        elif started < n_ops:
+            f = "release" if p in holding else "acquire"
+            ops.append(Op(type="invoke", f=f, value=None, process=p))
+            pending[p] = f
+            started += 1
+    return history(ops)
+
+
+def queue_history(rng, n_ops, procs, corrupt=False, fifo=True):
+    """Unique-value enqueues; dequeues observe the simulated queue's
+    head (fifo) — also a legal unordered-queue history.  Corruption
+    rewrites ONE early dequeue's observed value to a fresh
+    never-enqueued one: early, so the exact oracle contradicts on a
+    short prefix instead of blowing its budget proving a deep
+    violation (the verdict-mix floor requires settled Falses)."""
+    q: list[int] = []
+    counter = [0]
+    seen = [0]
+    armed = [corrupt]
+
+    def plan(rng, state, p):
+        # Bias toward dequeue as the queue deepens: the packed model
+        # has 32 slots, and a history whose true queue ever exceeds
+        # them is undecidable in packed form (both engines grind to
+        # unknown trying to refute a valid history).
+        enq_p = 0.8 if len(q) < 4 else (0.5 if len(q) < 12 else 0.1)
+        if rng.random() < enq_p or not q:
+            counter[0] += 1
+            return ("enqueue", counter[0])
+        return ("dequeue", None)
+
+    def apply(state, f, value):
+        if f == "enqueue":
+            q.append(value)
+            return True, value
+        if not q:
+            return False, None
+        return True, q.pop(0 if fifo else rng.randrange(len(q)))
+
+    def corrupt_fn(rng, f, out):
+        seen[0] += 1
+        if (f == "dequeue" and out is not None and armed[0]
+                and seen[0] > max(4, n_ops // 20)):
+            armed[0] = False
+            return out + 100000  # never enqueued
+        return out
+
+    return _interleave(rng, n_ops, procs, plan, apply,
+                       corrupt_rate=1.0, corrupt_fn=corrupt_fn)
+
+
+def multi_register_history(rng, n_ops, procs, keys=("a", "b", "c"),
+                           corrupt=False):
+    """Per-(k, v) reads/writes over a fixed register set; corruption
+    rewrites one early read's observed value."""
+    values = {k: 0 for k in keys}
+    counter = [0]
+    seen = [0]
+    armed = [corrupt]
+
+    def plan(rng, state, p):
+        k = rng.choice(keys)
+        if rng.random() < 0.5:
+            return ("read", (k, None))
+        counter[0] += 1
+        return ("write", (k, counter[0]))
+
+    def apply(state, f, value):
+        k, v = value
+        if f == "write":
+            values[k] = v
+            return True, (k, v)
+        return True, (k, values[k])
+
+    def corrupt_fn(rng, f, out):
+        seen[0] += 1
+        if (f == "read" and armed[0]
+                and seen[0] > max(4, n_ops // 20)):
+            armed[0] = False
+            return (out[0], out[1] + 100000)  # never written
+        return out
+
+    return _interleave(rng, n_ops, procs, plan, apply,
+                       corrupt_rate=1.0, corrupt_fn=corrupt_fn)
+
+
+# -- the soak ------------------------------------------------------------
+
+
+CONFIGS = [
+    # (name, packed-model,
+    #  history_fn(rng, size, corrupt) -> History, sizes).
+    # Corruption is injected EARLY in every corrupt trial so the
+    # exact oracle contradicts on a short prefix and settles inside
+    # its budget (a late violation costs the DFS minutes and yields
+    # only skipped unknowns).
+    (
+        "cas-register",
+        lambda: cas_register().packed(),
+        lambda rng, n, corrupt: random_register_history(
+            n, procs=8, info_rate=0.08, seed=rng.randrange(1 << 30),
+            bad_at=rng.uniform(0.05, 0.3) if corrupt else None,
+        ),
+        (60, 300, 900),
+    ),
+    (
+        "multi-register",
+        lambda: multi_register({"a": 0, "b": 0, "c": 0}).packed(),
+        lambda rng, n, corrupt: multi_register_history(
+            rng, n, procs=6, corrupt=corrupt,
+        ),
+        (60, 300),
+    ),
+    (
+        "mutex",
+        lambda: mutex().packed(),
+        lambda rng, n, corrupt: mutex_history(
+            rng, n, procs=6, corrupt=corrupt,
+        ),
+        (60, 300),
+    ),
+    (
+        "fifo-queue",
+        lambda: fifo_queue().packed(),
+        lambda rng, n, corrupt: queue_history(
+            rng, n, procs=6, corrupt=corrupt,
+        ),
+        (60, 240),
+    ),
+    (
+        "unordered-queue",
+        lambda: unordered_queue().packed(),
+        lambda rng, n, corrupt: queue_history(
+            rng, n, procs=6, fifo=False, corrupt=corrupt,
+        ),
+        (60, 240),
+    ),
+]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize(
+    "name,pm_fn,hist_fn,sizes",
+    CONFIGS, ids=[c[0] for c in CONFIGS],
+)
+def test_device_matches_cpu_exact(name, pm_fn, hist_fn, sizes):
+    import zlib
+
+    pm = pm_fn()
+    # crc32, not hash(): string hashing is salted per process, and a
+    # salted seed would make CI failures unreproducible.
+    rng = random.Random(zlib.crc32(name.encode()) & 0xFFFF)
+    mismatches = []
+    verdicts = {True: 0, False: 0}
+    trials = 0
+    for size in sizes:
+        for rep in range(4):
+            # Deterministic schedule: half the trials per size carry
+            # an (early) injected violation — coin flips here made
+            # the verdict-mix floor a ~26% flake (review finding).
+            h = hist_fn(rng, size, rep % 2 == 1)
+            packed = pack_history(h, pm.encode)
+            # Tight oracle budget: pathological corrupt+info inputs
+            # can cost the DFS minutes; an unknown is skipped (the
+            # verdict-mix floor keeps the soak honest), so the budget
+            # trades coverage of the nastiest 1% for a CI-sized run.
+            cpu = check_wgl_cpu(packed, pm, time_limit_s=20.0)
+            dev = check_wgl_device(packed, pm, time_limit_s=60.0)
+            trials += 1
+            if "unknown" in (cpu.valid, dev.valid):
+                # Budget exhaustion is legal on either engine, never
+                # wrong; the verdict-mix floor below keeps the soak
+                # honest about settling most inputs.
+                continue
+            if cpu.valid is not dev.valid:
+                mismatches.append(
+                    (name, size, rep, cpu.valid, dev.valid)
+                )
+            verdicts[cpu.valid] += 1
+    assert not mismatches, mismatches
+    # The distribution must exercise BOTH verdicts, or the soak is
+    # testing half an engine.
+    assert verdicts[True] >= 3, verdicts
+    assert verdicts[False] >= 3, verdicts

@@ -150,17 +150,76 @@ def test_pallas_runtime_failure_falls_back_to_scan(monkeypatch):
 
 
 def test_models_without_rows_step_fall_back():
+    """A model with no Mosaic-safe batched step (round-4: every
+    shipped model now has one, so strip it artificially) must degrade
+    to the scan sweep under pallas='interpret' instead of erroring."""
+    import dataclasses
+
     from jepsen_tpu.models import unordered_queue
 
     pm = unordered_queue().packed()
-    # The unordered queue needs a per-lane sort — no Mosaic form.
-    assert pm.jax_step_rows is None
-    # pallas="interpret" silently degrades to the scan sweep.
+    pm = dataclasses.replace(pm, jax_step_rows=None)
     from jepsen_tpu.history import parse_literal, INVOKE, OK
 
     h = parse_literal([
         (0, INVOKE, "enqueue", 1), (0, OK, "enqueue", 1),
         (1, INVOKE, "dequeue", None), (1, OK, "dequeue", 1),
+    ])
+    p = pack_history(h, pm.encode)
+    r = check_wgl_witness(p, pm, pallas="interpret")
+    assert _verdict(r) is True
+
+
+def test_unordered_queue_rows_step_parity_and_witness():
+    """The round-4 sort-free unordered rows step: per-(state, op)
+    parity with jax_step up to multiset equality (the rows step does
+    not re-sort — by design, see collections.py), and a witness run
+    through the interpret-mode Pallas kernel."""
+    import itertools
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from jepsen_tpu.models import unordered_queue
+
+    pm = unordered_queue().packed()
+    C = pm.state_width
+    lanes = []
+    for fill in range(3):
+        for vals in itertools.product((2, 3), repeat=fill):
+            lanes.append([0] * (C - fill) + sorted(vals))
+    F_ENQ, F_DEQ = 0, 1
+    cases = [(F_ENQ, 2), (F_ENQ, 4), (F_DEQ, 2), (F_DEQ, 3),
+             (F_DEQ, 9)]
+    for f, a0 in cases:
+        states = jnp.asarray(np.array(lanes, dtype=np.int32)).T
+        rows_new, rows_legal = pm.jax_step_rows(
+            states, jnp.int32(f), jnp.int32(a0), jnp.int32(0)
+        )
+        for i, lane in enumerate(lanes):
+            ref_new, ref_legal = jax.jit(pm.jax_step)(
+                jnp.asarray(lane, jnp.int32), jnp.int32(f),
+                jnp.int32(a0), jnp.int32(0),
+            )
+            assert bool(ref_legal) == bool(rows_legal[i] != 0), (
+                f, a0, lane
+            )
+            if bool(ref_legal):
+                # Multiset equality: the rows step is sort-free.
+                assert sorted(np.asarray(rows_new[:, i]).tolist()) \
+                    == sorted(np.asarray(ref_new).tolist()), (
+                        f, a0, lane,
+                    )
+
+    # End-to-end witness through the interpret-mode kernel.
+    from jepsen_tpu.history import parse_literal, INVOKE, OK
+
+    h = parse_literal([
+        (0, INVOKE, "enqueue", 1), (0, OK, "enqueue", 1),
+        (2, INVOKE, "enqueue", 5), (2, OK, "enqueue", 5),
+        (1, INVOKE, "dequeue", None), (1, OK, "dequeue", 5),
+        (3, INVOKE, "dequeue", None), (3, OK, "dequeue", 1),
     ])
     p = pack_history(h, pm.encode)
     r = check_wgl_witness(p, pm, pallas="interpret")
