@@ -262,10 +262,17 @@ def _add_realtime_edges(history: History, g: DepGraph) -> None:
     A with comp(A) >= M need direct edges.  The surviving set is
     bounded by the concurrency, keeping this near-linear.  History
     indices are the time order."""
+    inv_of = getattr(history, "invocation", None)
+    if not callable(inv_of):
+        raise ValueError(
+            "realtime edges need a paired History (with .invocation), "
+            "not a bare op list — completion order alone cannot "
+            "recover realtime intervals"
+        )
     pairs = []  # (inv_index, comp_index, op.index) for committed txns
     for o in history:
         if o.is_ok and o.f in ("txn", None):
-            inv = history.invocation(o)
+            inv = inv_of(o)
             if inv is not None:
                 pairs.append((inv.index, o.index, o.index))
     pairs.sort()
@@ -298,7 +305,10 @@ def _add_process_edges(history: History, g: DepGraph) -> None:
     the chain is its own transitive reduction."""
     last_by_process: dict = {}
     for o in history:
-        if o.is_ok and o.f in ("txn", None):
+        if o.is_ok and o.f in ("txn", None) and o.process is not None:
+            # process=None (bare literal ops) carries no session
+            # identity; chaining those would invent one shared
+            # session and falsely convict valid histories.
             prev = last_by_process.get(o.process)
             if prev is not None and prev != o.index:
                 g.add_edge(prev, o.index, "process")

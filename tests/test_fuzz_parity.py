@@ -38,14 +38,16 @@ from jepsen_tpu.ops.wgl import check_wgl_device
 from jepsen_tpu.utils.histgen import random_register_history
 
 
-def _interleave(rng, n_ops, procs, plan_op, apply_op, info_rate=0.0,
+def _interleave(rng, n_ops, procs, plan_op, apply_op,
                 corrupt_rate=0.0, corrupt_fn=None):
     """Generic linearizable-by-construction interleaver: each process
     invokes, then later completes; the op's effect applies atomically
     at completion.  plan_op(rng, state) -> (f, value) or None (no op
     currently legal for this process); apply_op(state, f, value) ->
     (ok, completion_value).  corrupt_fn(rng, f, value) perturbs an
-    observed completion value."""
+    observed completion value.  (No indeterminate ops here: the queue
+    encoders have no packed form for info dequeues; the register
+    family covers info-op coverage via random_register_history.)"""
     state: dict = {"_": None}
     ops: list[Op] = []
     pending: dict[int, tuple] = {}
@@ -54,12 +56,6 @@ def _interleave(rng, n_ops, procs, plan_op, apply_op, info_rate=0.0,
         p = rng.randrange(procs)
         if p in pending:
             f, value = pending.pop(p)
-            if info_rate and rng.random() < info_rate:
-                # Indeterminate: effect maybe happened.
-                if rng.random() < 0.5:
-                    apply_op(state, f, value)
-                ops.append(Op(type="info", f=f, value=value, process=p))
-                continue
             ok, out = apply_op(state, f, value)
             if ok and corrupt_fn and rng.random() < corrupt_rate:
                 out = corrupt_fn(rng, f, out)
