@@ -31,6 +31,35 @@ def test_whole_stack_run_rate_floor():
     )
 
 
+def _timed_wgl_rate(n_ops: int, reps: int) -> float:
+    """Best-of-reps ops/s for the bench-shaped workload through
+    check_wgl_device (rep 0 is the compile warm-up and never counts).
+    Shared by both floor tests so they always guard the same path."""
+    import time
+
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops.wgl import check_wgl_device
+    from jepsen_tpu.ops.wgl_witness import plan_width
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    pm = cas_register().packed()
+    h = random_register_history(n_ops, procs=16, info_rate=0.05,
+                                seed=45100)
+    packed = pack_history(h, pm.encode)
+    width = plan_width(packed)
+    best = None
+    for rep in range(reps + 1):
+        t0 = time.monotonic()
+        res = check_wgl_device(packed, pm, time_limit_s=600.0,
+                               width_hint=width)
+        dt = time.monotonic() - t0
+        assert res.valid is True, res
+        if rep > 0:
+            best = dt if best is None else min(best, dt)
+    return n_ops / best
+
+
 @pytest.mark.slow
 def test_headline_bench_cpu_floor():
     """The flagship path itself — bench.py's exact 100k-op
@@ -43,30 +72,26 @@ def test_headline_bench_cpu_floor():
     pools shrink 8x).  The 50k floor both catches a generic 2x
     regression AND fails if the compaction win is ever silently
     lost.  Best of 3 to damp CI machine noise (~±20%)."""
-    import time
-
-    from jepsen_tpu.history.packed import pack_history
-    from jepsen_tpu.models import cas_register
-    from jepsen_tpu.ops.wgl import check_wgl_device
-    from jepsen_tpu.ops.wgl_witness import plan_width
-    from jepsen_tpu.utils.histgen import random_register_history
-
-    pm = cas_register().packed()
-    h = random_register_history(100_000, procs=16, info_rate=0.05,
-                                seed=45100)
-    packed = pack_history(h, pm.encode)
-    width = plan_width(packed)
-    best = None
-    for rep in range(4):  # rep 0 = compile warm-up
-        t0 = time.monotonic()
-        res = check_wgl_device(packed, pm, time_limit_s=600.0,
-                               width_hint=width)
-        dt = time.monotonic() - t0
-        assert res.valid is True, res
-        if rep > 0:
-            best = dt if best is None else min(best, dt)
-    rate = 100_000 / best
+    rate = _timed_wgl_rate(100_000, reps=3)
     assert rate > 50_000, (
         f"headline bench path regressed: {rate:,.0f} ops/s "
         f"(floor 50,000 — did candidate compaction break?)"
+    )
+
+
+@pytest.mark.slow
+def test_long_history_scaling_floor():
+    """Scaling guard (round 4): the checker held ~224k ops/s flat
+    from 100k to 10M ops on a single CPU device once two host-side
+    superlinearities were removed (per-block full-history masks in
+    the witness planner; numpy's whole-array cast on mismatched
+    searchsorted key dtypes — doc/design.md "Long-history scaling").
+    A 2M-op check at ≥1/3 of the measured single-device rate (under
+    this suite's 8-virtual-device split) fails CI if either class of
+    regression returns: the pre-fix rate at this size extrapolates
+    to well under the floor."""
+    rate = _timed_wgl_rate(2_000_000, reps=1)
+    assert rate > 40_000, (
+        f"long-history rate regressed: {rate:,.0f} ops/s at 2M ops "
+        f"(floor 40,000 — host-side superlinearity returned?)"
     )
