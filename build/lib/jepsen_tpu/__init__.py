@@ -1,0 +1,11 @@
+"""jepsen-tpu: a TPU-native distributed-systems correctness testing framework.
+
+A ground-up rebuild of Jepsen's capabilities (reference:
+/root/reference/jepsen, SURVEY.md) designed TPU-first: the control plane —
+remotes, generators, nemeses, orchestration — is host Python; histories are
+packed int32 op tensors; and the expensive analysis (Wing–Gong
+linearizability search, transactional cycle detection, per-key independent
+checking) runs on TPU via JAX with mesh sharding.
+"""
+
+__version__ = "0.1.0"

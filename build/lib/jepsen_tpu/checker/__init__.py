@@ -1,0 +1,51 @@
+"""Checkers: history analysis (jepsen.checker equivalents) with the
+linearizability search TPU-offloaded (the BASELINE.json north star)."""
+
+from .core import (
+    Checker,
+    Compose,
+    CounterChecker,
+    LogFilePattern,
+    NoOp,
+    Queue,
+    SetChecker,
+    SetFull,
+    Stats,
+    TotalQueue,
+    UnhandledExceptions,
+    UniqueIds,
+    check_safe,
+    checker,
+    compose,
+    concurrency_limit,
+    merge_valid,
+    valid_rank,
+)
+from .linearizable import Linearizable, linearizable
+from .wgl_cpu import WGLResult, check_wgl_cpu, check_wgl_host_model
+
+__all__ = [
+    "Checker",
+    "Compose",
+    "CounterChecker",
+    "LogFilePattern",
+    "NoOp",
+    "Queue",
+    "SetChecker",
+    "SetFull",
+    "Stats",
+    "TotalQueue",
+    "UnhandledExceptions",
+    "UniqueIds",
+    "check_safe",
+    "checker",
+    "compose",
+    "concurrency_limit",
+    "merge_valid",
+    "valid_rank",
+    "Linearizable",
+    "linearizable",
+    "WGLResult",
+    "check_wgl_cpu",
+    "check_wgl_host_model",
+]

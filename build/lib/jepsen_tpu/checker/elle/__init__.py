@@ -1,0 +1,151 @@
+"""Elle-equivalent: transactional anomaly checking via dependency
+graphs and cycle search (SURVEY.md §2.4; reimplemented, not ported —
+the elle library is not vendored in the reference).
+
+`append` and `wr` provide analyses + generators; `graph` the SCC/cycle
+machinery; Checker adapters here plug into the checker protocol.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from ...history.core import History
+from ..core import Checker
+from . import append as _append
+from . import graph, wr as _wr
+from .append import AppendGen, analyze as analyze_append
+from .graph import DepGraph, check_cycles
+from .wr import WrGen, analyze as analyze_wr
+
+__all__ = [
+    "AppendChecker",
+    "AppendGen",
+    "DepGraph",
+    "WrChecker",
+    "WrGen",
+    "analyze_append",
+    "analyze_wr",
+    "check_cycles",
+    "graph",
+    "write_artifacts",
+]
+
+
+def _device_cycle_fn(device: str):
+    """None (host Tarjan) or the device-screened search (ops/scc.py):
+    the MXU closure kernel settles acyclic graphs; small flagged
+    graphs get the exact host layered extraction, large flagged ones
+    extract their witness cycles on device too — same anomaly-type
+    verdicts, but the device path emits one certificate per layer
+    rather than the host's one per SCC per layer."""
+    if device == "off":
+        return None
+
+    def screened(g: DepGraph):
+        from ...ops.scc import check_cycles_device
+
+        return check_cycles_device([g])[0]
+
+    return screened
+
+
+def write_artifacts(result: dict, opts: Optional[dict],
+                    subdir: str = "elle") -> None:
+    """Persists an invalid analysis into the store directory the way
+    elle writes its :directory artifacts (consumed by the reference at
+    tests/cycle/append.clj via the :directory option): a JSON anomaly
+    dump plus one Graphviz DOT file per reported cycle, so a human can
+    `dot -Tsvg` the dependency cycle that failed the test."""
+    import json
+    import logging
+    import os
+
+    directory = (opts or {}).get("dir")
+    if not directory or result.get("valid") is True:
+        return
+    try:
+        out = os.path.join(directory, subdir)
+        os.makedirs(out, exist_ok=True)
+        with open(os.path.join(out, "anomalies.json"), "w") as f:
+            json.dump(
+                {
+                    "valid": result.get("valid"),
+                    "anomaly-types": result.get("anomaly-types"),
+                    "anomalies": result.get("anomalies"),
+                },
+                f, indent=2, default=repr,
+            )
+        cycles = result.get("anomalies")
+        if isinstance(cycles, dict):
+            cycles = [c for v in cycles.values() if isinstance(v, list)
+                      for c in v if isinstance(c, dict) and "cycle" in c]
+        elif isinstance(cycles, list):
+            cycles = [c for c in cycles
+                      if isinstance(c, dict) and "cycle" in c]
+        else:
+            cycles = []
+        for i, c in enumerate(cycles):
+            lines = ["digraph cycle {"]
+            for step in c.get("steps", []):
+                label = ",".join(step.get("types", []))
+                lines.append(
+                    f'  "T{step["from"]}" -> "T{step["to"]}" '
+                    f'[label="{label}"];'
+                )
+            lines.append("}")
+            name = f"cycle-{i}-{c.get('type', 'cycle')}.dot"
+            with open(os.path.join(out, name), "w") as f:
+                f.write("\n".join(lines) + "\n")
+    except Exception as e:
+        # A side-output failure (read-only/deleted store dir, full
+        # disk, or a malformed anomaly payload that json.dump / the
+        # DOT writer chokes on) must never escape and let check_safe
+        # downgrade an already-computed invalid verdict to "unknown".
+        # Same policy as IndependentChecker._write_key_artifacts.
+        logging.getLogger(__name__).warning(
+            "could not write elle artifacts to %s: %r", directory, e
+        )
+
+
+class AppendChecker(Checker):
+    """checker for list-append workloads (append.clj:6-27).  `device`:
+    "auto"/"on" screens cycle search on the accelerator, "off" keeps it
+    on host."""
+
+    def __init__(self, consistency_model: str = "serializable",
+                 device: str = "auto"):
+        self.consistency_model = consistency_model
+        self.device = device
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        res = analyze_append(
+            history.client_ops(),
+            consistency_model=self.consistency_model,
+            cycle_fn=_device_cycle_fn(self.device),
+        )
+        write_artifacts(res, opts, "elle-append")
+        return res
+
+
+class WrChecker(Checker):
+    """checker for rw-register workloads (wr.clj:5-25).  `device` as in
+    AppendChecker.  `sequential_keys` opts into the declared per-key
+    sequential-write version-order inference (see wr.analyze) for
+    systems that promise it."""
+
+    def __init__(self, consistency_model: str = "serializable",
+                 device: str = "auto", sequential_keys: bool = False):
+        self.consistency_model = consistency_model
+        self.device = device
+        self.sequential_keys = sequential_keys
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        res = analyze_wr(
+            history.client_ops(),
+            consistency_model=self.consistency_model,
+            cycle_fn=_device_cycle_fn(self.device),
+            sequential_keys=self.sequential_keys,
+        )
+        write_artifacts(res, opts, "elle-wr")
+        return res

@@ -1,0 +1,231 @@
+"""Core orchestration: the whole-test lifecycle.
+
+Equivalent of /root/reference/jepsen/src/jepsen/core.clj:
+`prepare-test` (:302-320), `run!` (:322-412), `run-case!` (:208-213),
+`analyze!` (:215-228), and `log-results` (:230-243).  The lifecycle
+(§3.1 of SURVEY.md):
+
+    prepare → store dir + logging → save-0 → sessions → OS setup →
+    DB cycle → client/nemesis setup → interpreter (history streamed to
+    disk) → save-1 → snarf logs → teardown → analyze → save-2
+
+The *test map* is the universal config object (core.clj:323-360).
+Keys: name, nodes, concurrency (int or "3n"), client, nemesis, db, os,
+net, generator, checker, model, ssh {dummy? ...}, store-dir,
+leave-db-running, log-snarfing off by default for dummy runs.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import re
+from typing import Any, Optional
+
+from . import db as jdb
+from . import interpreter, oses, store
+from .checker.core import check_safe
+from .control import with_sessions
+from .history import History
+from .nemesis import Nemesis, noop as noop_nemesis
+from .utils import real_pmap
+
+log = logging.getLogger(__name__)
+
+
+def parse_concurrency(spec: Any, n_nodes: int) -> int:
+    """int, or "3n" = 3 × node count (cli.clj:150-168)."""
+    if isinstance(spec, int):
+        return spec
+    m = re.fullmatch(r"(\d+)n", str(spec).strip())
+    if m:
+        return int(m.group(1)) * max(n_nodes, 1)
+    return int(spec)
+
+
+def prepare_test(test: dict) -> dict:
+    """Fills defaults: start-time, parsed concurrency, noop nemesis
+    (core.clj:302-320).  A workload-supplied "final-generator" (e.g. a
+    set workload's final read) is phased onto client threads after the
+    main generator — reference suites wire this by hand with
+    gen/phases; here the test map carries it."""
+    test = dict(test)
+    test.setdefault("name", "noname")
+    test.setdefault("nodes", ["n1", "n2", "n3", "n4", "n5"])
+    test["concurrency"] = parse_concurrency(
+        test.get("concurrency", "1n"), len(test["nodes"])
+    )
+    test.setdefault("nemesis", noop_nemesis)
+    fg = test.pop("final-generator", None)
+    if fg is not None and test.get("generator") is not None:
+        from .generator import clients as gen_clients, phases as gen_phases
+
+        test["generator"] = gen_phases(
+            test["generator"], gen_clients(fg)
+        )
+    return test
+
+
+def setup_nemesis(test: dict) -> Nemesis:
+    nem = test.get("nemesis") or noop_nemesis
+    return nem.setup(test)
+
+
+def _with_clients(test: dict, method: str) -> None:
+    """Opens a client per node and calls setup/teardown on it
+    (core.clj:175-206)."""
+    proto = test.get("client")
+    if proto is None:
+        return
+
+    def one(node: str) -> None:
+        c = proto.open(test, node)
+        try:
+            getattr(c, method)(test)
+        finally:
+            try:
+                c.close(test)
+            except Exception:  # noqa: BLE001
+                pass
+
+    if method == "teardown":
+        # Best-effort: a node the nemesis left dead must not turn a
+        # finished run into an error.
+        def one_safe(node: str) -> None:
+            try:
+                one(node)
+            except Exception as e:  # noqa: BLE001
+                log.warning("client teardown on %s failed: %r", node, e)
+
+        real_pmap(one_safe, test.get("nodes") or [])
+    else:
+        real_pmap(one, test.get("nodes") or [])
+
+
+def run_case(test: dict, history_writer=None) -> History:
+    """Client+nemesis setup, then the generator interpreter
+    (core.clj:208-213)."""
+    nem = setup_nemesis(test)
+    test = dict(test)
+    test["nemesis"] = nem
+    try:
+        _with_clients(test, "setup")
+        return interpreter.run(test, writer=history_writer)
+    finally:
+        try:
+            _with_clients(test, "teardown")
+        finally:
+            nem.teardown(test)
+
+
+def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
+    """Runs the test's checker over the history (core.clj:215-228).
+    `dir` is where artifact-writing checkers put their output; defaults
+    to the test's own store dir."""
+    checker = test.get("checker")
+    if checker is None:
+        return {"valid": True, "note": "no checker"}
+    opts: dict[str, Any] = {"history-key": None}
+    if dir is not None:
+        opts["dir"] = dir
+    else:
+        try:
+            opts["dir"] = store.test_dir(test)
+        except ValueError:
+            pass
+    return check_safe(checker, test, history, opts)
+
+
+def log_results(results: dict) -> None:
+    """core.clj:230-243."""
+    valid = results.get("valid")
+    if valid is True:
+        log.info("Everything looks good! ヽ('ー`)ノ")
+    elif valid == "unknown":
+        log.warning("Errors occurred during analysis; validity unknown")
+    else:
+        log.warning("Analysis invalid! (ﾉಥ益ಥ）ﾉ ┻━┻")
+
+
+def run(test: dict) -> dict:
+    """The full lifecycle (core.clj:322-412).  Returns the test map with
+    "history" and "results" added."""
+    test = prepare_test(test)
+    test = store.make_test_dir(test)
+    handler = store.start_logging(test)
+    try:
+        with store.Store(test) as st:
+            st.save_0(test)
+            hw = st.history_writer()
+            with with_sessions(test):
+                try:
+                    oses.setup(test)
+                    jdb.cycle(test)
+                    history = run_case(test, history_writer=hw.append)
+                    test["history"] = history
+                    st.save_1(test, history)
+                finally:
+                    # Whatever happened — OS/DB setup crash, client bug
+                    # mid-run — seal any partial history so the file
+                    # stays readable for `analyze`.
+                    try:
+                        hw.close()
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("history seal failed: %r", e)
+                    # Snarf logs even when the run throws — failing runs
+                    # are exactly the ones whose logs matter
+                    # (core.clj:142-158 with-log-snarfing).
+                    if test.get("db") is not None:
+                        try:
+                            jdb.snarf_logs(test, store.test_dir(test))
+                        except Exception as e:  # noqa: BLE001
+                            log.warning("log snarfing failed: %r", e)
+                    if not test.get("leave-db-running"):
+                        try:
+                            jdb.teardown(test)
+                        except Exception as e:  # noqa: BLE001
+                            log.warning("db teardown failed: %r", e)
+                    try:
+                        oses.teardown(test)
+                    except Exception as e:  # noqa: BLE001
+                        log.warning("os teardown failed: %r", e)
+            results = analyze(test, test["history"])
+            test["results"] = results
+            st.save_2(results)
+            log_results(results)
+    finally:
+        store.stop_logging(handler)
+    return test
+
+
+def rerun_analysis(test_dir: str, test: dict) -> dict:
+    """Re-runs checkers over a stored history — the `analyze` CLI
+    subcommand (cli.clj:402-441).  `test` supplies live objects
+    (checker, model); the stored test map fills the rest."""
+    tf = store.load(test_dir)
+    try:
+        stored = tf.test or {}
+        # The stored map is the record of the run; the caller's map only
+        # contributes live objects (checker/model/client...) and keys the
+        # stored run never had — CLI defaults must not clobber the
+        # recorded nodes/concurrency/etc.
+        merged = {**test, **stored}
+        for k in store.NONSERIALIZABLE_KEYS:
+            if k in test:
+                merged[k] = test[k]
+        history = tf.history()
+        # Artifacts go next to the file actually being analyzed, not a
+        # path recomputed from CLI options.
+        artifact_dir = (
+            test_dir if os.path.isdir(test_dir) else os.path.dirname(tf.path)
+        )
+        results = analyze(merged, history, dir=artifact_dir)
+        with store.format.Handle(
+            tf.path
+        ) as h:  # append fresh results to the same file
+            h.save_results(results)
+        merged["history"] = history
+        merged["results"] = results
+        return merged
+    finally:
+        tf.close()

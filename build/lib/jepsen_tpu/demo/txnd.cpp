@@ -1,0 +1,235 @@
+// txnd: a tiny MVCC key-value store with snapshot-isolation
+// transactions — and snapshot isolation's signature bug, write skew.
+//
+// The role: a REAL transactional system for the elle-equivalent
+// checkers (jepsen_tpu/checker/elle) to convict, the way the
+// reference project aims elle at tidb/cockroachdb/yugabyte (SURVEY.md
+// §2.5).  kvdb/repkv/logd cover durability, replication, and logs;
+// this covers transactions.
+//
+// Storage: versioned values per key, each stamped with the commit
+// sequence number that wrote it.  A transaction takes a snapshot
+// (the commit counter at BEGIN), reads the latest version <= its
+// snapshot, buffers writes, and at COMMIT aborts iff some written
+// key gained a version after the snapshot — first-committer-wins on
+// WRITE-write conflicts only.  That is textbook snapshot isolation:
+// two transactions that READ overlapping keys but WRITE disjoint
+// ones both commit, producing G2/write-skew anomalies (Berenson et
+// al. 1995; Adya's G2) that serializability forbids.
+//
+// --serializable widens commit validation to the READ set (aborts if
+// any key read has a newer version than the snapshot — backward
+// OCC), which closes the skew window: the control group.
+//
+// --think-us N sleeps between snapshot acquisition and commit
+// validation, widening the race window so short test runs reliably
+// exhibit the anomaly (a production system's window is its
+// transaction duration; we just make ours honest and visible).
+//
+// Protocol (line-based TCP, one txn per line, executed server-side):
+//   TXN r <k> [r <k2> ...] w <k> <v> ...\n
+//     -> OK [<read-val-or-NIL> per r, in order]\n   committed
+//     -> ABORT\n                                    conflict: nothing applied
+//   PING\n -> PONG\n
+//
+// Values are integers; writes are expected globally unique per key
+// (the elle rw-register workload guarantees this).
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <chrono>
+#include <map>
+#include <mutex>
+#include <sstream>
+#include <string>
+#include <thread>
+#include <vector>
+
+struct Version {
+  long long seq;
+  long long value;
+};
+
+static std::map<std::string, std::vector<Version>> g_store;
+static long long g_commit_seq = 0;
+static std::mutex g_mu;  // guards g_store + g_commit_seq
+
+static bool g_serializable = false;
+static long g_think_us = 2000;
+
+struct ReadOp {
+  std::string key;
+};
+struct WriteOp {
+  std::string key;
+  long long value;
+};
+
+// Latest committed value of key visible at `snap`; false if none.
+static bool read_at(const std::string &key, long long snap,
+                    long long *out) {
+  auto it = g_store.find(key);
+  if (it == g_store.end()) return false;
+  const auto &vs = it->second;
+  for (auto r = vs.rbegin(); r != vs.rend(); ++r) {
+    if (r->seq <= snap) {
+      *out = r->value;
+      return true;
+    }
+  }
+  return false;
+}
+
+// Newest version seq of key (0 if never written).
+static long long newest_seq(const std::string &key) {
+  auto it = g_store.find(key);
+  if (it == g_store.end() || it->second.empty()) return 0;
+  return it->second.back().seq;
+}
+
+static std::string run_txn(const std::vector<ReadOp> &reads,
+                           const std::vector<WriteOp> &writes) {
+  long long snap;
+  std::vector<std::pair<bool, long long>> results(reads.size());
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    snap = g_commit_seq;
+    for (size_t i = 0; i < reads.size(); i++) {
+      long long v = 0;  // read_at leaves it untouched on miss
+      results[i].first = read_at(reads[i].key, snap, &v);
+      results[i].second = v;
+    }
+  }
+
+  // The transaction "thinks" between snapshot and commit — the window
+  // in which a concurrent committer can invalidate its premises.
+  if (g_think_us > 0 && !writes.empty())
+    std::this_thread::sleep_for(std::chrono::microseconds(g_think_us));
+
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    for (const auto &w : writes)
+      if (newest_seq(w.key) > snap) return "ABORT";
+    if (g_serializable)
+      for (const auto &r : reads)
+        if (newest_seq(r.key) > snap) return "ABORT";
+    if (!writes.empty()) {
+      long long seq = ++g_commit_seq;
+      for (const auto &w : writes)
+        g_store[w.key].push_back({seq, w.value});
+    }
+  }
+
+  std::ostringstream out;
+  out << "OK";
+  for (const auto &res : results) {
+    if (res.first)
+      out << " " << res.second;
+    else
+      out << " NIL";
+  }
+  return out.str();
+}
+
+static void serve(int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  FILE *in = fdopen(fd, "r");
+  FILE *out = fdopen(dup(fd), "w");
+  if (!in || !out) {
+    close(fd);
+    return;
+  }
+  char line[65536];
+  while (fgets(line, sizeof(line), in)) {
+    std::istringstream ss(line);
+    std::string cmd;
+    ss >> cmd;
+    std::string resp;
+    if (cmd == "PING") {
+      resp = "PONG";
+    } else if (cmd == "TXN") {
+      std::vector<ReadOp> reads;
+      std::vector<WriteOp> writes;
+      std::string op;
+      bool bad = false;
+      while (ss >> op) {
+        if (op == "r") {
+          std::string k;
+          if (!(ss >> k)) { bad = true; break; }
+          reads.push_back({k});
+        } else if (op == "w") {
+          std::string k;
+          long long v;
+          if (!(ss >> k >> v)) { bad = true; break; }
+          writes.push_back({k, v});
+        } else {
+          bad = true;
+          break;
+        }
+      }
+      resp = bad ? "ERR bad txn" : run_txn(reads, writes);
+    } else {
+      resp = "ERR unknown command";
+    }
+    fputs(resp.c_str(), out);
+    fputc('\n', out);
+    fflush(out);
+  }
+  fclose(in);
+  fclose(out);
+}
+
+int main(int argc, char **argv) {
+  int port = 7500;
+  std::string listen_addr = "127.0.0.1";
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    if (a == "--port" && i + 1 < argc)
+      port = atoi(argv[++i]);
+    else if (a == "--listen" && i + 1 < argc)
+      listen_addr = argv[++i];
+    else if (a == "--serializable")
+      g_serializable = true;
+    else if (a == "--think-us" && i + 1 < argc)
+      g_think_us = atol(argv[++i]);
+    else {
+      fprintf(stderr, "unknown arg %s\n", a.c_str());
+      return 2;
+    }
+  }
+  signal(SIGPIPE, SIG_IGN);
+
+  int srv = socket(AF_INET, SOCK_STREAM, 0);
+  int one = 1;
+  setsockopt(srv, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons(port);
+  if (inet_pton(AF_INET, listen_addr.c_str(), &addr.sin_addr) != 1) {
+    fprintf(stderr, "bad --listen address %s\n", listen_addr.c_str());
+    return 2;
+  }
+  if (bind(srv, (sockaddr *)&addr, sizeof(addr)) != 0) {
+    perror("bind");
+    return 1;
+  }
+  listen(srv, 64);
+  fprintf(stderr, "txnd listening on %s:%d (%s, think %ld us)\n",
+          listen_addr.c_str(), port,
+          g_serializable ? "serializable" : "snapshot-isolation",
+          g_think_us);
+  for (;;) {
+    int fd = accept(srv, nullptr, nullptr);
+    if (fd < 0) continue;
+    std::thread(serve, fd).detach();
+  }
+}

@@ -1,0 +1,72 @@
+"""libfaketime wrappers: per-node clock rates for DB binaries.
+
+Equivalent of /root/reference/jepsen/src/jepsen/faketime.clj (:24-47):
+instead of skewing the system clock (clock nemesis), wrap a DB binary
+in a shell script that runs it under `faketime` with an initial offset
+and a rate multiplier, so different nodes experience time passing at
+different speeds.  `wrap` moves the real binary aside idempotently;
+`unwrap` restores it.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+from .control import Session
+
+#: Suffix for the displaced original binary (faketime.clj:37-47).
+REAL_SUFFIX = ".no-faketime"
+
+
+def script(cmd: str, init_offset: float = 0, rate: float = 1.0) -> str:
+    """A sh script invoking cmd under faketime (faketime.clj:24-35)."""
+    sign = "-" if init_offset < 0 else "+"
+    return (
+        "#!/bin/bash\n"
+        f'faketime -m -f "{sign}{abs(int(init_offset))}s x{float(rate)}" '
+        f'{cmd} "$@"\n'
+    )
+
+
+def install(sess: Session) -> None:
+    """Installs the faketime binary (the reference builds a patched
+    0.9.6 fork; distribution packages are fine for the rate/offset
+    features we use)."""
+    with sess.su():
+        sess.exec_star(
+            "env", "DEBIAN_FRONTEND=noninteractive",
+            "apt-get", "install", "-y", "faketime",
+        )
+
+
+def _exists(sess: Session, path: str) -> bool:
+    return sess.exec_star("test", "-e", path).get("exit") == 0
+
+
+def wrap(sess: Session, cmd: str, init_offset: float = 0,
+         rate: float = 1.0) -> None:
+    """Replaces `cmd` with a faketime wrapper, moving the original to
+    cmd.no-faketime.  Idempotent (faketime.clj:37-47): re-wrapping just
+    rewrites the wrapper script."""
+    real = cmd + REAL_SUFFIX
+    if not _exists(sess, real):
+        sess.exec("mv", cmd, real)
+    sess.exec("tee", cmd, stdin=script(real, init_offset, rate))
+    sess.exec("chmod", "a+x", cmd)
+
+
+def unwrap(sess: Session, cmd: str) -> None:
+    """Restores the original binary if wrapped (faketime.clj:49-55)."""
+    real = cmd + REAL_SUFFIX
+    if _exists(sess, real):
+        sess.exec("mv", real, cmd)
+
+
+def rand_factor(factor: float, rng: Optional[random.Random] = None) -> float:
+    """A rate drawn around 1 such that max/min = factor
+    (faketime.clj:57-66)."""
+    rng = rng or random
+    hi = 2 / (1 + 1 / factor)
+    lo = hi / factor
+    return lo + rng.random() * (hi - lo)

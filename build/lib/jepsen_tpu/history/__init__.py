@@ -1,0 +1,71 @@
+"""History: ops, histories, pairing, and packed device tensors.
+
+Replaces the reference's external `io.jepsen/history` dependency
+(SURVEY.md §2.4) with a host-friendly Op/History view plus the packed
+int32 columnar representation the TPU checkers consume.
+"""
+
+from .core import (
+    FAIL,
+    INFO,
+    INVOKE,
+    NEMESIS,
+    NEMESIS_CODE,
+    OK,
+    TYPE_CODES,
+    TYPE_NAMES,
+    TYPES,
+    History,
+    Op,
+    fail,
+    history,
+    info,
+    invoke,
+    ok,
+    op,
+    parse_literal,
+)
+from .fold import Fold, Task, loopf, task
+from .fold import fold as run_fold  # `fold` stays the submodule name
+from .packed import (
+    NIL,
+    NO_RET,
+    ST_INFO,
+    ST_OK,
+    Interner,
+    PackedOps,
+    pack_history,
+)
+
+__all__ = [
+    "FAIL",
+    "Fold",
+    "Task",
+    "loopf",
+    "run_fold",
+    "task",
+    "INFO",
+    "INVOKE",
+    "NEMESIS",
+    "NEMESIS_CODE",
+    "OK",
+    "TYPE_CODES",
+    "TYPE_NAMES",
+    "TYPES",
+    "History",
+    "Op",
+    "fail",
+    "history",
+    "info",
+    "invoke",
+    "ok",
+    "op",
+    "parse_literal",
+    "NIL",
+    "NO_RET",
+    "ST_INFO",
+    "ST_OK",
+    "Interner",
+    "PackedOps",
+    "pack_history",
+]

@@ -1,0 +1,367 @@
+"""Operations and histories.
+
+Equivalent of the external `io.jepsen/history` library as consumed by the
+reference (SURVEY.md §2.4): the `Op` record (fields index, time, type,
+process, f, value — constructed at
+/root/reference/jepsen/src/jepsen/generator.clj:529-536), history
+construction with dense indices, invoke↔completion pairing, predicates
+(invoke?/ok?/fail?/info?/client-op?), and filtered views.
+
+Design notes (TPU-first): a History is an immutable sequence of Op rows
+backed by plain Python objects for host-side ergonomics, with `pair_index`
+computed once in O(n).  The device-facing columnar encoding lives in
+`jepsen_tpu.history.packed` — this module is the friendly host view.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Iterator, Sequence
+
+# Op types (the reference uses keywords :invoke :ok :fail :info).
+INVOKE = "invoke"
+OK = "ok"
+FAIL = "fail"
+INFO = "info"
+
+TYPES = (INVOKE, OK, FAIL, INFO)
+
+#: Packed integer codes for op types (BASELINE.json packed tensor layout).
+TYPE_CODES = {INVOKE: 0, OK: 1, FAIL: 2, INFO: 3}
+TYPE_NAMES = {v: k for k, v in TYPE_CODES.items()}
+
+#: The nemesis's logical process (the reference uses the keyword :nemesis,
+#: generator/context.clj:258-286).
+NEMESIS = "nemesis"
+
+#: Packed process code for the nemesis.
+NEMESIS_CODE = -1
+
+#: Sentinel for Op.complete: keep the invocation's value.
+_KEEP = object()
+
+
+@dataclass(slots=True)
+class Op:
+    """One history event.
+
+    Mirrors jepsen.history's Op record: `index` is the dense position in the
+    history, `time` is nanoseconds since test start, `type` is one of
+    invoke/ok/fail/info, `process` is an integer worker process or
+    NEMESIS, `f` is the operation function (any hashable), `value` its
+    payload.  Extra keys (e.g. :error) live in `ext`."""
+
+    type: str
+    f: Any = None
+    value: Any = None
+    process: Any = None
+    time: int = -1
+    index: int = -1
+    ext: dict[str, Any] = field(default_factory=dict)
+
+    # -- predicates (jepsen.history predicates; SURVEY.md §2.4) ------------
+
+    @property
+    def is_invoke(self) -> bool:
+        return self.type == INVOKE
+
+    @property
+    def is_ok(self) -> bool:
+        return self.type == OK
+
+    @property
+    def is_fail(self) -> bool:
+        return self.type == FAIL
+
+    @property
+    def is_info(self) -> bool:
+        return self.type == INFO
+
+    @property
+    def is_client_op(self) -> bool:
+        """Client ops have integer processes; the nemesis doesn't."""
+        return isinstance(self.process, int)
+
+    @property
+    def error(self) -> Any:
+        return self.ext.get("error")
+
+    def replace(self, *, type: Any = _KEEP, f: Any = _KEEP,
+                value: Any = _KEEP, process: Any = _KEEP,
+                time: Any = _KEEP, index: Any = _KEEP,
+                ext: Any = _KEEP) -> "Op":
+        # Hand-rolled dataclasses.replace: this sits on the interpreter
+        # hot path (3 calls per executed op); named sentinel parameters
+        # beat both the generic version's field introspection and a
+        # **kw dict (7 dict lookups per call) in whole-stack profiles.
+        # Unknown fields still raise TypeError via normal arg binding.
+        return Op(
+            type=self.type if type is _KEEP else type,
+            f=self.f if f is _KEEP else f,
+            value=self.value if value is _KEEP else value,
+            process=self.process if process is _KEEP else process,
+            time=self.time if time is _KEEP else time,
+            index=self.index if index is _KEEP else index,
+            ext=self.ext if ext is _KEEP else ext,
+        )
+
+    def complete(self, type: str, value: Any = _KEEP, **ext: Any) -> "Op":
+        """The completion of this invocation: same process/f, new type,
+        optionally a new value and extra keys (e.g. error=...); time and
+        index are left for the interpreter to fill."""
+        new_ext = dict(self.ext)
+        new_ext.update(ext)
+        return self.replace(
+            type=type,
+            value=self.value if value is _KEEP else value,
+            time=-1,
+            index=-1,
+            ext=new_ext,
+        )
+
+    def with_ext(self, **kw: Any) -> "Op":
+        ext = dict(self.ext)
+        ext.update(kw)
+        return self.replace(ext=ext)
+
+    def to_dict(self) -> dict[str, Any]:
+        d = {
+            "index": self.index,
+            "time": self.time,
+            "type": self.type,
+            "process": self.process,
+            "f": self.f,
+            "value": self.value,
+        }
+        d.update(self.ext)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "Op":
+        ext = {
+            k: v
+            for k, v in d.items()
+            if k not in ("index", "time", "type", "process", "f", "value")
+        }
+        return cls(
+            type=d["type"],
+            f=d.get("f"),
+            value=d.get("value"),
+            process=d.get("process"),
+            time=d.get("time", -1),
+            index=d.get("index", -1),
+            ext=ext,
+        )
+
+    def __str__(self) -> str:
+        return (
+            f"{self.index}\t{self.process}\t{self.type}\t{self.f}\t{self.value!r}"
+            + (f"\t{self.ext}" if self.ext else "")
+        )
+
+
+def op(type: str, f: Any = None, value: Any = None, process: Any = None, **ext: Any) -> Op:
+    """Terse Op constructor for tests and literal histories."""
+    return Op(type=type, f=f, value=value, process=process, ext=ext)
+
+
+def invoke(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(INVOKE, f, value, process, **ext)
+
+
+def ok(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(OK, f, value, process, **ext)
+
+
+def fail(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(FAIL, f, value, process, **ext)
+
+
+def info(f: Any = None, value: Any = None, process: Any = 0, **ext: Any) -> Op:
+    return op(INFO, f, value, process, **ext)
+
+
+class History(Sequence[Op]):
+    """An immutable, dense-indexed sequence of Ops with O(1)
+    invoke↔completion pairing.
+
+    Construction mirrors `(h/history ops {:dense-indices? true ...})` at
+    generator/interpreter.clj:284-286: indices are (re)assigned densely
+    unless the ops already carry dense indices, and missing times are filled
+    from indices so literal test histories sort sensibly."""
+
+    __slots__ = ("ops", "_pair_index", "_by_index")
+
+    def __init__(self, ops: Iterable[Op | dict], *, reindex: bool | None = None):
+        rows: list[Op] = [
+            o if isinstance(o, Op) else Op.from_dict(o) for o in ops
+        ]
+        if reindex is None:
+            reindex = not all(o.index == i for i, o in enumerate(rows))
+        if reindex:
+            rows = [
+                o.replace(index=i, time=(o.time if o.time >= 0 else i))
+                for i, o in enumerate(rows)
+            ]
+        self.ops: tuple[Op, ...] = tuple(rows)
+        #: Op.index -> position in self.ops (they differ on filtered views,
+        #: which preserve original indices).
+        self._by_index: dict[int, int] = {
+            o.index: pos for pos, o in enumerate(self.ops)
+        }
+        self._pair_index = self._compute_pairs()
+
+    # -- pairing ----------------------------------------------------------
+
+    def _compute_pairs(self) -> dict[int, int]:
+        """Maps Op.index -> paired Op.index.
+
+        An invocation pairs with the next op on the same process (its
+        completion).  Client processes perform one op at a time; a client
+        :info completion crashes the process, after which the interpreter
+        assigns a fresh pid (interpreter.clj:245-249), so same-process
+        pairing is unambiguous.  Nemesis invokes pair with the following
+        nemesis completion.  A double invoke without completion is
+        tolerated (earlier op stays unpaired), like jepsen.history."""
+        pair: dict[int, int] = {}
+        pending: dict[Any, int] = {}
+        for o in self.ops:
+            if o.is_invoke:
+                pending[o.process] = o.index
+            else:
+                j = pending.pop(o.process, None)
+                if j is not None:
+                    pair[j] = o.index
+                    pair[o.index] = j
+        return pair
+
+    def completion(self, o: Op | int) -> Op | None:
+        """The completion op for an invocation (or None if it never
+        completed).  Works on filtered views: lookups key on Op.index."""
+        i = o if isinstance(o, int) else o.index
+        j = self._pair_index.get(i, -1)
+        if j > i and j in self._by_index:
+            return self.ops[self._by_index[j]]
+        return None
+
+    def invocation(self, o: Op | int) -> Op | None:
+        """The invocation op for a completion."""
+        i = o if isinstance(o, int) else o.index
+        j = self._pair_index.get(i, -1)
+        if 0 <= j < i and j in self._by_index:
+            return self.ops[self._by_index[j]]
+        return None
+
+    def pair_index(self, i: int) -> int:
+        return self._pair_index.get(i, -1)
+
+    def get_index(self, i: int) -> Op | None:
+        """The op with Op.index == i, or None (O(1))."""
+        pos = self._by_index.get(i)
+        return self.ops[pos] if pos is not None else None
+
+    # -- sequence protocol -------------------------------------------------
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def __getitem__(self, i):  # type: ignore[override]
+        if isinstance(i, slice):
+            return list(self.ops[i])
+        return self.ops[i]
+
+    def __iter__(self) -> Iterator[Op]:
+        return iter(self.ops)
+
+    def __eq__(self, other: object) -> bool:
+        if isinstance(other, History):
+            return self.ops == other.ops
+        if isinstance(other, (list, tuple)):
+            return list(self.ops) == list(other)
+        return NotImplemented
+
+    def __repr__(self) -> str:
+        return f"History({len(self.ops)} ops)"
+
+    # -- filtered views ----------------------------------------------------
+
+    def filter(self, pred: Callable[[Op], bool]) -> "History":
+        """A new history of ops matching pred.  Indices are preserved
+        (like jepsen.history filtered views), so pairing against the
+        original remains meaningful via .index."""
+        return History([o for o in self.ops if pred(o)], reindex=False)
+
+    def remove(self, pred: Callable[[Op], bool]) -> "History":
+        return self.filter(lambda o: not pred(o))
+
+    def map(self, f: Callable[[Op], Op]) -> "History":
+        return History([f(o) for o in self.ops], reindex=False)
+
+    def client_ops(self) -> "History":
+        return self.filter(lambda o: o.is_client_op)
+
+    def invokes(self) -> "History":
+        return self.filter(lambda o: o.is_invoke)
+
+    def oks(self) -> "History":
+        return self.filter(lambda o: o.is_ok)
+
+    def fails(self) -> "History":
+        return self.filter(lambda o: o.is_fail)
+
+    def infos(self) -> "History":
+        return self.filter(lambda o: o.is_info)
+
+    def nemesis_ops(self) -> "History":
+        return self.filter(lambda o: o.process == NEMESIS)
+
+    def has_f(self, fs) -> "History":
+        if callable(fs):
+            return self.filter(lambda o: fs(o.f))
+        fset = {fs} if isinstance(fs, str) else set(fs)
+        return self.filter(lambda o: o.f in fset)
+
+    def possible(self) -> "History":
+        """Ops that may have happened: everything except :fail completions
+        and their invocations (knossos drops certainly-failed ops)."""
+        failed_invokes = {
+            self._pair_index[o.index]
+            for o in self.ops
+            if o.is_fail and o.index in self._pair_index
+        }
+        return self.filter(
+            lambda o: not (o.is_fail or o.index in failed_invokes)
+        )
+
+    def fold(self, f: "Any", chunk_size: "int | None" = None) -> Any:
+        """Runs a history.fold.Fold over this history (h/fold)."""
+        # Import the submodule explicitly: the package re-exports the
+        # `fold` FUNCTION, which shadows the module name.
+        from .fold import fold as run_fold
+
+        if chunk_size is None:
+            return run_fold(self, f)
+        return run_fold(self, f, chunk_size=chunk_size)
+
+    def strip_indices(self) -> list[Op]:
+        """Ops with indices removed (generator/test.clj:73)."""
+        return [o.replace(index=-1) for o in self.ops]
+
+    # -- convenience -------------------------------------------------------
+
+    def to_dicts(self) -> list[dict[str, Any]]:
+        return [o.to_dict() for o in self.ops]
+
+
+def history(ops: Iterable[Op | dict], **kw: Any) -> History:
+    return History(ops, **kw)
+
+
+def parse_literal(rows: Iterable[tuple]) -> History:
+    """Builds a history from terse (process, type, f, value) tuples — the
+    shape checker tests use (checker_test.clj feeds literal op vectors)."""
+    ops = []
+    for row in rows:
+        process, type_, f, value = row
+        ops.append(Op(type=type_, f=f, value=value, process=process))
+    return History(ops)

@@ -1,0 +1,217 @@
+"""Packed columnar op tensors — the device-facing history representation.
+
+This is the TPU-native serialization called for by BASELINE.json: a history
+becomes packed int32 arrays (process, f, args, type) plus invocation /
+completion event indices, ready to ship to device for checker kernels.
+Mirrors what `jepsen.history`'s Op records + `knossos`'s history
+preprocessing provide to the reference's checkers (SURVEY.md §2.4), but
+columnar from the start.
+
+Shapes: for a history with n live operations (invoke/completion pairs from
+client ops, certain failures dropped), every column is an `(n,)` numpy
+array sorted by invocation order — int32 for op payloads
+(process/status/f/a0/a1), int64 for event bookkeeping (inv/ret/src_index/
+preds/horizon, since ret uses NO_RET = int64 max; the device path clamps
+to int32 INF on transfer).  Precedence structure is reduced to two
+counters per op (SURVEY.md §7 stage 3; see ops/wgl.py for how the search
+uses them):
+
+  preds[a] = #{y != a : ret(y) < inv(a)}   ops that must precede a
+  horizon[a] = #{y != a : inv(y) < ret(a)} last level at which a may remain
+                                            un-linearized
+
+Info (indeterminate) ops never complete, so ret = +inf (INT64 max) and
+horizon = n-1: they stay optional forever — exactly why high-:info
+histories blow up search width (SURVEY.md §7 "hard parts").
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from .core import FAIL, INFO, INVOKE, OK, History, Op
+
+#: Sentinel for "never returns" event index.
+NO_RET = np.iinfo(np.int64).max
+
+#: Sentinel int32 for missing / nil argument values.
+NIL = np.iinfo(np.int32).min
+
+#: Status codes for packed ops.
+ST_OK = 1
+ST_INFO = 3
+
+
+class Interner:
+    """Dense int interning of arbitrary hashable values (f symbols, large
+    or non-int op payloads)."""
+
+    __slots__ = ("values", "_ids")
+
+    def __init__(self) -> None:
+        self.values: list[Any] = []
+        self._ids: dict[Any, int] = {}
+
+    def intern(self, v: Any) -> int:
+        i = self._ids.get(v)
+        if i is None:
+            i = len(self.values)
+            self._ids[v] = i
+            self.values.append(v)
+        return i
+
+    def value(self, i: int) -> Any:
+        return self.values[i]
+
+    def __len__(self) -> int:
+        return len(self.values)
+
+
+#: An encoder maps (invocation, completion|None) to packed
+#: (f_code, a0, a1) int32 triple, or None to drop the op entirely (e.g.
+#: indeterminate reads, which can never affect model state).
+OpEncoderFn = Callable[[Op, Optional[Op]], Optional[tuple[int, int, int]]]
+
+
+@dataclass
+class PackedOps:
+    """Columnar live-operation table, invocation-ordered."""
+
+    #: (n,) invocation event index within the source history
+    inv: np.ndarray
+    #: (n,) completion event index, NO_RET when never completed
+    ret: np.ndarray
+    #: (n,) worker process ids
+    process: np.ndarray
+    #: (n,) ST_OK / ST_INFO
+    status: np.ndarray
+    #: (n,) packed op function codes
+    f: np.ndarray
+    #: (n,) first argument (NIL if absent)
+    a0: np.ndarray
+    #: (n,) second argument (NIL if absent)
+    a1: np.ndarray
+    #: (n,) original History index of the invocation (for reporting)
+    src_index: np.ndarray
+    #: (n,) number of ops that must be linearized before this one
+    preds: np.ndarray
+    #: (n,) last BFS level at which this op may remain un-linearized
+    horizon: np.ndarray
+
+    @property
+    def n(self) -> int:
+        return int(self.inv.shape[0])
+
+    @property
+    def n_ok(self) -> int:
+        return int((self.status == ST_OK).sum())
+
+    def op_row(self, a: int) -> dict[str, int]:
+        return {
+            "inv": int(self.inv[a]),
+            "ret": int(self.ret[a]),
+            "process": int(self.process[a]),
+            "status": int(self.status[a]),
+            "f": int(self.f[a]),
+            "a0": int(self.a0[a]),
+            "a1": int(self.a1[a]),
+            "src_index": int(self.src_index[a]),
+        }
+
+
+def pack_history(h: History, encode: OpEncoderFn) -> PackedOps:
+    """Packs the client portion of a history into columnar arrays.
+
+    Pipeline (mirrors knossos's preprocessing as observed through the
+    checker API, checker.clj:214-233):
+      1. keep client ops only;
+      2. pair invocations with completions;
+      3. drop certain failures (:fail) — they never happened;
+      4. ops whose completion is missing or :info become indeterminate
+         (ret = NO_RET);
+      5. encode (f, value) via the model's encoder; encoders may drop
+         no-effect indeterminate ops (e.g. :info reads).
+    """
+    client = [o for o in h if o.is_client_op]
+    rows: list[tuple[int, int, int, int, int, int, int, int]] = []
+    # Re-derive pairing on the client-only event sequence so inv/ret indices
+    # are dense event positions in that sequence.
+    pending: dict[Any, tuple[int, Op]] = {}
+    events: list[tuple[Op, int]] = [(o, e) for e, o in enumerate(client)]
+
+    def emit(inv_e: int, invoke_op: Op, ret_e: int, comp: Op | None) -> None:
+        if comp is not None and comp.type == FAIL:
+            return  # certainly never happened
+        status = ST_OK if (comp is not None and comp.type == OK) else ST_INFO
+        enc = encode(invoke_op, comp)
+        if enc is None:
+            return
+        fc, a0, a1 = enc
+        rows.append(
+            (
+                inv_e,
+                ret_e if status == ST_OK else NO_RET,
+                invoke_op.process,
+                status,
+                fc,
+                a0,
+                a1,
+                invoke_op.index,
+            )
+        )
+
+    for o, e in events:
+        if o.type == INVOKE:
+            prev = pending.get(o.process)
+            if prev is not None:
+                # Double invoke without completion (torn history): the
+                # earlier op is indeterminate, like core pairing keeps it.
+                emit(prev[0], prev[1], -1, None)
+            pending[o.process] = (e, o)
+        else:
+            inv = pending.pop(o.process, None)
+            if inv is None:
+                continue  # completion without invocation: tolerate
+            inv_e, inv_op = inv
+            emit(inv_e, inv_op, e, o)
+    # Unfinished invocations are indeterminate.
+    for inv_e, inv_op in pending.values():
+        emit(inv_e, inv_op, -1, None)
+
+    rows.sort(key=lambda r: r[0])
+    if rows:
+        arr = np.array(rows, dtype=np.int64)
+    else:
+        arr = np.zeros((0, 8), dtype=np.int64)
+
+    inv = arr[:, 0]
+    ret = arr[:, 1]
+    n = arr.shape[0]
+
+    # preds[a] = #{y != a : ret(y) < inv(a)}
+    # horizon[a] = #{y != a : inv(y) < ret(a)}
+    # O(n log n) via sorted ret values.
+    ret_sorted = np.sort(ret)
+    preds = np.searchsorted(ret_sorted, inv, side="left").astype(np.int64)
+    # inv is sorted ascending already; count invs strictly below each ret.
+    inv_before_ret = np.searchsorted(inv, ret, side="left").astype(np.int64)
+    # Subtract self when inv(a) < ret(a) (always true for completed ops;
+    # for NO_RET ops every other op counts, self too — subtract 1).
+    horizon = inv_before_ret - 1
+    horizon = np.minimum(horizon, n - 1)
+
+    return PackedOps(
+        inv=inv.astype(np.int64),
+        ret=ret,
+        process=arr[:, 2].astype(np.int32),
+        status=arr[:, 3].astype(np.int32),
+        f=arr[:, 4].astype(np.int32),
+        a0=arr[:, 5].astype(np.int32),
+        a1=arr[:, 6].astype(np.int32),
+        src_index=arr[:, 7].astype(np.int64),
+        preds=preds,
+        horizon=horizon,
+    )

@@ -1,0 +1,143 @@
+"""Sequential specification models.
+
+Equivalent of the external `knossos.model` namespace as the reference
+consumes it (SURVEY.md §2.4; protocol quoted in
+/root/reference/doc/tutorial/04-checker.md — `Model`/`step`, inconsistent
+states): a model is an immutable value; `step(op)` returns the next model
+or an `Inconsistent` describing why the transition is illegal.
+
+TPU-first addition: every checkable model can also compile itself to a
+`PackedModel` — a table-free arithmetic transition function over int32
+state vectors, usable both as plain Python (CPU reference WGL) and as a
+JAX function vmapped over search frontiers (ops/wgl.py).  Op payloads are
+interned to int32 by the model's encoder (history/packed.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+from ..history.core import OK, Op
+from ..history.packed import NIL, Interner, OpEncoderFn
+
+
+class Inconsistent:
+    """Terminal model state: the op sequence was illegal."""
+
+    __slots__ = ("msg",)
+
+    def __init__(self, msg: str):
+        self.msg = msg
+
+    def step(self, op: Op) -> "Inconsistent":
+        return self
+
+    @property
+    def is_inconsistent(self) -> bool:
+        return True
+
+    def __repr__(self) -> str:
+        return f"Inconsistent({self.msg!r})"
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Inconsistent) and other.msg == self.msg
+
+    def __hash__(self) -> int:
+        return hash(("Inconsistent", self.msg))
+
+
+def inconsistent(msg: str) -> Inconsistent:
+    return Inconsistent(msg)
+
+
+class Model:
+    """Base sequential datatype model (knossos.model/Model)."""
+
+    @property
+    def is_inconsistent(self) -> bool:
+        return False
+
+    def step(self, op: Op) -> "Model | Inconsistent":
+        raise NotImplementedError
+
+    # -- packed / device compilation --------------------------------------
+
+    def packed(self) -> "PackedModel":
+        """The packed int32 form of this model, memoized per instance —
+        device kernel caches key on the identity of the PackedModel's
+        jax_step, so repeated checks with one model must reuse one
+        compilation.  Raises NotImplementedError for host-only models
+        (e.g. unbounded sets)."""
+        cached = getattr(self, "_packed_cache", None)
+        if cached is None:
+            cached = self._compile_packed()
+            try:
+                object.__setattr__(self, "_packed_cache", cached)
+            except AttributeError:
+                pass  # __slots__ without cache slot: recompile each call
+        return cached
+
+    def _compile_packed(self) -> "PackedModel":
+        """Builds the packed form.  Subclasses override this, not
+        packed()."""
+        raise NotImplementedError(
+            f"{type(self).__name__} has no packed/device form"
+        )
+
+
+@dataclass
+class PackedModel:
+    """A model compiled for the packed/device pipeline.
+
+    - `state_width`: number of int32 words of model state per search
+      configuration (1 for cas-register, K for multi-register, ...).
+    - `init_state`: tuple of `state_width` ints.
+    - `encode`: OpEncoderFn packing (invocation, completion) → (f, a0, a1),
+      or None to drop no-effect indeterminate ops.
+    - `py_step(state, f, a0, a1) -> (state', legal)`: plain-Python
+      transition over int tuples (CPU reference WGL).
+    - `jax_step(state, f, a0, a1) -> (state', legal)`: the same transition
+      written in jnp over an (state_width,) int32 array — MUST be
+      vmap/jit-compatible: no Python control flow on traced values.
+    - `interner`: maps packed value codes back to real values for
+      counterexample reporting.
+    """
+
+    name: str
+    state_width: int
+    init_state: tuple[int, ...]
+    encode: OpEncoderFn
+    py_step: Callable[[tuple[int, ...], int, int, int], tuple[tuple[int, ...], bool]]
+    jax_step: Callable[..., Any]
+    interner: Interner
+    #: optional pretty-printer for a packed op row
+    describe_op: Optional[Callable[[int, int, int], str]] = None
+    #: optional soundness gate: given the PackedOps about to be
+    #: searched, return None when the packed form is exact for this
+    #: history, or a reason string when it is not (e.g. a bounded-
+    #: capacity queue whose capacity the history could exceed) — the
+    #: checker then falls back to the host-model search.
+    validate_packed: Optional[Callable[..., Optional[str]]] = None
+    #: optional batched transition `(states (state_width, B) i32, f,
+    #: a0, a1) -> (states', legal (B,))` — LANE-MAJOR (beam lanes on
+    #: the trailing axis) and written WITHOUT scatter ops (no
+    #: `.at[...].set` — use masked `jnp.where` over rows): the Pallas
+    #: witness sweep (ops/wgl_witness.py) lowers this through Mosaic,
+    #: which rejects the scatters `vmap(jax_step)` produces and
+    #: sub-32-bit / lane<->sublane relayouts.  Models without one
+    #: simply stay on the XLA-scan sweep.
+    jax_step_rows: Optional[Callable[..., Any]] = None
+    #: optional columnar facets for the sound non-linearizability
+    #: screens (checker/refute.py): PackedOps -> RefuteView.  Models
+    #: without a register-like assert/produce structure leave it None
+    #: and skip the screens.
+    refute_view: Optional[Callable[..., Any]] = None
+
+
+def intern_value(interner: Interner, v: Any) -> int:
+    """Interns an op payload value to an int32 code.  Hashable required;
+    unhashable payloads (lists) are converted to tuples."""
+    if isinstance(v, list):
+        v = tuple(v)
+    return interner.intern(v)

@@ -1,0 +1,104 @@
+"""Mutex model (knossos.model/mutex; listed in SURVEY.md §2.4 as a model
+the rebuild must provide, exercised by BASELINE.json config 2)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..history.core import Op
+from ..history.packed import NIL, Interner
+from .base import Model, PackedModel, inconsistent
+
+F_ACQUIRE, F_RELEASE = 0, 1
+
+
+class Mutex(Model):
+    __slots__ = ("locked", "_packed_cache")
+
+    def __init__(self, locked: bool = False):
+        self.locked = locked
+
+    def step(self, op: Op):
+        if op.f == "acquire":
+            if self.locked:
+                return inconsistent("cannot acquire held mutex")
+            return Mutex(True)
+        if op.f == "release":
+            if not self.locked:
+                return inconsistent("cannot release free mutex")
+            return Mutex(False)
+        return inconsistent(f"unknown op f {op.f!r}")
+
+    def __eq__(self, other):
+        return type(other) is Mutex and other.locked == self.locked
+
+    def __hash__(self):
+        return hash(("Mutex", self.locked))
+
+    def __repr__(self):
+        return f"Mutex(locked={self.locked})"
+
+    def _compile_packed(self) -> PackedModel:
+        interner = Interner()
+        interner.intern(None)
+        init = (1 if self.locked else 0,)
+
+        def encode(inv: Op, comp: Optional[Op]):
+            if inv.f == "acquire":
+                return (F_ACQUIRE, NIL, NIL)
+            if inv.f == "release":
+                return (F_RELEASE, NIL, NIL)
+            raise ValueError(f"mutex can't encode op f {inv.f!r}")
+
+        def py_step(state, f, a0, a1):
+            held = state[0]
+            if f == F_ACQUIRE:
+                return (1,), held == 0
+            return (0,), held == 1
+
+        def jax_step(state, f, a0, a1):
+            import jax.numpy as jnp
+
+            held = state[0]
+            is_acq = f == F_ACQUIRE
+            # where() rather than &~: `f` may be a plain Python int
+            # (tests, py callers), and ~bool is deprecated.
+            legal = jnp.where(is_acq, held == 0, held == 1)
+            new = jnp.where(is_acq, 1, 0)
+            return state.at[0].set(new), legal
+
+        def jax_step_rows(states, f, a0, a1):
+            # Scatter-free lane-major form for the Pallas sweep
+            # (states is (1, B)).
+            import jax.numpy as jnp
+
+            held = states[0]
+            is_acq = f == F_ACQUIRE
+            # int32 legality: Mosaic fails to legalize selects that
+            # produce bool vectors (see _make_pallas_sweep).
+            legal = jnp.where(
+                is_acq,
+                (held == 0).astype(jnp.int32),
+                (held == 1).astype(jnp.int32),
+            )
+            new = jnp.where(is_acq, 1, 0)
+            return jnp.broadcast_to(new, held.shape)[None, :], legal
+
+        def describe_op(f: int, a0: int, a1: int) -> str:
+            return "acquire" if f == F_ACQUIRE else "release"
+
+        return PackedModel(
+            name="mutex",
+            state_width=1,
+            init_state=init,
+            encode=encode,
+            py_step=py_step,
+            jax_step=jax_step,
+            interner=interner,
+            describe_op=describe_op,
+            jax_step_rows=jax_step_rows,
+        )
+
+
+def mutex() -> Mutex:
+    return Mutex(False)

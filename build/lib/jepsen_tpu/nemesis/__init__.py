@@ -1,0 +1,53 @@
+"""Fault injection: the Nemesis protocol and stock faults.
+
+Equivalent of /root/reference/jepsen/src/jepsen/nemesis.clj plus the
+nemesis/ subtree (combined packages, clock faults, membership churn).
+"""
+
+from .core import (
+    Compose,
+    FMap,
+    Nemesis,
+    NoopNemesis,
+    Partitioner,
+    Timeout,
+    bisect,
+    bridge,
+    complete_grudge,
+    compose,
+    f_map,
+    invert_grudge,
+    majorities_ring,
+    noop,
+    partition_halves,
+    partition_majorities_ring,
+    partition_random_halves,
+    partition_random_node,
+    partitioner,
+    split_one,
+    timeout,
+)
+
+__all__ = [
+    "Compose",
+    "FMap",
+    "Nemesis",
+    "NoopNemesis",
+    "Partitioner",
+    "Timeout",
+    "bisect",
+    "bridge",
+    "complete_grudge",
+    "compose",
+    "f_map",
+    "invert_grudge",
+    "majorities_ring",
+    "noop",
+    "partition_halves",
+    "partition_majorities_ring",
+    "partition_random_halves",
+    "partition_random_node",
+    "partitioner",
+    "split_one",
+    "timeout",
+]

@@ -1,0 +1,803 @@
+"""Device witness search for linearizability — the valid-verdict fast path.
+
+Round-1 finding: the level-synchronous BFS in ops/wgl.py carries every
+reachable subset of absorbed indeterminate (:info) ops as a distinct
+configuration, so frontier width grows ~2^k with accumulated info ops
+(the deliberately adversarial BASELINE.json 100k-op high-:info config).
+This module is the algorithmic answer: an *event-walk* formulation of
+Wing–Gong (the just-in-time linearization strategy of Lowe's "Testing
+for Linearizability" — the same algorithm family knossos's
+`knossos.wgl/analysis` implements, consumed by the reference at
+jepsen/src/jepsen/checker.clj:214-233):
+
+* Walk :ok operations in completion order.  By induction every :ok op
+  returning before the current barrier is linearized in every surviving
+  config, so the WGL candidate rule — `a` may be linearized iff
+  inv(a) < min ret over non-members — collapses to "invoked before the
+  current barrier's return".
+* At the barrier for op `a`, each config must contain `a`: configs pass
+  (a already linearized as an earlier helper), linearize `a` directly
+  (one model step per beam lane), or linearize a *chain* of helper ops
+  ending in `a`.  Helpers are ops still open at the barrier:
+  indeterminate ops (ret = ∞, never forced) and :ok ops returning later.
+* Chains are found just-in-time, vectorized: a targeted round evaluates
+  every (lane, helper) pair `h·a` in one batched model step; an
+  escalation round expands by any *productive* single helper
+  (state-changing — an unproductive helper child is dominated by its
+  parent), deduplicates children by resulting model state, and retries.
+  Info ops are therefore only linearized at the barrier that needs
+  their effect — the frontier never enumerates subsets of irrelevant
+  info ops.
+
+Execution is shaped by two measured costs (round-2 profiling):
+
+* XLA recompilation: anything shape-polymorphic per block (window
+  width, re-gather permutations) recompiles hundreds of times.  The
+  window width W is therefore fixed for the whole run (the max over
+  blocks, bucketed), so exactly one chunk kernel is compiled, and the
+  between-block member re-layout is a static-shape device gather driven
+  by per-block permutation tensors.
+* Dispatch latency (~20 ms/call over a tunneled TPU): barriers are
+  grouped into blocks of `bars_per_block`, and `blocks_per_call` blocks
+  ship per device call — a 100k-op history runs in ~3 calls.  Inside a
+  call, an outer `lax.scan` over blocks re-lays the window and scans
+  the block's barriers once: the body does the pass/direct step inline
+  (membership of ops whose barrier passed is *implied by barrier rank*,
+  so direct linearizations write no member bits) and enters the heavy
+  chain-search round behind a `lax.cond` only at barriers where the
+  frontier would die.  (An earlier fast-scan/heavy/re-scan split spent
+  ~85% of device time re-walking blocks after each heavy round.)
+
+Soundness: every transition is a legal WGL linearization step, so any
+config alive after the final barrier is a witness — `valid=True` is
+exact.  The search is *not* exhaustive (beam + chain-depth bounded, and
+direct success suppresses early-linearization branches), so a dead
+frontier proves nothing: callers fall back to the exact frontier BFS
+(ops/wgl.py) / CPU DFS (checker/wgl_cpu.py) for invalid/unknown.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+import numpy as np
+
+from ..checker.wgl_cpu import WGLResult
+from ..history.packed import ST_OK, PackedOps
+from ..models.base import PackedModel
+from .wgl import _bucket, window_regather
+
+INF = np.int32(2**31 - 1)
+NO_BAR = np.iinfo(np.int32).max
+
+#: Default per-block bound on indeterminate-op window columns.  Narrow
+#: on purpose: W buckets to 2048 on the bench config (1.8 s vs 3.2 s at
+#: 4096 — round-2 measurement).  check_wgl_device escalates to
+#: WIDE_INFO_WINDOW when a narrow attempt that actually dropped columns
+#: finds no witness.  bench.py's warm-up precompiles via plan_width,
+#: which shares this default — keep them coupled through this constant.
+NARROW_INFO_WINDOW = 512
+WIDE_INFO_WINDOW = 4096
+
+_chunk_fn_cache: dict[tuple, Any] = {}
+
+
+def _state_hash_vec(sw: int, seed: int = 0xA11CE) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.uniform(1.0, 2.0, size=(sw,)).astype(np.float32)
+
+
+def _plan_blocks(packed: PackedOps, bars_per_block: int,
+                 info_window: Optional[int] = None):
+    """Host-side plan: barrier order, per-block active windows.
+
+    `info_window` keeps only the most recently invoked N indeterminate
+    ops in each block's window.  Dropping an info column is SOUND for
+    the witness tier regardless of its membership state — an
+    unlinearized one merely stops being a helper candidate
+    (completeness loss only), and a linearized one keeps its state
+    contribution while becoming un-relinearizable.  Without the bound,
+    info ops accumulate for the whole run (ret = ∞) and the window —
+    hence heavy-round cost — grows linearly with history length: the
+    1M-op bench config reaches W = 65536 unbounded.
+
+    The per-block window is maintained INCREMENTALLY: rows are
+    invocation-ordered, so each block's entrants are the contiguous
+    index range invoked since the previous block (one searchsorted),
+    and its leavers are exactly the barriers that passed in the
+    previous block plus the oldest info rows beyond the bound — both
+    O(window) merges.  A fresh full-history mask per block (the
+    round-1..3 implementation) made planning O(n_blocks * n): at 10M
+    ops it dominated end-to-end time (measured 43.7k ops/s vs 190k at
+    1M, i.e. the checker itself was linear but the planner wasn't).
+
+    Returns (bars, bar_rank, inv32, ret32, blocks, any_dropped);
+    `any_dropped` reports whether any block actually lost info columns
+    to the bound — when False, a wider retry would plan identically."""
+    status = packed.status
+    inv32 = packed.inv.astype(np.int32)
+    ret32 = np.minimum(packed.ret, np.int64(INF)).astype(np.int32)
+    ok_rows = np.nonzero(status == ST_OK)[0]
+    bars = ok_rows[np.argsort(ret32[ok_rows], kind="stable")]
+    bar_rank = np.full(packed.n, NO_BAR, dtype=np.int64)
+    bar_rank[bars] = np.arange(len(bars))
+    is_info = status != ST_OK
+    blocks = []
+    any_dropped = False
+    # active: sorted row indices currently in the window; hi: rows
+    # [0, hi) have entered (inv32 is strictly increasing row-wise).
+    active = np.empty(0, dtype=np.int64)
+    hi = 0
+    for k0 in range(0, len(bars), bars_per_block):
+        block_bars = bars[k0 : k0 + bars_per_block]
+        end_ret = int(ret32[block_bars[-1]])
+        # Leavers: barriers whose rank passed at block start.
+        if k0:
+            passed = bars[k0 - bars_per_block : k0]
+            keep = np.isin(active, passed, assume_unique=True,
+                           invert=True)
+            active = active[keep]
+        # Entrants: invoked before this block's last barrier.  New
+        # rows have larger indices than everything already active, so
+        # concatenation preserves sortedness.
+        # np.int32 key: a python-int key makes numpy CAST THE WHOLE
+        # 10M-row array per call (measured 50 ms vs 6 µs — it was 76%
+        # of end-to-end time at 8M ops).
+        hi_new = int(np.searchsorted(inv32, np.int32(end_ret),
+                                     side="left"))
+        if hi_new > hi:
+            entering = np.arange(hi, hi_new, dtype=np.int64)
+            # Rows whose barrier already passed never join.
+            entering = entering[bar_rank[entering] >= k0]
+            active = np.concatenate([active, entering])
+            hi = hi_new
+        if info_window is not None:
+            info_mask = is_info[active]
+            n_info = int(info_mask.sum())
+            if n_info > info_window:
+                # Keep the newest N info rows; the drop is permanent
+                # ("newest N" is monotone as rows only get newer),
+                # matching the per-block criterion of the full-mask
+                # implementation.
+                drop_pos = np.nonzero(info_mask)[0][: n_info - info_window]
+                active = np.delete(active, drop_pos)
+                any_dropped = True
+        blocks.append((k0, block_bars, active))
+    return bars, bar_rank, inv32, ret32, blocks, any_dropped
+
+
+def plan_width(packed: PackedOps, bars_per_block: int = 1024,
+               info_window: Optional[int] = NARROW_INFO_WINDOW) -> int:
+    """The window width a witness run over `packed` will use — lets a
+    warm-up run pre-compile the same kernel via `width_hint`."""
+    if packed.n == 0 or packed.n_ok == 0:
+        return 0
+    _, _, _, _, blocks, _ = _plan_blocks(packed, bars_per_block,
+                                         info_window)
+    return _bucket(max(max(len(a) for _, _, a in blocks), 1))
+
+
+def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
+               info_window: Optional[int] = NARROW_INFO_WINDOW) -> bool:
+    """Whether a witness plan at this info_window would drop any info
+    columns — when False, a wider window plans identically and an
+    escalation retry is pointless."""
+    if packed.n == 0 or packed.n_ok == 0 or info_window is None:
+        return False
+    if packed.n - packed.n_ok <= info_window:
+        return False  # cheap bound: fewer info ops than the window
+    return _plan_blocks(packed, bars_per_block, info_window)[5]
+
+
+def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
+                       interpret: bool):
+    """The easy-path barrier sweep as a Pallas TPU kernel.
+
+    The XLA `lax.scan` version pays ~30 µs of small-op critical path
+    per barrier (round-2 measurement: 1.36 s for a 47k-barrier 0-info
+    history).  Here the whole sweep runs inside one kernel whose state
+    (member bits, beam states, alive mask) stays on-chip, with a
+    `while_loop` that exits at the first barrier the easy path cannot
+    survive — the heavy chain search stays in XLA and resumes the
+    sweep afterwards.
+
+    Mosaic constraints shape the layout: dynamic per-barrier scalar
+    reads must come from SMEM (VMEM vector loads need statically
+    aligned indices), so the barrier table lives in SMEM and the
+    member matrix is BIT-PACKED to one int32 word per window row
+    ((W,) in SMEM; lane b of the beam is bit b — arithmetic
+    right-shift + &1 extracts bits for any B <= 32).  All vector
+    state is LANE-MAJOR (beam lanes on the 128-lane axis: states
+    (SW, B), masks (1, B)) and 32-bit, because sub-32-bit relayouts
+    and lane<->sublane reshapes don't lower.
+
+    Outputs: states', alive', death (1,1) SMEM i32 — death == K means
+    the block completed; any smaller value is the barrier index whose
+    pass/direct step would have killed the frontier (state/alive
+    returned are from just BEFORE that barrier).  Identical
+    transition semantics to the `easy` branch of the scan path."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    def kernel(start_ref, bars_ref, mbits_ref, states_ref, alive_ref,
+               states_out, alive_out, death_ref):
+        lane = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
+        start = start_ref[0, 0]
+        states0 = states_ref[:]          # (SW, B) i32
+        alive0 = alive_ref[:]            # (1, B) i32 0/1
+
+        # All VECTOR masks are int32 0/1 — Mosaic fails to legalize
+        # selects that produce bool vectors; scalar bools (loop
+        # control) are fine.
+        def cond(c):
+            k, _, _, died = c
+            return jnp.logical_and(k < K, jnp.logical_not(died))
+
+        def body(c):
+            k, states, alive, _ = c
+            a = bars_ref[0, k]
+            real = bars_ref[2, k] != 0   # scalar bool
+            bf = bars_ref[3, k]
+            ba0 = bars_ref[4, k]
+            ba1 = bars_ref[5, k]
+            bits = mbits_ref[a]
+            has = (bits >> lane) & 1                   # (1, B) i32
+            ns, legal_b = jax_step_rows(states, bf, ba0, ba1)
+            legal = legal_b.reshape(1, B).astype(jnp.int32)
+            surv_pass = alive & has
+            surv_dir = alive & (1 - has) & legal
+            new_alive = surv_pass | surv_dir
+            died = real & (new_alive.max() == 0)       # scalar bool
+            commit_i = jnp.where(real & ~died, 1, 0)   # scalar i32
+            take = commit_i * surv_dir                 # (1, B) i32
+            st = jnp.where(take != 0, ns, states)
+            al = commit_i * new_alive + (1 - commit_i) * alive
+            return (jnp.where(died, k, k + 1), st, al, died)
+
+        k, states, alive, died = jax.lax.while_loop(
+            cond, body, (start, states0, alive0, jnp.bool_(False))
+        )
+        states_out[:] = states
+        alive_out[:] = alive
+        death_ref[0, 0] = jnp.where(died, k, K)
+
+    call = pl.pallas_call(
+        kernel,
+        out_shape=(
+            jax.ShapeDtypeStruct((SW, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, B), jnp.int32),
+            jax.ShapeDtypeStruct((1, 1), jnp.int32),
+        ),
+        in_specs=[
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), memory_space=pltpu.SMEM),
+        ),
+        interpret=interpret,
+    )
+
+    def sweep(start_k, bars, member, states, alive):
+        start = jnp.asarray(start_k, jnp.int32).reshape(1, 1)
+        # Pack each member row to one int32 word (lane b -> bit b).
+        mbits = (
+            member.astype(jnp.int32)
+            << jnp.arange(B, dtype=jnp.int32)[None, :]
+        ).sum(axis=1).astype(jnp.int32)
+        s2, al2, dk = call(
+            start, bars, mbits, states.T,
+            alive[None, :].astype(jnp.int32),
+        )
+        return s2.T, al2[0] != 0, dk[0, 0]
+
+    return sweep
+
+
+def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
+                   jax_step, pallas_mode: str = "off",
+                   jax_step_rows=None, compact: int = 0):
+    """One call runs NB blocks of up to K barriers each.
+
+    Args: member (W, B) bool — window-major so the per-barrier
+    membership lookup member[a] is a fast major-axis row slice (a
+    (B, W) layout makes it a minor-axis dynamic gather) —, states
+    (B, SW) i32, alive (B,) bool, failed () bool, and per-block
+    tensors — bars (NB, 6, K) i32 (rows: window col, ret, real, and
+    the barrier op's f/a0/a1 pre-gathered on host so the hot scan does
+    no table lookups), tab (NB, 5, W) i32 (rows: inv, f, a0, a1,
+    bar_rank — the heavy round's helper tables), perm (NB, W) i32 +
+    present (NB, W) bool (member re-layout from the previous block's
+    window), k0s (NB,) i32 (global rank of each block's first
+    barrier).  Padding blocks pass identity perm/present and zero
+    `real` flags and are no-ops.
+
+    The heavy chain search runs INSIDE the barrier scan behind a
+    lax.cond — round-2 profiling showed the earlier design (fast scan
+    to the death point, heavy round, masked re-scan) spent ~85% of
+    device time re-scanning: each of the ~458 heavy rounds on the
+    100k-op bench re-walked up to K barriers.  Inline, every barrier
+    is visited exactly once.
+
+    Flat (helper, lane) pair indexing is helper-major: i = h*B + lane.
+
+    `compact` (static, 0 = off) is the candidate-compaction tile width:
+    round-3 profiling measured 50-90% of the (W, B) pair lanes masked
+    out by `avail` in the chain rounds (which are 85-89% of witness
+    time).  When the number of window rows with ANY available lane fits
+    in `compact`, the heavy round gathers just those rows into a
+    (compact, B) tile — the batched pair-step and the argsort dedup
+    then run over compact*B candidates instead of W*B — and maps the
+    winners back to window columns through the gather index.  Overflow
+    falls back to the uncompacted path behind a lax.cond (the engine's
+    standard escalation pattern), so results are bit-identical.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    col = jnp.arange(W)
+    hv = jnp.asarray(_state_hash_vec(SW))
+    BIG = jnp.float32(3.0e38)
+    M = B * W
+    WC = compact if 0 < compact < W else 0
+
+    pallas_sweep = (
+        _make_pallas_sweep(
+            B, W, SW, K, jax_step_rows,
+            interpret=(pallas_mode == "interpret"),
+        )
+        if pallas_mode != "off"
+        else None
+    )
+
+    def run_block(member, states, alive, bars, tab, k0):
+        inv_w, f_w, a0_w, a1_w, bar_rank_w = (
+            tab[0], tab[1], tab[2], tab[3], tab[4],
+        )
+
+        def pair_steps(states_rep, f_r, a0_r, a1_r):
+            # helper-major: rows h*B+lane pair helper h with lane's state
+            return jax.vmap(jax_step)(
+                states_rep,
+                jnp.repeat(f_r, B),
+                jnp.repeat(a0_r, B),
+                jnp.repeat(a1_r, B),
+            )
+
+        def select_children(member, child_states, good, row_map):
+            """Dedup (helper, lane) children by model state, keep <= B.
+
+            Selection happens over flat-pair scalars FIRST; member
+            columns are materialized only for the <= B winners —
+            building (M, W) child-member matrices up front costs
+            ~B*W*W bytes.  Hash-sort + exact adjacent compare: equal
+            states always hash equal; collisions only cost beam slots.
+            `row_map` maps tile rows back to window columns (identity
+            for the uncompacted path)."""
+            h = jnp.where(good, child_states.astype(jnp.float32) @ hv, BIG)
+            order = jnp.argsort(h)
+            hs = h[order]
+            ss = child_states[order]
+            same = (hs == jnp.roll(hs, 1)) & (
+                ss == jnp.roll(ss, 1, axis=0)
+            ).all(axis=1)
+            same = same.at[0].set(False)
+            uniq = (hs < BIG) & ~same
+            n_child = jnp.minimum(uniq.sum(), B)
+            pos = order[jnp.nonzero(uniq, size=B, fill_value=0)[0]]
+            hcol = row_map[pos // B]
+            lane = pos % B
+            new_member = member[:, lane] | (col[:, None] == hcol[None, :])
+            new_alive = jnp.arange(B) < n_child
+            return new_member, child_states[pos], new_alive
+
+        def heavy(member, states, alive, a, r, bf, ba0, ba1, k_rank):
+            """Chain search at one barrier: direct -> targeted h·a ->
+            expand-any, bounded by chain depth D."""
+            # Membership of ops whose barrier already passed is implied.
+            implied = bar_rank_w < k_rank
+
+            def step_bar(s):
+                return jax_step(s, bf, ba0, ba1)
+
+            def helper_avail(member, alive):
+                # (W, B): helper rows x lanes
+                return (
+                    alive[None, :]
+                    & ~member
+                    & ~implied[:, None]
+                    & (inv_w[:, None] < r)
+                    & (col[:, None] != a)
+                )
+
+            def try_direct(member, states, alive):
+                ns, legal = jax.vmap(step_bar)(states)
+                has = member[a]
+                surv_pass = alive & has
+                surv_dir = alive & ~has & legal
+                new_alive = surv_pass | surv_dir
+                new_states = jnp.where(surv_dir[:, None], ns, states)
+                return member, new_states, new_alive
+
+            def run_tile(member, states, avail, row_map, f_r, a0_r,
+                         a1_r):
+                """One fused escalation over a (R, B) candidate tile:
+                the helper pair-step is evaluated ONCE and feeds both
+                the targeted test (helper+barrier legal -> done) and
+                the expand-any fallback (any productive helper -> keep
+                searching).  Round-2's split version recomputed
+                pair_steps and ran select_children twice behind an
+                extra lax.cond — the chain rounds are ~88% of witness
+                time (see tools/profile_witness.py), so the duplicated
+                work was the engine's single hottest redundancy."""
+                R = row_map.shape[0]
+                flat = avail.reshape(-1)
+                states_rep = jnp.tile(states, (R, 1))
+                s1, legal1 = pair_steps(states_rep, f_r, a0_r, a1_r)
+                s2, legal2 = jax.vmap(step_bar)(s1)
+                good_t = flat & legal1 & legal2
+                ok2 = good_t.any()
+                productive = legal1 & (s1 != states_rep).any(axis=1)
+                good_e = flat & productive
+                child = jnp.where(ok2, s2, s1)
+                good = jnp.where(ok2, good_t, good_e)
+                cm, cs, ca = select_children(member, child, good,
+                                             row_map)
+                return cm, cs, ca, ok2
+
+            def targeted_or_expand(member, states, alive):
+                """Chain-round escalation with candidate compaction:
+                gather the window rows that still have an available
+                (helper, lane) pair into a (WC, B) tile when they fit
+                (the 50-90%-masked common case measured in round 3),
+                else run the full (W, B) tile.  Candidate order is
+                preserved by the ascending gather, so both branches
+                select identical children — the cond trades nothing
+                but compile time."""
+                avail_full = helper_avail(member, alive)  # (W, B)
+                if WC == 0:
+                    return run_tile(member, states, avail_full, col,
+                                    f_w, a0_w, a1_w)
+
+                row_any = avail_full.any(axis=1)
+                n_av = row_any.sum()
+
+                def compact_path(_):
+                    idx = jnp.nonzero(row_any, size=WC,
+                                      fill_value=0)[0]
+                    avail_c = avail_full[idx] & (
+                        jnp.arange(WC) < n_av
+                    )[:, None]
+                    return run_tile(member, states, avail_c, idx,
+                                    f_w[idx], a0_w[idx], a1_w[idx])
+
+                def full_path(_):
+                    return run_tile(member, states, avail_full, col,
+                                    f_w, a0_w, a1_w)
+
+                return jax.lax.cond(n_av <= WC, compact_path,
+                                    full_path, None)
+
+            def cond(c):
+                _, _, alive, done, d = c
+                return (~done) & (d < D) & alive.any()
+
+            def body(c):
+                member, states, alive, _, d = c
+                m1, s1, al1 = try_direct(member, states, alive)
+
+                def on_direct(_):
+                    return m1, s1, al1, True
+
+                def no_direct(_):
+                    return targeted_or_expand(member, states, alive)
+
+                mN, sN, alN, done = jax.lax.cond(
+                    al1.any(), on_direct, no_direct, None
+                )
+                return mN, sN, alN, done, d + 1
+
+            member, states, alive, done, _ = jax.lax.while_loop(
+                cond, body, (member, states, alive, False, 0)
+            )
+            return member, states, alive, done
+
+        if pallas_sweep is not None:
+            # ---- pallas hybrid: VMEM sweep to the next death point,
+            # heavy in XLA, resume — all under one while_loop ----
+            def cond_w(c):
+                k, _, _, _, failed = c
+                return (k < K) & ~failed
+
+            def body_w(c):
+                k, member, states, alive, failed = c
+                s2, al2, dk = pallas_sweep(k, bars, member, states, alive)
+
+                def clean(_):
+                    return jnp.int32(K), member, s2, al2, failed
+
+                def death(_):
+                    colv = jax.lax.dynamic_slice(
+                        bars, (jnp.int32(0), dk), (6, 1)
+                    )[:, 0]
+                    m, s, al, done = heavy(
+                        member, s2, al2, colv[0], colv[1], colv[3],
+                        colv[4], colv[5], k0 + dk,
+                    )
+                    return dk + 1, m, s, al, failed | ~done
+
+                return jax.lax.cond(dk >= K, clean, death, None)
+
+            _, member, states, alive, failed = jax.lax.while_loop(
+                cond_w, body_w,
+                (jnp.int32(0), member, states, alive, jnp.bool_(False)),
+            )
+            return member, states, alive, failed
+
+        # ---- barrier scan: pass/direct inline, heavy behind a cond ----
+        def body(carry, xs):
+            member, states, alive, failed = carry
+            a, r, real, bf, ba0, ba1, k = xs
+            has = member[a]
+            ns, legal = jax.vmap(
+                lambda s: jax_step(s, bf, ba0, ba1)
+            )(states)
+            surv_pass = alive & has
+            surv_dir = alive & ~has & legal
+            new_alive = surv_pass | surv_dir
+            active = (real != 0) & ~failed
+
+            def easy(_):
+                commit = active & new_alive.any()
+                st = jnp.where((commit & surv_dir)[:, None], ns, states)
+                al = jnp.where(commit, new_alive, alive)
+                return member, st, al, failed
+
+            def hard(_):
+                m, s, al, done = heavy(
+                    member, states, alive, a, r, bf, ba0, ba1, k0 + k
+                )
+                return m, s, al, failed | ~done
+
+            out = jax.lax.cond(
+                active & ~new_alive.any(), hard, easy, None
+            )
+            return out, None
+
+        carry0 = (member, states, alive, jnp.bool_(False))
+        (member, states, alive, failed), _ = jax.lax.scan(
+            body, carry0,
+            (bars[0], bars[1], bars[2], bars[3], bars[4], bars[5],
+             jnp.arange(K, dtype=jnp.int32)),
+        )
+        return member, states, alive, failed
+
+    def chunk(member, states, alive, failed, bars, tab, perm, present,
+              k0s):
+        def body(carry, xs):
+            member, states, alive, failed = carry
+            bars_b, tab_b, perm_b, present_b, k0 = xs
+            member = jnp.where(present_b[:, None], member[perm_b],
+                               False)
+
+            def run(_):
+                return run_block(member, states, alive, bars_b, tab_b, k0)
+
+            def skip(_):
+                return member, states, alive, jnp.bool_(False)
+
+            m, s, al, f2 = jax.lax.cond(~failed, run, skip, None)
+            return (m, s, al, failed | f2), None
+
+        (member, states, alive, failed), _ = jax.lax.scan(
+            body, (member, states, alive, failed),
+            (bars, tab, perm, present, k0s),
+        )
+        return member, states, alive, failed
+
+    return jax.jit(chunk)
+
+
+def check_wgl_witness(
+    packed: PackedOps,
+    pm: PackedModel,
+    *,
+    beam: int = 8,  # 16 -> 8 measured 0.70 -> 0.51 s on the 100k bench;
+    # chain diversity above 8 lanes almost never decides a register-
+    # class history, and a died witness still escalates to the exact
+    # tiers.
+    bars_per_block: int = 1024,
+    blocks_per_call: int = 32,
+    depth: int = 5,
+    info_window: Optional[int] = NARROW_INFO_WINDOW,
+    max_window: int = 32768,
+    width_hint: int = 0,
+    time_limit_s: Optional[float] = None,
+    pallas: str = "auto",
+    compact: int = -1,
+) -> Optional[WGLResult]:
+    """Runs the witness search on the default JAX device.
+
+    Returns an exact `WGLResult(valid=True)` when a witness linearization
+    survives, or None when the search dies / overflows / times out —
+    meaning "escalate to the exact search", never "invalid".
+
+    `width_hint` forces at least that window width so a warm-up run can
+    pre-compile the kernels a bigger history will use (see plan_width).
+
+    `pallas`: "auto" runs the easy sweep as a Pallas VMEM kernel on TPU
+    backends and the XLA scan elsewhere; "on"/"interpret"/"off" force a
+    mode ("interpret" is the CPU-testable emulation of the kernel).
+
+    `compact`: chain-round candidate-compaction tile width.  -1 picks
+    max(64, min(W // 2, info_window)) — or max(64, W // 8) when
+    info_window is None: available helpers at a chain round are
+    almost all info columns, which the window bound caps at
+    info_window, so a tile of exactly that width fits nearly every
+    round (measured on the 100k bench config: compact=512 = the
+    narrow window is 2.9x end-to-end vs off, while W//8 = 256
+    overflows to the full tile at most barriers and wins only 7%).
+    0 disables.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    t0 = time.monotonic()
+    n = packed.n
+    if n == 0 or packed.n_ok == 0:
+        return WGLResult(valid=True, configs_explored=1,
+                         elapsed_s=time.monotonic() - t0)
+
+    bars, bar_rank, inv32, ret32, blocks, _ = _plan_blocks(
+        packed, bars_per_block, info_window
+    )
+    n_bars = len(bars)
+    if max(len(a) for _, _, a in blocks) > max_window:
+        return None
+
+    SW = pm.state_width
+    B = _bucket(beam, lo=8)
+    K = bars_per_block
+    D = depth
+    NB = blocks_per_call
+    W = _bucket(max(max(len(a) for _, _, a in blocks), width_hint, 1))
+
+    if pallas not in ("auto", "on", "off", "interpret"):
+        raise ValueError(f"unknown pallas mode {pallas!r}")
+    if pallas == "auto":
+        # devices()[0].platform is "tpu" even under tunneled plugin
+        # platforms whose backend name differs (e.g. axon).
+        pallas = "on" if jax.devices()[0].platform == "tpu" else "off"
+    if pm.jax_step_rows is None or B > 32:
+        # No Mosaic-safe batched step for this model, or the beam no
+        # longer fits the kernel's one-word member bit-packing.
+        pallas = "off"
+
+    if compact < 0:
+        compact = max(64, min(
+            W // 2, info_window if info_window is not None else W // 8
+        ))
+
+    # The step fn itself keys the cache (strong ref): an id() key
+    # can collide after GC address reuse and serve the wrong
+    # model's transition kernel.
+    key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
+    fn = _chunk_fn_cache.get(key)
+    if fn is None:
+        fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
+                            pallas_mode=pallas,
+                            jax_step_rows=pm.jax_step_rows,
+                            compact=compact)
+        _chunk_fn_cache[key] = fn
+
+    member = jnp.zeros((W, B), dtype=bool)
+    states = jnp.tile(
+        jnp.asarray(np.asarray(pm.init_state, dtype=np.int32)), (B, 1)
+    )
+    alive_np = np.zeros(B, dtype=bool)
+    alive_np[0] = True
+    alive = jnp.asarray(alive_np)
+    failed = jnp.bool_(False)
+
+    identity_perm = np.arange(W, dtype=np.int32)
+    prev_active: Optional[np.ndarray] = None
+
+    for c0 in range(0, len(blocks), NB):
+        chunk_blocks = blocks[c0 : c0 + NB]
+        nblk = len(chunk_blocks)
+        bars_np = np.zeros((NB, 6, K), dtype=np.int32)
+        bars_np[:, 1, :] = INF
+        tab_np = np.zeros((NB, 5, W), dtype=np.int32)
+        perm_np = np.tile(identity_perm, (NB, 1))
+        present_np = np.ones((NB, W), dtype=bool)
+        k0s_np = np.zeros(NB, dtype=np.int32)
+
+        for bi, (k0, block_bars, active) in enumerate(chunk_blocks):
+            nw = len(active)
+            nb = len(block_bars)
+            k0s_np[bi] = k0
+            bars_np[bi, 0, :nb] = np.searchsorted(active, block_bars)
+            bars_np[bi, 1, :nb] = ret32[block_bars]
+            bars_np[bi, 2, :nb] = 1
+            bars_np[bi, 3, :nb] = packed.f[block_bars]
+            bars_np[bi, 4, :nb] = packed.a0[block_bars]
+            bars_np[bi, 5, :nb] = packed.a1[block_bars]
+            row = tab_np[bi]
+            row[0, :] = INF
+            row[0, :nw] = inv32[active]
+            row[1, :nw] = packed.f[active]
+            row[2, :nw] = packed.a0[active]
+            row[3, :nw] = packed.a1[active]
+            row[4, :] = NO_BAR
+            row[4, :nw] = np.minimum(bar_rank[active], NO_BAR)
+            if prev_active is None:
+                # Very first block: nothing to re-gather; member is
+                # all-False already, so a full wipe is a no-op.
+                present_np[bi, :] = False
+                perm_np[bi, :] = 0
+            else:
+                perm, present = window_regather(prev_active, active)
+                perm_np[bi, :nw] = perm
+                perm_np[bi, nw:] = 0
+                present_np[bi, :nw] = present
+                present_np[bi, nw:] = False
+            prev_active = active
+
+        try:
+            member, states, alive, failed = fn(
+                member, states, alive, failed,
+                jnp.asarray(bars_np), jnp.asarray(tab_np),
+                jnp.asarray(perm_np), jnp.asarray(present_np),
+                jnp.asarray(k0s_np),
+            )
+            # One sync per chunk (~32k barriers): early exit + time
+            # budget.  The sync ALSO belongs inside the try — jitted
+            # dispatch is asynchronous, so execution-time failures
+            # only raise when a result is consumed.
+            failed_now = bool(failed)
+        except Exception:
+            if pallas != "on":
+                raise
+            # A Mosaic compile or transient runtime failure on the
+            # tunneled chip must not cost the verdict: evict the
+            # kernel and restart this search on the XLA-scan sweep.
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "pallas sweep failed; retrying witness on the XLA "
+                "scan sweep", exc_info=True,
+            )
+            _chunk_fn_cache.pop(key, None)
+            if time_limit_s is not None:
+                remaining = time_limit_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    return None  # budget blown: escalate directly
+            else:
+                remaining = None
+            return check_wgl_witness(
+                packed, pm, beam=beam, bars_per_block=bars_per_block,
+                blocks_per_call=blocks_per_call, depth=depth,
+                info_window=info_window, max_window=max_window,
+                width_hint=width_hint, time_limit_s=remaining,
+                pallas="off", compact=compact,
+            )
+        if failed_now:
+            return None
+        if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
+            return None
+
+    if not bool(alive.any()):
+        return None
+    return WGLResult(
+        valid=True,
+        configs_explored=n_bars,
+        elapsed_s=time.monotonic() - t0,
+    )

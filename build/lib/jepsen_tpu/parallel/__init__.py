@@ -1,0 +1,26 @@
+"""Parallelism over the TPU mesh: per-key independent checking (the
+`jepsen.independent` equivalent, with keys sharded across devices) and
+mesh helpers."""
+
+from .independent import (
+    KV,
+    IndependentChecker,
+    history_keys,
+    independent_checker,
+    kv,
+    subhistories,
+    tuple_gen,
+)
+from .mesh import checker_mesh, default_mesh
+
+__all__ = [
+    "KV",
+    "IndependentChecker",
+    "history_keys",
+    "independent_checker",
+    "kv",
+    "subhistories",
+    "tuple_gen",
+    "checker_mesh",
+    "default_mesh",
+]

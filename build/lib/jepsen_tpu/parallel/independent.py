@@ -1,0 +1,330 @@
+"""Per-key independent checking — `jepsen.independent`, TPU-sharded.
+
+The reference lifts single-key workloads to many keys: op values become
+`(k, v)` tuples, the history is split into per-key subhistories, and each
+key is checked independently under a bounded thread pool
+(/root/reference/jepsen/src/jepsen/independent.clj:27, :259-325,
+:327-377).  This module keeps the same host API but re-designs the
+compute: when the base checker is a packed-model linearizability check,
+all keys are packed into one padded batch and decided by a single
+vmapped + shard_mapped device search (ops/wgl_batched.py) — per-key data
+parallelism across the TPU mesh instead of a JVM thread pool.
+
+Generator-side lifting (`sequential_generator`/`concurrent_generator`,
+independent.clj:37-257) lives in jepsen_tpu.generator.independent, next
+to the generator machinery it builds on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Iterable, NamedTuple, Optional
+
+from ..checker.core import Checker, check_safe, merge_valid
+from ..checker.linearizable import Linearizable
+from ..history.core import History, Op
+from ..utils import bounded_pmap
+
+
+class KV(NamedTuple):
+    """A `[key value]` tuple op payload (independent.clj:18-35).  A
+    distinct type — not a plain tuple — so multi-argument payloads like
+    cas `(old, new)` aren't mistaken for keyed values."""
+
+    key: Any
+    value: Any
+
+    def __repr__(self) -> str:
+        return f"[{self.key!r} {self.value!r}]"
+
+
+def kv(key: Any, value: Any) -> KV:
+    return KV(key, value)
+
+
+def is_kv(v: Any) -> bool:
+    return isinstance(v, KV)
+
+
+def tuple_gen(key: Any, value: Any) -> KV:
+    """Alias mirroring `independent/tuple`."""
+    return KV(key, value)
+
+
+def history_keys(h: History) -> list:
+    """All keys in KV-valued ops, in first-seen order
+    (independent.clj:259-269)."""
+    seen: dict[Any, None] = {}
+    for o in h:
+        if is_kv(o.value):
+            seen.setdefault(o.value.key, None)
+    return list(seen)
+
+
+def subhistories(h: History) -> dict[Any, History]:
+    """Splits a history into per-key histories, unwrapping KV values
+    (independent.clj:271-325).  Completions that lost their KV payload
+    (e.g. an :info with value None) inherit the key of their process's
+    pending invocation.  Ops keep their original indices, so per-key
+    results can cite positions in the full history."""
+    per_key: dict[Any, list[Op]] = {}
+    pending: dict[Any, Any] = {}  # process -> key
+    for o in h:
+        k = None
+        if is_kv(o.value):
+            k = o.value.key
+            if o.is_invoke:
+                pending[o.process] = k
+            else:
+                pending.pop(o.process, None)
+        elif not o.is_invoke and o.process in pending:
+            k = pending.pop(o.process)
+        if k is None:
+            continue
+        v = o.value.value if is_kv(o.value) else o.value
+        per_key.setdefault(k, []).append(o.replace(value=v))
+    return {k: History(ops, reindex=False) for k, ops in per_key.items()}
+
+
+class IndependentChecker(Checker):
+    """Applies `base` to each key's subhistory and merges validity
+    (independent.clj:327-377).
+
+    Fast path: if `base` is a Linearizable checker whose model packs to
+    int32 form, every key is packed and decided in one batched device
+    search sharded over the mesh; only keys the beam search could not
+    settle fall back to the exact CPU search (still sound).  Any other
+    checker runs per-key under bounded_pmap, like the reference.
+    """
+
+    def __init__(self, base: Checker, *, bound: Optional[int] = None):
+        self.base = base
+        self.bound = bound
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        subs = subhistories(history)
+        keys = list(subs)
+        if not keys:
+            return {"valid": True, "results": {}, "key-count": 0}
+
+        results: dict[Any, dict]
+        if isinstance(self.base, Linearizable):
+            results = self._check_linearizable(test, subs, opts)
+        else:
+            rs = bounded_pmap(
+                lambda k: check_safe(
+                    self.base, test, subs[k], {**opts, "history_key": k}
+                ),
+                keys,
+                bound=self.bound,
+            )
+            results = dict(zip(keys, rs))
+
+        valid = merge_valid(r.get("valid") for r in results.values())
+        failures = [k for k, r in results.items() if r.get("valid") is False]
+        self._write_key_artifacts(opts, subs, results)
+        return {
+            "valid": valid,
+            "key-count": len(keys),
+            "failures": failures[:32],
+            "failure-count": len(failures),
+            "results": results,
+        }
+
+    #: Per-key artifact budget: failed keys always write; passing keys
+    #: only up to this many (the reference writes every key's dir,
+    #: independent.clj:355-364, but per-key workloads here can carry
+    #: tens of thousands of keys).
+    MAX_OK_KEY_DIRS = 256
+
+    def _write_key_artifacts(self, opts: dict, subs: dict,
+                             results: dict) -> None:
+        """store/<test>/independent/<key>/{results.json,history.txt}
+        per key, like the reference's per-key dirs.  Failures never
+        raise: a side-output must not change the verdict."""
+        import json
+        import logging
+        import os
+
+        import hashlib
+
+        from ..utils import sanitize_path_part
+
+        directory = (opts or {}).get("dir")
+        if not directory:
+            return
+        log = logging.getLogger(__name__)
+
+        def jsonable_keys(x):
+            # json.dump coerces dict VALUES via default=, never KEYS;
+            # skipkeys would silently drop diagnostic entries.
+            if isinstance(x, dict):
+                return {
+                    k if isinstance(k, str) else repr(k):
+                        jsonable_keys(v)
+                    for k, v in x.items()
+                }
+            if isinstance(x, (list, tuple)):
+                return [jsonable_keys(v) for v in x]
+            return x
+
+        ok_written = 0
+        used: set = set()
+        for k, res in results.items():
+            # Only fully-passing keys count against the budget:
+            # False AND "unknown" verdicts are exactly the ones a
+            # maintainer must inspect, so they always write.
+            budgeted = res.get("valid") is True
+            if budgeted and ok_written >= self.MAX_OK_KEY_DIRS:
+                continue
+            safe = sanitize_path_part(k)[:80]
+            if safe in used:
+                # Disambiguate truncation collisions with a stable
+                # digest of the full key, keeping names bounded.
+                digest = hashlib.sha1(
+                    repr(k).encode()
+                ).hexdigest()[:10]
+                safe = f"{safe[:69]}-{digest}"
+            used.add(safe)
+            # Per-key isolation: one key's write failure (quota,
+            # unserializable value, hostile op repr) must neither
+            # skip later keys nor — via check_safe — replace the
+            # computed verdict with "unknown".
+            try:
+                d = os.path.join(directory, "independent", safe)
+                os.makedirs(d, exist_ok=True)
+                with open(os.path.join(d, "results.json"), "w") as f:
+                    json.dump(jsonable_keys(res), f, indent=2,
+                              default=repr)
+                with open(os.path.join(d, "history.txt"), "w",
+                          errors="replace") as f:
+                    for o in subs.get(k, ()):
+                        f.write(str(o) + "\n")
+                if budgeted:
+                    ok_written += 1  # only successful writes consume budget
+            except Exception as e:  # noqa: BLE001 — side output only
+                log.warning(
+                    "could not write artifacts for key %r: %r", k, e
+                )
+
+    # -- batched device path ------------------------------------------------
+
+    def _check_linearizable(
+        self, test: dict, subs: dict[Any, History], opts: dict
+    ) -> dict[Any, dict]:
+        lin = self.base
+        model = lin.model or test.get("model")
+        keys = list(subs)
+        try:
+            pm = model.packed()
+        except (NotImplementedError, AttributeError):
+            pm = None
+        if pm is None or lin.algorithm in ("wgl", "linear", "cpu", "event"):
+            rs = bounded_pmap(
+                lambda k: check_safe(
+                    lin, test, subs[k], {**opts, "history_key": k}
+                ),
+                keys,
+                bound=self.bound,
+            )
+            return dict(zip(keys, rs))
+
+        from ..history.packed import pack_history
+        from ..ops.wgl_batched import check_wgl_batched
+        from .mesh import checker_mesh
+
+        all_packs = {}
+        unpackable = []
+        for k in keys:
+            try:
+                p = pack_history(subs[k], pm.encode)
+            except ValueError:
+                # e.g. an indeterminate dequeue: no packed form for
+                # this key — the single-key checker falls back to the
+                # host-model search itself.
+                unpackable.append(k)
+                continue
+            if pm.validate_packed is not None and \
+                    pm.validate_packed(p) is not None:
+                unpackable.append(k)
+                continue
+            all_packs[k] = p
+        results_unpack: dict[Any, dict] = {}
+        if unpackable:
+            rs = bounded_pmap(
+                lambda k: check_safe(
+                    lin, test, subs[k], {**opts, "history_key": k}
+                ),
+                unpackable,
+                bound=self.bound,
+            )
+            results_unpack = dict(zip(unpackable, rs))
+            keys = [k for k in keys if k in all_packs]
+            if not keys:
+                return results_unpack
+        # Long keys skip the batched kernel entirely: its compile/pad
+        # cost scales with the LONGEST key, and the single-history
+        # witness-first path (check_wgl_device) is built for length.
+        long_keys = [k for k in keys if all_packs[k].n > 2000]
+        keys = [k for k in keys if all_packs[k].n <= 2000]
+        results_long: dict[Any, dict] = {}
+        if long_keys:
+            long_chk = Linearizable(
+                model, "wgl-tpu",
+                beam=lin.beam, max_beam=lin.max_beam,
+                time_limit_s=lin.time_limit_s,
+                max_configs=lin.max_configs,
+            )
+            rs = bounded_pmap(
+                lambda k: check_safe(
+                    long_chk, test, subs[k], {**opts, "history_key": k}
+                ),
+                long_keys,
+                bound=self.bound,
+            )
+            results_long = dict(zip(long_keys, rs))
+            if not keys:
+                return {**results_unpack, **results_long}
+
+        packs = [all_packs[k] for k in keys]
+        mesh = checker_mesh(test)
+        # Start the beam small — per-key histories are short, and the
+        # overflow-retry doubles straight up to the configured beam.
+        batch = check_wgl_batched(
+            packs,
+            pm,
+            beam=min(lin.beam, 256),
+            max_beam=max(lin.max_beam, lin.beam),
+            mesh=mesh,
+            time_limit_s=lin.time_limit_s,
+        )
+
+        results: dict[Any, dict] = {**results_unpack, **results_long}
+        for i, k in enumerate(keys):
+            v = batch.valid[i]
+            if v is True:
+                results[k] = {
+                    "valid": True,
+                    "algorithm": "wgl-tpu-batched",
+                    "configs-explored": int(batch.explored[i]),
+                }
+            else:
+                # invalid or unknown: settle on CPU for the exact verdict
+                # and the counterexample detail (per-key histories are
+                # short; checker.clj renders these via knossos.linear.report).
+                # "cpu" auto-routes info-heavy keys to the event-walk
+                # engine, which settles cases the memoized DFS cannot.
+                single = Linearizable(
+                    model,
+                    "cpu",
+                    time_limit_s=lin.time_limit_s,
+                    max_configs=lin.max_configs,
+                )
+                r = check_safe(single, test, subs[k], {**opts, "history_key": k})
+                r["algorithm"] = "wgl-tpu-batched+cpu"
+                r["device-verdict"] = v
+                results[k] = r
+        return results
+
+
+def independent_checker(base: Checker, **kw: Any) -> IndependentChecker:
+    return IndependentChecker(base, **kw)

@@ -1,0 +1,64 @@
+"""Device mesh construction for checker sharding.
+
+The reference's parallel axis is JVM threads under `bounded-pmap`
+(independent.clj:346-367); ours is a `jax.sharding.Mesh` whose "keys"
+axis carries independent per-key searches.  One mesh axis suffices:
+per-key WGL has no cross-key communication, so any physical topology
+(v5e-8 ring, multi-host DCN) works — XLA never inserts collectives into
+the hot loop.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_mesh_cache: dict = {}
+
+
+def default_mesh(n_devices: Optional[int] = None, axis: str = "keys"):
+    """A 1-D mesh over (the first n) local devices.  Memoized: device
+    kernel caches key on mesh identity, so repeated checks must see the
+    same Mesh object."""
+    key = (n_devices, axis)
+    mesh = _mesh_cache.get(key)
+    if mesh is not None:
+        return mesh
+
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    devs = jax.devices()
+    if n_devices is not None:
+        devs = devs[:n_devices]
+    mesh = Mesh(np.asarray(devs), (axis,))
+    _mesh_cache[key] = mesh
+    return mesh
+
+
+def shard_map_compat():
+    """(shard_map, replication-check kwargs) across jax versions: the
+    stable `jax.shard_map` (>= 0.8) renamed check_rep -> check_vma.
+    Checking is disabled either way — checker outputs are fully
+    sharded or psum-replicated by construction.  Single shim for the
+    three shard_map call sites (wgl, wgl_batched, scc)."""
+    try:
+        from jax import shard_map  # jax >= 0.8
+
+        return shard_map, {"check_vma": False}
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map
+
+        return shard_map, {"check_rep": False}
+
+
+def checker_mesh(test: Optional[dict] = None):
+    """The mesh a checker should use: the test map's "mesh" entry if set,
+    else all local devices, else None for single-device."""
+    if test and test.get("mesh") is not None:
+        return test["mesh"]
+    import jax
+
+    if len(jax.devices()) > 1:
+        return default_mesh()
+    return None

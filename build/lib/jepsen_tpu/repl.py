@@ -1,0 +1,42 @@
+"""Interactive exploration namespace (repl.clj's role): one import
+that brings the whole toolkit into scope for a REPL session.
+
+    >>> from jepsen_tpu.repl import *
+    >>> t = store.load(store.latest())
+    >>> h = History(list(t.iter_ops()))
+    >>> checker.linearizable(models.cas_register()).check({}, h, {})
+"""
+
+from jepsen_tpu import (  # noqa: F401
+    checker,
+    cli,
+    client,
+    codec,
+    core,
+    db,
+    faketime,
+    fs_cache,
+    generator,
+    lazyfs,
+    models,
+    nemesis,
+    net,
+    oses,
+    reconnect,
+    report,
+    store,
+    web,
+)
+from jepsen_tpu.control import (  # noqa: F401
+    DummyRemote,
+    LocalRemote,
+    Session,
+    SshCliRemote,
+    on_nodes,
+    with_sessions,
+)
+from jepsen_tpu.history import History, Op, history  # noqa: F401
+from jepsen_tpu.parallel.independent import (  # noqa: F401
+    KV,
+    independent_checker,
+)

@@ -1,0 +1,21 @@
+"""End-to-end test suites against real systems.
+
+Equivalent of the reference's per-database projects (SURVEY.md §2.5 —
+zookeeper/, etcd/, ...): each suite module provides a DB
+implementation, a network client, workload assembly, and a CLI `main`,
+following the zookeeper/src/jepsen/zookeeper.clj shape.
+"""
+
+from . import kvdb
+
+__all__ = ["kvdb", "logd", "repkv", "txnd"]
+
+
+def __getattr__(name):
+    # Lazy: repkv/logd/txnd pull in checker stacks; importing the
+    # package should not.
+    if name in ("logd", "repkv", "txnd"):
+        import importlib
+
+        return importlib.import_module(f".{name}", __name__)
+    raise AttributeError(name)

@@ -1,0 +1,381 @@
+"""General-purpose utilities for jepsen-tpu.
+
+Host-side equivalents of the reference's `jepsen.util`
+(/root/reference/jepsen/src/jepsen/util.clj): parallel maps with meaningful
+exception selection, time bookkeeping, retry/timeout helpers, majorities,
+interval-set rendering.  Everything here is pure Python; no JAX.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import itertools
+import math
+import random
+import threading
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Iterator, Sequence, TypeVar
+
+T = TypeVar("T")
+U = TypeVar("U")
+
+
+# ---------------------------------------------------------------------------
+# Parallel maps
+# ---------------------------------------------------------------------------
+
+
+def real_pmap(f: Callable[[T], U], xs: Iterable[T]) -> list[U]:
+    """Maps f over xs with one thread per element, returning results in
+    order.  If any call throws, raises the first *meaningful* exception
+    (preferring non-interrupt errors), like `jepsen.util/real-pmap`
+    (util.clj:71-83).  Used for per-node control-plane fan-out."""
+    xs = list(xs)
+    if not xs:
+        return []
+    results: list[Any] = [None] * len(xs)
+    errors: list[tuple[int, BaseException]] = []
+    lock = threading.Lock()
+
+    def run(i: int, x: T) -> None:
+        try:
+            results[i] = f(x)
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            with lock:
+                errors.append((i, e))
+
+    threads = [
+        threading.Thread(target=run, args=(i, x), daemon=True)
+        for i, x in enumerate(xs)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        # Prefer a non-KeyboardInterrupt error, like real-pmap prefers
+        # non-InterruptedException.
+        errors.sort(key=lambda ie: (isinstance(ie[1], KeyboardInterrupt), ie[0]))
+        raise errors[0][1]
+    return results
+
+
+def bounded_pmap(f: Callable[[T], U], xs: Iterable[T], bound: int | None = None) -> list[U]:
+    """Parallel map over xs with at most `bound` concurrent workers
+    (default: cpu count + 2), preserving order.  Mirrors the reference's
+    `bounded-pmap` used by `jepsen.independent/checker`
+    (independent.clj:346-367)."""
+    import os
+
+    xs = list(xs)
+    if not xs:
+        return []
+    if bound is None:
+        bound = (os.cpu_count() or 4) + 2
+    with ThreadPoolExecutor(max_workers=bound) as pool:
+        return list(pool.map(f, xs))
+
+
+# ---------------------------------------------------------------------------
+# Time
+# ---------------------------------------------------------------------------
+
+#: Conversions, mirroring util.clj:380-407.
+NANOS_PER_MS = 1_000_000
+NANOS_PER_SECOND = 1_000_000_000
+
+_relative_time_origin = threading.local()
+
+
+@contextlib.contextmanager
+def with_relative_time() -> Iterator[None]:
+    """Binds a nanosecond-resolution time origin for `relative_time_nanos`
+    (util.clj:397-407, bound at core.clj:400)."""
+    old = getattr(_relative_time_origin, "origin", None)
+    _relative_time_origin.origin = _time.monotonic_ns()
+    try:
+        yield
+    finally:
+        _relative_time_origin.origin = old
+
+
+def relative_time_nanos() -> int:
+    """Nanoseconds since the enclosing `with_relative_time` (or process-start
+    monotonic clock if unbound)."""
+    origin = getattr(_relative_time_origin, "origin", None)
+    if origin is None:
+        return _time.monotonic_ns()
+    return _time.monotonic_ns() - origin
+
+
+def ms_to_nanos(ms: float) -> int:
+    return int(ms * NANOS_PER_MS)
+
+
+def nanos_to_ms(ns: float) -> float:
+    return ns / NANOS_PER_MS
+
+def nanos_to_secs(ns: float) -> float:
+    return ns / NANOS_PER_SECOND
+
+
+def sleep_ms(ms: float) -> None:
+    """High-resolution-ish sleep (util.clj:409-428)."""
+    _time.sleep(ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
+# Control flow
+# ---------------------------------------------------------------------------
+
+
+class JepsenTimeout(Exception):
+    """Raised when a `timeout`-bounded call exceeds its budget."""
+
+
+def timeout(ms: float, f: Callable[[], T], *, default: Any = JepsenTimeout) -> T:
+    """Runs f in a worker thread with a deadline, like the `timeout` macro
+    (util.clj:430-441).  On expiry returns `default` (or raises
+    JepsenTimeout when no default given).  The worker thread is abandoned
+    (Python cannot safely kill threads), matching the advisory nature of
+    the reference's thread interrupt."""
+    box: list[Any] = []
+    err: list[BaseException] = []
+
+    def run() -> None:
+        try:
+            box.append(f())
+        except BaseException as e:  # noqa: BLE001
+            err.append(e)
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    t.join(ms / 1000.0)
+    if t.is_alive():
+        if default is JepsenTimeout:
+            raise JepsenTimeout(f"timed out after {ms} ms")
+        return default
+    if err:
+        raise err[0]
+    return box[0]
+
+
+class RetryExhausted(Exception):
+    pass
+
+
+def with_retry(
+    f: Callable[[], T],
+    *,
+    retries: int = 5,
+    backoff_ms: float = 100.0,
+    jitter: float = 0.5,
+    retry_on: tuple[type[BaseException], ...] = (Exception,),
+    log: Callable[[str], None] | None = None,
+) -> T:
+    """Calls f, retrying up to `retries` times with randomized backoff,
+    like `with-retry` (util.clj:487-527) and the SSH retry policy
+    (control/retry.clj:15-21: 5 retries, ~100 ms)."""
+    attempt = 0
+    while True:
+        try:
+            return f()
+        except retry_on as e:
+            attempt += 1
+            if attempt > retries:
+                raise
+            if log:
+                log(f"retry {attempt}/{retries} after {type(e).__name__}: {e}")
+            _time.sleep(backoff_ms * (1 + jitter * random.random()) / 1000.0)
+
+
+def await_fn(
+    f: Callable[[], T],
+    *,
+    retry_interval_ms: float = 1000.0,
+    timeout_ms: float = 60_000.0,
+    log_interval_ms: float | None = 10_000.0,
+    log_message: str | None = None,
+    log: Callable[[str], None] | None = None,
+) -> T:
+    """Invokes f until it returns without throwing; throws JepsenTimeout when
+    the deadline passes.  Logs progress via `log` every `log_interval_ms`
+    (util.clj:443-485; defaults to the stdlib logger)."""
+    if log is None:
+        import logging
+
+        log = logging.getLogger("jepsen_tpu").info
+    deadline = _time.monotonic() + timeout_ms / 1000.0
+    last_log = _time.monotonic()
+    while True:
+        try:
+            return f()
+        except Exception as e:
+            now = _time.monotonic()
+            if now > deadline:
+                raise JepsenTimeout(
+                    log_message or f"await_fn timed out after {timeout_ms} ms"
+                ) from e
+            if log_interval_ms and (now - last_log) * 1000 >= log_interval_ms:
+                last_log = now
+                log(log_message or f"waiting for {getattr(f, '__name__', 'fn')}")
+            _time.sleep(retry_interval_ms / 1000.0)
+
+
+# ---------------------------------------------------------------------------
+# Math / collections
+# ---------------------------------------------------------------------------
+
+
+def majority(n: int) -> int:
+    """Smallest integer strictly greater than half of n; majority(0) == 1
+    (util.clj:90-97)."""
+    return max(1, n // 2 + 1)
+
+
+def chunks(xs: Sequence[T], size: int) -> Iterator[Sequence[T]]:
+    for i in range(0, len(xs), size):
+        yield xs[i : i + size]
+
+
+def integer_interval_set_str(xs: Iterable[int]) -> str:
+    """Renders a set of integers as compact interval notation, e.g.
+    #{1..3 5 7..9} (util.clj:691-721)."""
+    xs = sorted(set(xs))
+    parts: list[str] = []
+    i = 0
+    while i < len(xs):
+        j = i
+        while j + 1 < len(xs) and xs[j + 1] == xs[j] + 1:
+            j += 1
+        if j == i:
+            parts.append(str(xs[i]))
+        else:
+            parts.append(f"{xs[i]}..{xs[j]}")
+        i = j + 1
+    return "#{" + " ".join(parts) + "}"
+
+
+def rand_exp(rate: float, rng: random.Random | None = None) -> float:
+    """Exponentially-distributed random value with given rate; used by
+    stagger-style generators (generator.clj:1346-1361)."""
+    r = (rng or random).random()
+    return -math.log(1.0 - r) / rate
+
+
+def nemesis_intervals(history: Iterable[Any], start_fs=("start",), stop_fs=("stop",)) -> list[tuple[Any, Any]]:
+    """Pairs of [start-op stop-op] for nemesis activity windows
+    (util.clj:780-826).  Like the reference: consecutive ops pair up as
+    (invoke, completion) — pairs with mismatched :f are dropped — every
+    open start pair is closed by the next stop pair (start1 start2
+    start3 start4 stop1 stop2 yields [s1 e1] [s2 e2] [s3 e1] [s4 e2]),
+    and unclosed intervals pair with None.
+
+    Like the reference (util.clj:803-805), the input is filtered to
+    nemesis ops first — the strict stride-2 pairing would misalign on
+    any interleaved client op.  Contract note: callers passing
+    synthetic ops without a `process` field (pre-round-2 behavior
+    accepted "any objects with .f attributes") fall back to unfiltered
+    pairing, so a nemesis-only synthetic history keeps yielding
+    intervals instead of silently returning []."""
+    history = list(history)
+    ops = [
+        o for o in history
+        if getattr(o, "process", None) == "nemesis"
+    ]
+    if not ops:
+        # Only the process-less ops join the fallback: client ops with
+        # real process ids must never enter the stride-2 pairing (the
+        # misalignment the nemesis filter exists to prevent).
+        ops = [
+            o for o in history
+            if getattr(o, "process", None) is None and hasattr(o, "f")
+        ]
+    pairs = [
+        (ops[i], ops[i + 1])
+        for i in range(0, len(ops) - 1, 2)
+        if getattr(ops[i], "f", None) == getattr(ops[i + 1], "f", None)
+    ]
+    intervals: list[tuple[Any, Any]] = []
+    open_starts: list[tuple[Any, Any]] = []
+    for a, b in pairs:
+        f = getattr(a, "f", None)
+        if f in start_fs:
+            open_starts.append((a, b))
+        elif f in stop_fs:
+            for s1, s2 in open_starts:
+                intervals.append((s1, a))
+                intervals.append((s2, b))
+            open_starts = []
+    for s1, s2 in open_starts:
+        intervals.append((s1, None))
+        intervals.append((s2, None))
+    return intervals
+
+
+def name_thread(name: str) -> contextlib.AbstractContextManager[None]:
+    """Temporarily renames the current thread (util.clj:723-735), useful in
+    log lines."""
+
+    @contextlib.contextmanager
+    def ctx() -> Iterator[None]:
+        t = threading.current_thread()
+        old = t.name
+        t.name = name
+        try:
+            yield
+        finally:
+            t.name = old
+
+    return ctx()
+
+
+def coll_str(x: Any, limit: int = 8) -> str:
+    """Abbreviated rendering of long collections for log lines."""
+    try:
+        xs = list(x)
+    except TypeError:
+        return repr(x)
+    if len(xs) <= limit:
+        return repr(xs)
+    return f"[{', '.join(map(repr, xs[:limit]))}, ... ({len(xs)} total)]"
+
+
+class Forgettable:
+    """A reference that can forget its value, letting the head of a
+    long generator chain be GC'd during a run (util.clj:1037-1066)."""
+
+    __slots__ = ("_value", "_forgotten")
+
+    def __init__(self, value: Any):
+        self._value = value
+        self._forgotten = False
+
+    def deref(self) -> Any:
+        if self._forgotten:
+            raise ValueError("value has been forgotten")
+        return self._value
+
+    def forget(self) -> None:
+        self._value = None
+        self._forgotten = True
+
+
+def fraction(num: float, denom: float) -> float:
+    """num/denom, but 0 when denom is 0 (checker.clj fraction helper)."""
+    return num / denom if denom else 0.0
+
+
+def sanitize_path_part(part: Any) -> str:
+    """One safe filesystem path component from an arbitrary value:
+    hostile characters become underscores, and names that are empty or
+    all dots (".", "..", "" — which would escape or collapse the
+    parent directory) are fully underscored.  Shared by the fs cache
+    and per-key artifact writers."""
+    import re
+
+    s = re.sub(r"[^A-Za-z0-9._-]", "_", str(part))
+    if not s or set(s) <= {"."}:
+        return "_" * max(1, len(s))
+    return s

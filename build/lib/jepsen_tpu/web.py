@@ -1,0 +1,194 @@
+"""Web dashboard: browse stored test runs over HTTP.
+
+Equivalent of /root/reference/jepsen/src/jepsen/web.clj: an index of
+runs with name, time, and validity (:51-66 cached rows), per-run file
+listings, and file serving.  Stdlib http.server instead of
+http-kit/hiccup; no external deps.
+"""
+
+from __future__ import annotations
+
+import html
+import http.server
+import json
+import logging
+import os
+import urllib.parse
+from typing import Optional
+
+from . import store
+
+log = logging.getLogger(__name__)
+
+_STYLE = """
+body { font-family: sans-serif; margin: 2em; }
+table { border-collapse: collapse; }
+td, th { padding: 0.3em 1em; border-bottom: 1px solid #ddd; text-align: left; }
+.valid-true { color: #0a0; } .valid-false { color: #a00; }
+.valid-unknown { color: #a60; }
+a { text-decoration: none; }
+"""
+
+
+#: {run_dir: (jtpu mtime, validity)} so the index doesn't re-scan every
+#: test file on every page load (web.clj:51-66 caches its rows too).
+_validity_cache: dict[str, tuple[float, str]] = {}
+
+
+def _validity(run_dir: str) -> str:
+    jtpu = os.path.join(run_dir, store.TEST_FILE)
+    try:
+        mtime = os.path.getmtime(jtpu)
+    except OSError:
+        return "?"
+    cached = _validity_cache.get(run_dir)
+    if cached is not None and cached[0] == mtime:
+        return cached[1]
+    try:
+        tf = store.load(run_dir)
+        try:
+            res = tf.results
+            v = "?" if res is None else str(res.get("valid"))
+        finally:
+            tf.close()
+    except Exception:  # noqa: BLE001
+        v = "?"
+    _validity_cache[run_dir] = (mtime, v)
+    return v
+
+
+def _page(title: str, body: str) -> bytes:
+    return (
+        f"<!doctype html><html><head><meta charset='utf-8'>"
+        f"<title>{html.escape(title)}</title><style>{_STYLE}</style>"
+        f"</head><body><h1>{html.escape(title)}</h1>{body}</body></html>"
+    ).encode()
+
+
+class Handler(http.server.BaseHTTPRequestHandler):
+    store_dir = "store"
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet
+        log.debug("web: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, ctype: str = "text/html") -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+        path = urllib.parse.unquote(self.path.split("?", 1)[0])
+        try:
+            if path in ("/", ""):
+                self._index()
+            elif path.startswith("/files/"):
+                self._file(path[len("/files/"):])
+            elif path.startswith("/zip/"):
+                self._zip(path[len("/zip/"):])
+            else:
+                self._send(404, _page("404", "<p>not found</p>"))
+        except BrokenPipeError:
+            pass
+        except Exception as e:  # noqa: BLE001
+            log.exception("web error")
+            self._send(500, _page("error", f"<pre>{html.escape(repr(e))}</pre>"))
+
+    def _index(self) -> None:
+        rows = []
+        for name, runs in sorted(store.tests(self.store_dir).items()):
+            for t, d in sorted(runs.items(), reverse=True):
+                v = _validity(d)
+                rel = os.path.relpath(d, self.store_dir)
+                q = urllib.parse.quote(rel)
+                rows.append(
+                    f"<tr><td><a href='/files/{q}/'>"
+                    f"{html.escape(name)}</a></td>"
+                    f"<td>{html.escape(t)}</td>"
+                    f"<td class='valid-{html.escape(v.lower())}'>{html.escape(v)}</td>"
+                    f"<td><a href='/zip/{q}'>zip</a></td></tr>"
+                )
+        body = (
+            "<table><tr><th>test</th><th>time</th><th>valid?</th>"
+            "<th></th></tr>"
+            + "".join(rows)
+            + "</table>"
+        )
+        self._send(200, _page("jepsen-tpu store", body))
+
+    def _zip(self, rel: str) -> None:
+        """Streams a test dir as a zip (web.clj's zip download).  Built
+        in a spooled temp file (large runs would double in RSS as a
+        BytesIO) and each member is realpath-checked like _file so a
+        symlink inside a run dir can't pull outside files into the
+        archive."""
+        import shutil
+        import tempfile
+        import zipfile
+
+        root = os.path.realpath(self.store_dir)
+        target = os.path.realpath(os.path.join(root, rel.strip("/")))
+        if not (target.startswith(root + os.sep) and os.path.isdir(target)):
+            self._send(404, _page("404", "<p>not found</p>"))
+            return
+        with tempfile.TemporaryFile() as buf:
+            with zipfile.ZipFile(buf, "w", zipfile.ZIP_DEFLATED) as z:
+                for dirpath, _dirs, files in os.walk(target):
+                    for fn in files:
+                        full = os.path.join(dirpath, fn)
+                        real = os.path.realpath(full)
+                        if not real.startswith(root + os.sep):
+                            continue  # symlink escaping the store
+                        z.write(real, os.path.relpath(full, target))
+            size = buf.tell()
+            buf.seek(0)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/zip")
+            self.send_header("Content-Length", str(size))
+            self.end_headers()
+            shutil.copyfileobj(buf, self.wfile)
+
+    def _file(self, rel: str) -> None:
+        # Resolve inside the store dir only.
+        root = os.path.realpath(self.store_dir)
+        target = os.path.realpath(os.path.join(root, rel))
+        if not target.startswith(root + os.sep) and target != root:
+            self._send(403, _page("403", "<p>forbidden</p>"))
+            return
+        if os.path.isdir(target):
+            entries = []
+            for e in sorted(os.listdir(target)):
+                q = urllib.parse.quote(os.path.join(rel, e).strip("/"))
+                entries.append(f"<li><a href='/files/{q}'>{html.escape(e)}</a></li>")
+            self._send(200, _page(rel or "store", f"<ul>{''.join(entries)}</ul>"))
+        elif os.path.isfile(target):
+            with open(target, "rb") as f:
+                data = f.read()
+            ctype = (
+                "application/json"
+                if target.endswith(".json")
+                else "text/plain; charset=utf-8"
+            )
+            self._send(200, data, ctype)
+        else:
+            self._send(404, _page("404", "<p>not found</p>"))
+
+
+def make_server(
+    store_dir: str = "store", host: str = "127.0.0.1", port: int = 8080
+) -> http.server.ThreadingHTTPServer:
+    handler = type("BoundHandler", (Handler,), {"store_dir": store_dir})
+    return http.server.ThreadingHTTPServer((host, port), handler)
+
+
+def serve(store_dir: str = "store", *, host: str = "0.0.0.0", port: int = 8080) -> None:
+    srv = make_server(store_dir, host, port)
+    log.info("serving %s on http://%s:%d/", store_dir, host, port)
+    print(f"Serving {store_dir} on http://{host}:{port}/")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.server_close()

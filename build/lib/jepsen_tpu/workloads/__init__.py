@@ -1,0 +1,36 @@
+"""Stock workloads: {generator, checker, model, client?} maps.
+
+Equivalent of the reference's /root/reference/jepsen/src/jepsen/tests/
+subtree — each module exposes a `workload(opts) -> dict` whose keys are
+merged into a test map (the tests/bank.clj:178-191 pattern), plus an
+in-memory reference client so every workload runs whole-stack in CI
+(tests.clj:26-66 atom-client strategy).
+"""
+
+from . import (
+    adya,
+    append,
+    bank,
+    causal,
+    causal_reverse,
+    cycle,
+    kafka,
+    linearizable_register,
+    long_fork,
+    register_set,
+    wr,
+)
+
+__all__ = [
+    "adya",
+    "append",
+    "bank",
+    "causal",
+    "causal_reverse",
+    "cycle",
+    "kafka",
+    "linearizable_register",
+    "long_fork",
+    "register_set",
+    "wr",
+]

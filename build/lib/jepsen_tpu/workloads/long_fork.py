@@ -1,0 +1,128 @@
+"""Long-fork anomaly workload.
+
+Equivalent of /root/reference/jepsen/src/jepsen/tests/long_fork.clj
+(spec in its docstring :1-60): writers write each register key exactly
+once; readers read a whole group of n keys in one txn.  Under parallel
+snapshot isolation, two reads can observe the writes in contradictory
+orders — read A sees w1 but not w2 while read B sees w2 but not w1 —
+the "long fork" (an instance of G2).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+from collections import defaultdict
+from typing import Any, Optional
+
+from .. import client as jc
+from ..checker.core import Checker
+from ..generator.core import FnGen
+from ..history import OK, History
+
+
+def read_txn_mops(op_value) -> Optional[dict]:
+    """{k: v} for a read txn's mops, or None for a write txn."""
+    if not op_value:
+        return None
+    if any(m[0] != "r" for m in op_value):
+        return None
+    return {m[1]: m[2] for m in op_value}
+
+
+class LongForkChecker(Checker):
+    """Finds contradictory read pairs (long_fork.clj:62-250 condensed:
+    with single-write-per-key groups, two group reads fork iff each
+    sees a write the other missed)."""
+
+    def check(self, test: dict, history: History, opts: dict) -> dict:
+        reads_by_group: dict[frozenset, list] = defaultdict(list)
+        for op in history:
+            if not (op.is_ok and op.f == "txn"):
+                continue
+            r = read_txn_mops(op.value)
+            if r is not None and len(r) > 1:
+                reads_by_group[frozenset(r.keys())].append((op.index, r))
+
+        forks = []
+        for group, reads in reads_by_group.items():
+            for i in range(len(reads)):
+                for j in range(i + 1, len(reads)):
+                    ia, ra = reads[i]
+                    ib, rb = reads[j]
+                    # a key A saw written that B didn't, and vice versa
+                    a_ahead = any(
+                        ra[k] is not None and rb[k] is None for k in group
+                    )
+                    b_ahead = any(
+                        rb[k] is not None and ra[k] is None for k in group
+                    )
+                    if a_ahead and b_ahead:
+                        forks.append(
+                            {"ops": [ia, ib], "reads": [ra, rb]}
+                        )
+        return {
+            "valid": not forks,
+            "early-read-count": sum(len(v) for v in reads_by_group.values()),
+            "fork-count": len(forks),
+            "forks": forks[:8],
+        }
+
+
+class InMemoryLongForkClient(jc.Client):
+    """Atomic txn store over registers."""
+
+    def __init__(self, state=None, lock=None):
+        self.state = state if state is not None else {}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return InMemoryLongForkClient(self.state, self.lock)
+
+    def invoke(self, test, op):
+        with self.lock:
+            out = []
+            for f, k, v in op.value:
+                if f == "w":
+                    self.state[k] = v
+                    out.append([f, k, v])
+                else:
+                    out.append(["r", k, self.state.get(k)])
+            return op.complete(OK, value=out)
+
+    def reusable(self, test):
+        return True
+
+
+def generator(group_size: int = 2, rng: Optional[random.Random] = None):
+    """Write each key of the current group once (value 1), read whole
+    groups; move to a fresh group when exhausted
+    (long_fork.clj:252-332)."""
+    rng = rng or random.Random()
+    state = {"group": 0, "written": set()}
+
+    def step():
+        g = state["group"]
+        keys = list(range(g * group_size, (g + 1) * group_size))
+        unwritten = [k for k in keys if k not in state["written"]]
+        if unwritten and rng.random() < 0.4:
+            k = rng.choice(unwritten)
+            state["written"].add(k)
+            if not [x for x in keys if x not in state["written"]]:
+                state["group"] = g + 1
+            return {"f": "txn", "value": [["w", k, 1]]}
+        return {"f": "txn", "value": [["r", k, None] for k in keys]}
+
+    return FnGen(step)
+
+
+def workload(opts: Optional[dict] = None) -> dict:
+    opts = opts or {}
+    n = opts.get("group-size", 2)
+    return {
+        "name": "long-fork",
+        "generator": generator(n, random.Random(opts.get("seed"))),
+        "checker": LongForkChecker(),
+        "client": InMemoryLongForkClient(),
+    }

@@ -103,7 +103,7 @@ static std::string run_txn(const std::vector<ReadOp> &reads,
     std::lock_guard<std::mutex> lk(g_mu);
     snap = g_commit_seq;
     for (size_t i = 0; i < reads.size(); i++) {
-      long long v;
+      long long v = 0;  // read_at leaves it untouched on miss
       results[i].first = read_at(reads[i].key, snap, &v);
       results[i].second = v;
     }

@@ -49,7 +49,10 @@ def ensure_header() -> None:
         )
 
 
-def capture_bench() -> None:
+def capture_bench() -> bool:
+    """True only when a TPU measurement actually landed (the
+    last-good artifact exists) — a failed capture must NOT stop the
+    watcher from retrying on the next healthy probe."""
     log_line("probe=ok -> running bench.py to capture TPU measurement")
     try:
         proc = subprocess.run(
@@ -61,6 +64,10 @@ def capture_bench() -> None:
         log_line(f"bench rc={proc.returncode}: {line}")
     except subprocess.TimeoutExpired:
         log_line("bench TIMED OUT (1800 s) despite ok probe")
+        return False
+    return os.path.exists(
+        os.path.join(REPO, "BENCH_TPU_LAST_GOOD.json")
+    )
 
 
 def main() -> int:
@@ -76,8 +83,7 @@ def main() -> int:
         result = probe_chip()
         log_line(f"probe={result} ({time.time() - t0:.1f}s)")
         if result == "ok" and not captured:
-            capture_bench()
-            captured = True
+            captured = capture_bench()
         if args.once:
             return 0
         time.sleep(max(1.0, args.interval - (time.time() - t0)))

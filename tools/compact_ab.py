@@ -26,13 +26,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--ops", type=int, default=100_000)
-    ap.add_argument("--reps", type=int, default=3)
+    ap.add_argument("--reps", type=int, default=3,
+                    help="measured reps after the warm-up (min 1)")
     ap.add_argument("--info", type=float, default=0.05)
     ap.add_argument("--procs", type=int, default=16)
     ap.add_argument("--compact", type=int, nargs="*",
                     default=[0, -1])
     ap.add_argument("--platform", default="cpu")
     args = ap.parse_args()
+    if args.reps < 1:
+        ap.error("--reps must be >= 1 (rep 0 is the warm-up)")
 
     import jax
 
