@@ -65,12 +65,16 @@ def unseen_series(ops: list[Op]) -> list[tuple[float, int]]:
     return _downsample(series)
 
 
-def lag_series(ops: list[Op]) -> dict[Any, list[tuple[float, int]]]:
+def lag_series(ops: list[Op], orders: Optional[dict] = None,
+               ) -> dict[Any, list[tuple[float, int]]]:
     """{process: [(t_seconds, lag)]} — at each completed poll, how many
     version-order positions the polled value sits behind the newest
     value sent so far on that key; a process's point is its worst key.
-    Index-based analogue of the reference's realtime consumer lag."""
-    orders, _ = version_orders(ops, reads_by_type(ops))
+    Index-based analogue of the reference's realtime consumer lag.
+    `orders` accepts a precomputed version-order map so one analysis
+    pass can serve every artifact."""
+    if orders is None:
+        orders, _ = version_orders(ops, reads_by_type(ops))
     newest: dict[Any, int] = {}
     out: dict[Any, list[tuple[float, int]]] = defaultdict(list)
     for op in ops:
@@ -162,6 +166,11 @@ def write_artifacts(result: dict, opts: Optional[dict],
         out = os.path.join(directory, "kafka")
         os.makedirs(out, exist_ok=True)
 
+        # One version-order inference serves the lag plot AND the
+        # divergence artifact below (each previously recomputed it on
+        # top of analyze()'s own pass).
+        orders, _ = version_orders(ops, reads_by_type(ops))
+
         series = unseen_series(ops)
         with open(os.path.join(out, "unseen.json"), "w") as f:
             json.dump(
@@ -169,7 +178,8 @@ def write_artifacts(result: dict, opts: Optional[dict],
                 f, indent=2, default=repr,
             )
         _plot_unseen(series, os.path.join(out, "unseen.svg"))
-        _plot_lag(lag_series(ops), os.path.join(out, "realtime-lag.svg"))
+        _plot_lag(lag_series(ops, orders),
+                  os.path.join(out, "realtime-lag.svg"))
 
         if result.get("valid") is True:
             return
@@ -192,7 +202,6 @@ def write_artifacts(result: dict, opts: Optional[dict],
             if isinstance(d, dict)
         }
         if divergent:
-            orders, _ = version_orders(ops, reads_by_type(ops))
             with open(os.path.join(out, "version-orders.json"),
                       "w") as f:
                 json.dump(
