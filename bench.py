@@ -330,7 +330,8 @@ def main() -> int:
             # it too is killed mid-rep with no JSON line (same
             # requirement as the wedged-probe clamp above).
             env2 = dict(env, JEPSEN_BENCH_PLATFORM="cpu",
-                        JEPSEN_BENCH_TIME_LIMIT="90")
+                        JEPSEN_BENCH_TIME_LIMIT="90",
+                        JEPSEN_BENCH_TPU_PROBE="wedged_midrun")
             try:
                 proc = subprocess.run(
                     [sys.executable, os.path.abspath(__file__)],

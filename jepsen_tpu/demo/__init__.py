@@ -1,4 +1,4 @@
-"""The demo systems under test: four small C++ servers, each built to
+"""The demo systems under test: five small C++ servers, each built to
 exhibit one canonical distributed-systems bug class for the framework
 to convict (SURVEY.md §2.5's per-database-suite role):
 
@@ -11,6 +11,10 @@ to convict (SURVEY.md §2.5's per-database-suite role):
   write-behind loses acked records on SIGKILL (logs).
 * ``txnd.cpp``  — MVCC snapshot isolation; first-committer-wins
   admits textbook write skew (transactions).
+* ``electd.cpp`` — bully-style leader election with no fencing;
+  partitions split-brain it and heal discards one side's acked
+  writes (election / lost updates); ``--quorum`` swaps in ABD
+  majority rounds as the linearizable control group.
 
 Shipped as package data so the suites (jepsen_tpu/suites/) can upload
 and compile them on nodes from any install, not just a repo checkout;

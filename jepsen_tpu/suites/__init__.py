@@ -8,13 +8,13 @@ following the zookeeper/src/jepsen/zookeeper.clj shape.
 
 from . import kvdb
 
-__all__ = ["kvdb", "logd", "repkv", "txnd"]
+__all__ = ["electd", "kvdb", "logd", "repkv", "txnd"]
 
 
 def __getattr__(name):
-    # Lazy: repkv/logd/txnd pull in checker stacks; importing the
-    # package should not.
-    if name in ("logd", "repkv", "txnd"):
+    # Lazy: electd/repkv/logd/txnd pull in checker stacks; importing
+    # the package should not.
+    if name in ("electd", "logd", "repkv", "txnd"):
         import importlib
 
         return importlib.import_module(f".{name}", __name__)

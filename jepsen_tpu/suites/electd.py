@@ -1,0 +1,432 @@
+"""electd suite: split-brain leader election against a real system.
+
+The classic shape of the reference's published findings (the
+partition-induced split-brain write loss its suites were built to
+catch): jepsen_tpu/demo/electd.cpp elects a leader by
+lowest-reachable-id heartbeats with no terms and no fencing.  A
+partition isolating the lowest-id node leaves BOTH sides with a
+self-believed leader; both acknowledge writes; on heal the higher-id
+leader steps down and adopts the survivor's state wholesale, silently
+discarding every write it acked during the split.  The linearizability
+checker (checker/linearizable.py — the knossos equivalent,
+checker.clj:202-233) convicts those acked-then-lost updates.
+
+The control group (--quorum) ignores leadership entirely and runs ABD
+majority reads/writes — linearizable by construction — so the SAME
+partition schedule that convicts unsafe mode leaves quorum mode valid.
+ABD covers read/write registers only (CAS needs consensus, which
+electd deliberately lacks), so the quorum workload is rw-only; the
+unsafe workload includes CAS.
+
+Partitions use ElectdNet: the `Net` protocol over electd's
+BLOCK/UNBLOCK admin commands (the suites/repkv.py pattern) — the same
+declarative partition packages drive either transport, and the netns
+cluster can substitute kernel-enforced routes.
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+from typing import Any
+
+from .. import cli as jcli
+from .. import client as jc
+from .. import db as jdb
+from .. import demo as _demo
+from .. import net as jnet
+from ..checker.linearizable import Linearizable
+from ..control import Session
+from ..control import util as cutil
+from ..generator.core import (
+    mix,
+    nemesis as gen_nemesis,
+    phases,
+    stagger,
+    time_limit,
+)
+from ..history import FAIL, INFO, OK
+from ..models import cas_register
+from ..nemesis.combined import nemesis_package
+
+ELECTD_SRC = _demo.source("electd")
+BASE_PORT = 7500
+
+
+def node_index(test: dict, node: str) -> int:
+    return (test.get("nodes") or []).index(node)
+
+
+def node_port(test: dict, node: str) -> int:
+    return test.get("electd-base-port", BASE_PORT) + 1 + node_index(test, node)
+
+
+def node_dir(test: dict, node: str) -> str:
+    root = test.get("electd-dir", "/tmp/jepsen-electd")
+    return f"{root}/{node}"
+
+
+def node_host(test: dict, node: str) -> str:
+    if test.get("electd-local", True):
+        return "127.0.0.1"
+    alias = (test.get("node-addresses") or {}).get(node)
+    if alias:
+        return alias
+    from ..control.core import split_host_port
+
+    host, _ = split_host_port(node)
+    return host
+
+
+def _admin_round_trip(test: dict, node: str, line: str,
+                      timeout: float = 1.0) -> str:
+    with socket.create_connection(
+        (node_host(test, node), node_port(test, node)), timeout=timeout
+    ) as s:
+        f = s.makefile("rw", newline="\n")
+        f.write(line + "\n")
+        f.flush()
+        return (f.readline() or "").strip()
+
+
+class ElectdDB(jdb.DB):
+    """Compile + daemonize one election group member per node."""
+
+    def _paths(self, test: dict, node: str) -> dict:
+        d = node_dir(test, node)
+        return {
+            "dir": d,
+            "src": f"{d}/electd.cpp",
+            "bin": f"{d}/electd",
+            "pid": f"{d}/electd.pid",
+            "log": f"{d}/electd.log",
+        }
+
+    def setup(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        sess.exec("mkdir", "-p", p["dir"])
+        sess.upload(os.path.abspath(ELECTD_SRC), p["src"])
+        sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        self.start(test, sess, node)
+        cutil.await_tcp_port(
+            sess, node_port(test, node), timeout_s=30, interval_s=0.1
+        )
+
+    def start(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        nodes = test.get("nodes") or []
+        me = node_index(test, node)
+        peers = ",".join(
+            f"{i}@{node_host(test, n)}:{node_port(test, n)}"
+            for i, n in enumerate(nodes)
+            if n != node
+        )
+        args = [
+            "--id", str(me),
+            "--port", str(node_port(test, node)),
+            "--peers", peers,
+            "--stale-ms", str(test.get("electd-stale-ms", 400)),
+        ]
+        if not test.get("electd-local", True):
+            args += ["--listen", "0.0.0.0"]
+        if test.get("electd-quorum"):
+            args.append("--quorum")
+        cutil.start_daemon(
+            sess, p["bin"], *args, pidfile=p["pid"], logfile=p["log"]
+        )
+        try:
+            cutil.await_tcp_port(
+                sess, node_port(test, node), timeout_s=10, interval_s=0.05
+            )
+        except Exception:  # noqa: BLE001 — best-effort, like kvdb
+            pass
+
+    def kill(self, test: dict, sess: Session, node: str) -> None:
+        cutil.stop_daemon(sess, self._paths(test, node)["pid"],
+                          signal="KILL")
+
+    def primaries(self, test: dict):
+        out = []
+        for node in test.get("nodes") or []:
+            try:
+                if _admin_round_trip(test, node, "ROLE") == "LEADER":
+                    out.append(node)
+            except OSError:
+                continue
+        return out
+
+    def teardown(self, test: dict, sess: Session, node: str) -> None:
+        p = self._paths(test, node)
+        cutil.stop_daemon(sess, p["pid"])
+        if not test.get("leave-db-running"):
+            sess.exec("rm", "-rf", p["dir"])
+
+    def log_files(self, test: dict, sess: Session, node: str):
+        return [self._paths(test, node)["log"]]
+
+
+class ElectdNet(jnet.Net):
+    """The Net protocol over electd's BLOCK/UNBLOCK admin commands."""
+
+    def drop(self, test: dict, src: str, dest: str) -> None:
+        _admin_round_trip(test, dest, f"BLOCK {node_index(test, src)}",
+                          timeout=2.0)
+
+    def heal(self, test: dict) -> None:
+        for node in test.get("nodes") or []:
+            try:
+                _admin_round_trip(test, node, "UNBLOCK *", timeout=2.0)
+            except OSError:
+                continue  # killed node: nothing to heal
+
+
+class ElectdClient(jc.Client):
+    """Talks ONLY to its own node — the reference suites' canonical
+    topology (client i bound to node i).  A node that does not
+    currently claim leadership answers ERR notleader and the op fails
+    cleanly; when a partition makes the client's node promote itself,
+    this client's writes land on that side's leader.  That bound-
+    client traffic is what turns a split brain into acked-then-lost
+    updates the checker can convict — a discovery client that chased
+    "the" leader cluster-wide would pile every op onto the surviving
+    side and hide the bug.
+
+    Completion semantics: a response is definitive (OK -> ok,
+    FAIL/NIL/notleader -> fail: the op certainly did not apply).  A
+    quorum timeout on a mutation is indeterminate — ABD phase 2 may
+    have stored the value on a minority that a later read write-back
+    resurrects — so SET/CAS map noquorum and dead connections to
+    info, never fail.  Reads have no effect and may fail freely.
+    """
+
+    def __init__(self, key: str = "x"):
+        self.key = key
+        self.sock = None
+        self.node: Any = None
+
+    def open(self, test, node):
+        c = ElectdClient(self.key)
+        c.node = node
+        try:
+            c.sock = self._dial(test, node)
+        except OSError:
+            c.sock = None
+        return c
+
+    def _dial(self, test, node):
+        s = socket.create_connection(
+            (node_host(test, node), node_port(test, node)), timeout=2.0
+        )
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s.makefile("rw", newline="\n")
+
+    def _round_trip(self, line: str) -> str:
+        if self.sock is None:
+            raise ConnectionError("no connection")
+        self.sock.write(line + "\n")
+        self.sock.flush()
+        resp = self.sock.readline()
+        if not resp:
+            raise ConnectionError("electd closed the connection")
+        return resp.strip()
+
+    def _req(self, test, line: str, retry: bool) -> str:
+        """One request, optionally with a single redial of the SAME
+        node (covers a killed-and-restarted server, never another
+        node).  Mutations must NOT retry: the first attempt may have
+        applied before the connection died, and a resend that answers
+        notleader/FAIL would then misclassify an applied op as failed
+        — the retry is for reads only, mutations surface the OSError
+        so invoke() completes them info."""
+        try:
+            return self._round_trip(line)
+        except OSError:
+            if not retry:
+                raise
+            self.sock = self._dial(test, self.node)
+            return self._round_trip(line)
+
+    def invoke(self, test, op):
+        mutation = op.f in ("write", "cas")
+        try:
+            if op.f == "read":
+                resp = self._req(test, f"GET {self.key}", retry=True)
+            elif op.f == "write":
+                resp = self._req(test, f"SET {self.key} {op.value}",
+                                 retry=False)
+            else:
+                old, new = op.value
+                resp = self._req(test, f"CAS {self.key} {old} {new}",
+                                 retry=False)
+        except OSError as e:
+            try:
+                # Dead socket: leave a fresh connection for the next op
+                # (the interpreter reuses this client after an info).
+                self.sock = self._dial(test, self.node)
+            except OSError:
+                self.sock = None
+            if mutation:
+                return op.complete(INFO, error=str(e))
+            return op.complete(FAIL, error=str(e))
+
+        if op.f == "read":
+            if resp == "NIL":
+                return op.complete(OK, value=None)
+            if resp.startswith("VAL "):
+                return op.complete(OK, value=int(resp.split(" ", 1)[1]))
+            return op.complete(FAIL, error=resp)
+        if resp == "OK":
+            return op.complete(OK)
+        if resp in ("FAIL", "NIL") or resp == "ERR notleader":
+            return op.complete(FAIL, error=resp)
+        if mutation:
+            # noquorum / unknown: phase 2 may have partially stored.
+            return op.complete(INFO, error=resp)
+        return op.complete(FAIL, error=resp)
+
+    def close(self, test):
+        if self.sock is not None:
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def electd_test(opts: dict) -> dict:
+    """Test-map assembly (zookeeper.clj:112-137 shape)."""
+    import itertools
+    import random
+
+    nodes = (opts.get("nodes") or ["n1", "n2", "n3"])[:5]
+    faults = set(
+        opts["faults"] if opts.get("faults") is not None
+        else ["partition"]
+    )
+    quorum = bool(opts.get("quorum"))
+    if quorum and "kill" in faults:
+        # ABD is linearizable over PARTITIONS only: electd keeps no
+        # stable storage, so a killed-and-restarted replica reboots
+        # empty and a later majority can miss an acked write (crash
+        # amnesia).  That is real physics, but it would convict the
+        # control group for a reason outside the unsafe-vs-quorum
+        # contrast this suite exists to demonstrate — refuse the
+        # combination rather than quietly invert the experiment.
+        raise ValueError(
+            "--quorum is the partition control group; combine kill "
+            "faults with the default (unsafe) mode instead"
+        )
+    rng = random.Random(opts.get("seed"))
+    counter = itertools.count(1)
+
+    last_write = {"v": 1}
+
+    def workload_gen():
+        def write():
+            v = next(counter)
+            last_write["v"] = v
+            return {"f": "write", "value": v}
+
+        gens = [
+            lambda: {"f": "read", "value": None},
+            write,
+        ]
+        if not quorum:
+            # ABD is rw-only; CAS exercises the unsafe leader path.
+            # Expected-old values come from the recent write window so
+            # a fraction of CAS ops actually succeed and constrain the
+            # history (an old value the register never held would make
+            # every CAS a no-signal FAIL).
+            def cas():
+                hi = last_write["v"]
+                return {"f": "cas",
+                        "value": (rng.randrange(max(1, hi - 10), hi + 1),
+                                  next(counter))}
+
+            gens.append(cas)
+        return mix(gens)
+
+    pkg = nemesis_package({
+        "faults": faults,
+        "interval": opts.get("interval", 3.0),
+        # isolate-one partitions: the split-brain trigger is the
+        # lowest-id node landing alone, which "one" hits 1/n of the
+        # time per cycle.
+        "partition": {"targets": opts.get("partition-targets",
+                                          ["one", "majority"])},
+    })
+    generator = time_limit(
+        opts.get("time-limit", 15.0),
+        gen_nemesis(
+            pkg["generator"],
+            stagger(1.0 / opts.get("rate", 100), workload_gen()),
+        ),
+    )
+    if pkg.get("final-generator"):
+        generator = phases(generator, gen_nemesis(pkg["final-generator"]))
+
+    store_root = os.path.abspath(opts.get("store-dir") or "store")
+    return {
+        "name": "electd-register",
+        "nodes": nodes,
+        "db": ElectdDB(),
+        "net": ElectdNet(),
+        "client": ElectdClient(),
+        "nemesis": pkg["nemesis"],
+        "generator": generator,
+        "model": cas_register(),
+        "checker": Linearizable(
+            algorithm=opts.get("algorithm", "wgl-tpu"),
+            time_limit_s=60.0,
+        ),
+        "electd-quorum": quorum,
+        "electd-stale-ms": opts.get("stale-ms", 400),
+        "electd-dir": opts.get("electd-dir") or os.path.join(
+            store_root, "electd-data"
+        ),
+        "electd-base-port": cutil.hashed_base_port(store_root, BASE_PORT),
+    }
+
+
+def _extra_opts(p) -> None:
+    p.add_argument("--faults", action="append", default=None,
+                   choices=["partition", "kill"])
+    p.add_argument("--rate", type=float, default=100.0)
+    p.add_argument("--interval", type=float, default=3.0)
+    p.add_argument("--quorum", action="store_true",
+                   help="ABD majority reads/writes (the control group)")
+    p.add_argument("--stale-ms", type=int, default=400)
+    p.add_argument("--algorithm", default="wgl-tpu",
+                   choices=["cpu", "wgl", "wgl-tpu"])
+
+
+def main(argv=None) -> int:
+    def _localize(t: dict) -> dict:
+        from ..control import LocalRemote
+
+        t.setdefault("remote", LocalRemote())
+        return t
+
+    def suite(opt_map: dict) -> dict:
+        return _localize(electd_test(opt_map))
+
+    def all_suites(opt_map: dict):
+        """test-all: the split-brain conviction run and its ABD quorum
+        control group (cli.clj:501-529 pattern)."""
+        for quorum in (False, True):
+            o = dict(opt_map, quorum=quorum)
+            t = _localize(electd_test(o))
+            t["name"] = ("electd-register-quorum" if quorum
+                         else "electd-register-unsafe")
+            yield t
+
+    parser = jcli.single_test_cmd(
+        suite, name="electd", extra_opts=_extra_opts,
+        tests_fn=all_suites,
+    )
+    return jcli.run(parser, argv)
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())
