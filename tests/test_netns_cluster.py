@@ -192,9 +192,12 @@ def test_routenet_rate_shape(cluster):
         assert "tbf" not in out
 
 
-def run_repkv_netns(cluster, tmp_path, **opts):
+def run_suite_netns(cluster, tmp_path, test_fn, local_key, **opts):
+    """Run a suite's test map across the namespace cluster: the
+    overlay binds the netns transport AND the kernel-level RouteNet,
+    overriding the suite's app-level BLOCK net; `<suite>-local` False
+    makes nodes listen 0.0.0.0 with peers on the real IPs."""
     from jepsen_tpu import core
-    from jepsen_tpu.suites import repkv
 
     o = {
         "nodes": cluster.nodes,
@@ -205,14 +208,19 @@ def run_repkv_netns(cluster, tmp_path, **opts):
         "algorithm": "wgl-tpu",
     }
     o.update(opts)
-    test = repkv.repkv_test(o)
-    # The overlay binds the netns transport AND the kernel-level
-    # RouteNet — overriding repkv's app-level BLOCK net.
+    test = test_fn(o)
     test.update(cluster.test_overlay())
-    test["repkv-local"] = False  # listen 0.0.0.0, advertise real IP
+    test[local_key] = False
     test["concurrency"] = o.get("concurrency", 6)
     test["store-dir"] = o["store-dir"]
     return core.run(test)
+
+
+def run_repkv_netns(cluster, tmp_path, **opts):
+    from jepsen_tpu.suites import repkv
+
+    return run_suite_netns(cluster, tmp_path, repkv.repkv_test,
+                           "repkv-local", **opts)
 
 
 @pytest.mark.slow
@@ -256,6 +264,60 @@ def test_repkv_kernel_partition_safe_reads_control(tmp_path):
             c, tmp_path,
             **{"safe-reads": True, "faults": ["partition"],
                "sync": True},
+        )
+    res = done["results"]
+    assert res["valid"] is True, res
+    parts = [op for op in done["history"]
+             if op.process == "nemesis" and op.f == "start-partition"]
+    assert parts
+
+
+def run_electd_netns(cluster, tmp_path, **opts):
+    from jepsen_tpu.suites import electd
+
+    return run_suite_netns(cluster, tmp_path, electd.electd_test,
+                           "electd-local", **opts)
+
+
+@pytest.mark.slow
+def test_electd_kernel_partition_split_brain_conviction(tmp_path):
+    """The flagship anomaly on kernel faults: blackhole routes inside
+    the namespaces cut electd's heartbeats for real, both sides elect
+    a leader, both ack writes, heal discards one side's — and the
+    linearizability checker convicts.  No app-level blocks anywhere in
+    the path."""
+    last = None
+    for attempt in range(3):
+        c = NetnsCluster(
+            n_nodes=3, tag="jte%05d" % (time.time_ns() % 90000)
+        )
+        with c:
+            done = run_electd_netns(
+                c, tmp_path / f"a{attempt}",
+                **{"faults": ["partition"], "time-limit": 12.0,
+                   "seed": attempt},
+            )
+        last = done["results"]
+        h = done["history"]
+        parts = [op for op in h
+                 if op.process == "nemesis"
+                 and op.f == "start-partition" and op.type == "info"]
+        assert parts, "the nemesis never partitioned"
+        if last["valid"] is False:
+            return
+    pytest.fail(f"3 kernel-partitioned runs never split-brained: {last}")
+
+
+@pytest.mark.slow
+def test_electd_kernel_partition_quorum_control(tmp_path):
+    """Identical kernel faults, ABD majority rounds: valid — the
+    conviction above is the election bug's doing, not the cluster or
+    the route injection."""
+    c = NetnsCluster(n_nodes=3, tag="jtq%05d" % (time.time_ns() % 90000))
+    with c:
+        done = run_electd_netns(
+            c, tmp_path,
+            **{"quorum": True, "faults": ["partition"], "rate": 40.0},
         )
     res = done["results"]
     assert res["valid"] is True, res
