@@ -27,12 +27,26 @@
 // read/write registers only — CAS needs consensus, which electd
 // deliberately does not have; the suite's quorum workload is rw-only.)
 //
+// Second experiment (crash amnesia): ABD assumes replicas remember
+// their (ts, wid, val) across failures.  Without --wal the store and
+// the timestamp clock are process memory, so kill -9 + restart
+// reboots a replica EMPTY — a later majority can miss an acked write
+// entirely (and a reused timestamp can diverge replicas).  That is
+// the reference's canonical volatile-quorum finding.  --wal <path>
+// appends every accepted (k, ts, wid, val) to a fsync'd log replayed
+// at boot (clock floor included), closing the amnesia hole; the suite
+// runs the same kill schedule volatile (convicted) and durable
+// (valid).  The WAL is quorum-mode durability: unsafe mode's
+// wholesale state adoption deliberately discards entries, which an
+// append-only replay cannot represent.
+//
 // Client protocol (one request per line):
 //   GET <k>               -> VAL <v> | NIL | ERR notleader|noquorum
 //   SET <k> <v>           -> OK | ERR notleader|noquorum
 //   CAS <k> <old> <new>   -> OK | FAIL | NIL | ERR notleader (unsafe only)
 //   ROLE                  -> LEADER | FOLLOWER | QUORUM
 //   PING                  -> PONG
+//   CLOCK                 -> CLOCK <abd_clock>   (admin observability)
 //   DUMP <from>           -> STATE <k>=<ts>:<wid>:<v>,...   (step-down pull)
 //   BLOCK <id> / UNBLOCK <id>|* -> OK   (app-level partition injection,
 //                                        the suite's Net implementation)
@@ -86,6 +100,8 @@ int g_peer_timeout_ms = 100;  // per-peer connect/read budget
 std::mutex g_mu;
 std::map<std::string, Entry> g_kv;
 long long g_abd_clock = 0;  // node-local monotonic ABD timestamp floor
+FILE* g_wal = nullptr;      // quorum-mode durability; null = volatile
+std::mutex g_wal_mu;        // append order; never held with g_mu
 std::set<int> g_blocked;
 std::map<int, Clock::time_point> g_last_heard;
 bool g_leader = false;
@@ -256,8 +272,37 @@ bool quorum_read(const std::string& k, Entry* out) {
   return true;
 }
 
+// Appends one record durably.  Fail-stop on any I/O error: a node
+// that cannot log must not ack (or serve) — dying here turns ENOSPC
+// into a dead node, which the suite's fault model already covers,
+// instead of into silently-volatile "durable" mode.
+void wal_append(const std::string& k, long long ts, int wid,
+                const std::string& v) {
+  std::lock_guard<std::mutex> l(g_wal_mu);
+  if (fprintf(g_wal, "%s %lld %d %s\n", k.c_str(), ts, wid,
+              v.c_str()) < 0 ||
+      fflush(g_wal) != 0 || fsync(fileno(g_wal)) != 0) {
+    perror("electd: wal append failed, stopping");
+    _exit(1);
+  }
+}
+
 void local_store(const std::string& k, long long ts, int wid,
                  const std::string& v) {
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    Entry& e = g_kv[k];
+    if (ts < e.ts || (ts == e.ts && wid <= e.wid)) return;
+  }
+  // Durable BEFORE visible (and before the QACK/OK leaves this node):
+  // once the entry is in g_kv another op can read it and ack the
+  // value onward, so crashing after visibility but before the append
+  // would lose an observed write even in durable mode.  The fsync
+  // happens outside g_mu so a slow disk stalls only writers, not
+  // reads/heartbeats.  A newer entry racing in between the append and
+  // the apply just makes this record a no-op on disk and in memory —
+  // replay applies with the same (ts, wid) precedence.
+  if (g_wal) wal_append(k, ts, wid, v);
   std::lock_guard<std::mutex> l(g_mu);
   Entry& e = g_kv[k];
   if (ts > e.ts || (ts == e.ts && wid > e.wid)) {
@@ -265,6 +310,49 @@ void local_store(const std::string& k, long long ts, int wid,
     e.wid = wid;
     e.val = v;
   }
+  if (ts > g_abd_clock) g_abd_clock = ts;
+}
+
+// Boot-time WAL replay: re-applies entries with local_store's own
+// precedence (last state wins per key) and restores the clock floor
+// so a restarted writer can never reuse a pre-crash timestamp.
+// A kill can tear the final record (it was never fsync-acked, so
+// dropping it is correct); the file is then TRUNCATED at the tear so
+// the next append starts on a clean line boundary — otherwise a
+// second incarnation's entries would glue onto the torn tail and a
+// later replay would stop there, forgetting acked writes.
+void wal_replay(const std::string& path) {
+  FILE* f = fopen(path.c_str(), "r");
+  if (!f) return;  // first boot: nothing yet
+  char line[4600];
+  char k[256], v[3900];
+  long long ts;
+  int wid;
+  int applied = 0;
+  long good_end = 0;
+  while (fgets(line, sizeof(line), f)) {
+    size_t n = strlen(line);
+    if (n == 0 || line[n - 1] != '\n' ||
+        sscanf(line, "%255s %lld %d %3899s", k, &ts, &wid, v) != 4)
+      break;  // torn tail: everything before it was fsync'd whole
+    good_end = ftell(f);
+    std::lock_guard<std::mutex> l(g_mu);
+    Entry& e = g_kv[k];
+    if (ts > e.ts || (ts == e.ts && wid > e.wid)) {
+      e.ts = ts;
+      e.wid = wid;
+      e.val = v;
+    }
+    if (ts > g_abd_clock) g_abd_clock = ts;
+    applied++;
+  }
+  fclose(f);
+  if (truncate(path.c_str(), good_end) != 0) {
+    perror("electd: wal truncate failed, stopping");
+    _exit(1);
+  }
+  fprintf(stderr, "electd id=%d wal replay: %d entries, clock %lld\n",
+          g_id, applied, g_abd_clock);
 }
 
 // ABD phase 2: store (ts, wid, v) on self + a majority.
@@ -341,6 +429,12 @@ void serve(int fd) {
       in >> from;
       if (blocked(from)) continue;
       resp = "STATE " + state_str();
+    } else if (cmd == "CLOCK") {
+      // Admin observability: the ABD timestamp floor (replay must
+      // restore it or a restarted writer can reuse pre-crash
+      // timestamps and diverge replicas).
+      std::lock_guard<std::mutex> l(g_mu);
+      resp = "CLOCK " + std::to_string(g_abd_clock);
     } else if (cmd == "GET") {
       std::string k;
       in >> k;
@@ -458,6 +552,7 @@ void serve(int fd) {
 int main(int argc, char** argv) {
   int port = 7400;
   std::string listen_addr = "127.0.0.1";
+  std::string wal_path;
   std::string peers;  // "id@host:port,id@host:port"
   for (int i = 1; i < argc; i++) {
     std::string a = argv[i];
@@ -467,11 +562,21 @@ int main(int argc, char** argv) {
     else if (a == "--id") g_id = atoi(next().c_str());
     else if (a == "--peers") peers = next();
     else if (a == "--quorum") g_quorum = true;
+    else if (a == "--wal") wal_path = next();
     else if (a == "--stale-ms") g_stale_ms = atoi(next().c_str());
     else if (a == "--peer-timeout-ms")
       g_peer_timeout_ms = atoi(next().c_str());
   }
   signal(SIGPIPE, SIG_IGN);
+
+  if (!wal_path.empty()) {
+    wal_replay(wal_path);
+    g_wal = fopen(wal_path.c_str(), "a");
+    if (!g_wal) {
+      perror("wal");
+      return 1;
+    }
+  }
 
   std::stringstream ps(peers);
   std::string item;

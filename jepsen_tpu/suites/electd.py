@@ -18,6 +18,18 @@ ABD covers read/write registers only (CAS needs consensus, which
 electd deliberately lacks), so the quorum workload is rw-only; the
 unsafe workload includes CAS.
 
+Second experiment (crash amnesia): ABD's guarantee assumes replicas
+remember their state across failures.  Volatile quorum mode under
+kill faults reboots replicas empty, so a majority can later miss an
+acked write — the checker convicts.  --durable gives each node a
+fsync'd write-ahead log (electd --wal) replayed at boot, and the same
+kill schedule stays valid.  The experiment matrix:
+
+    unsafe  + partition            -> split-brain conviction
+    quorum  + partition            -> valid       (control for #1)
+    quorum  + kill                 -> amnesia conviction
+    quorum  + kill + --durable     -> valid       (control for #3)
+
 Partitions use ElectdNet: the `Net` protocol over electd's
 BLOCK/UNBLOCK admin commands (the suites/repkv.py pattern) — the same
 declarative partition packages drive either transport, and the netns
@@ -131,6 +143,8 @@ class ElectdDB(jdb.DB):
             args += ["--listen", "0.0.0.0"]
         if test.get("electd-quorum"):
             args.append("--quorum")
+        if test.get("electd-durable"):
+            args += ["--wal", f"{p['dir']}/wal"]
         cutil.start_daemon(
             sess, p["bin"], *args, pidfile=p["pid"], logfile=p["log"]
         )
@@ -271,7 +285,15 @@ class ElectdClient(jc.Client):
 
         if op.f == "read":
             if resp == "NIL":
-                return op.complete(OK, value=None)
+                # The EMPTY register is an observation, not ignorance:
+                # the model treats a None read as unconstrained (the
+                # knossos convention for "value not recorded"), which
+                # would let a post-wipe NIL read linearize anywhere.
+                # Encoding empty as the sentinel 0 — with the model's
+                # initial value 0 and workload values starting at 1 —
+                # makes crash amnesia (NIL after an acked write)
+                # convictable.
+                return op.complete(OK, value=0)
             if resp.startswith("VAL "):
                 return op.complete(OK, value=int(resp.split(" ", 1)[1]))
             return op.complete(FAIL, error=resp)
@@ -303,18 +325,14 @@ def electd_test(opts: dict) -> dict:
         else ["partition"]
     )
     quorum = bool(opts.get("quorum"))
-    if quorum and "kill" in faults:
-        # ABD is linearizable over PARTITIONS only: electd keeps no
-        # stable storage, so a killed-and-restarted replica reboots
-        # empty and a later majority can miss an acked write (crash
-        # amnesia).  That is real physics, but it would convict the
-        # control group for a reason outside the unsafe-vs-quorum
-        # contrast this suite exists to demonstrate — refuse the
-        # combination rather than quietly invert the experiment.
-        raise ValueError(
-            "--quorum is the partition control group; combine kill "
-            "faults with the default (unsafe) mode instead"
-        )
+    if opts.get("durable") and not quorum:
+        # The WAL logs the quorum path (local_store); unsafe-mode
+        # writes mutate directly and step-down adoption discards
+        # entries an append-only log cannot un-write.  Refuse rather
+        # than hand out a durability flag that logs nothing.
+        raise ValueError("--durable requires --quorum (the WAL covers "
+                         "the ABD path; unsafe mode is volatile by "
+                         "design)")
     rng = random.Random(opts.get("seed"))
     counter = itertools.count(1)
 
@@ -373,12 +391,16 @@ def electd_test(opts: dict) -> dict:
         "client": ElectdClient(),
         "nemesis": pkg["nemesis"],
         "generator": generator,
-        "model": cas_register(),
+        # Initial value 0 = the sentinel the client reports for NIL
+        # reads (see ElectdClient.invoke): an empty register is a
+        # checkable observation, not an unconstrained read.
+        "model": cas_register(0),
         "checker": Linearizable(
             algorithm=opts.get("algorithm", "wgl-tpu"),
             time_limit_s=60.0,
         ),
         "electd-quorum": quorum,
+        "electd-durable": bool(opts.get("durable")),
         "electd-stale-ms": opts.get("stale-ms", 400),
         "electd-dir": opts.get("electd-dir") or os.path.join(
             store_root, "electd-data"
@@ -393,7 +415,11 @@ def _extra_opts(p) -> None:
     p.add_argument("--rate", type=float, default=100.0)
     p.add_argument("--interval", type=float, default=3.0)
     p.add_argument("--quorum", action="store_true",
-                   help="ABD majority reads/writes (the control group)")
+                   help="ABD majority reads/writes (the partition "
+                        "control group; volatile under kill faults)")
+    p.add_argument("--durable", action="store_true",
+                   help="fsync'd per-node WAL replayed at boot (the "
+                        "kill-fault control group for --quorum)")
     p.add_argument("--stale-ms", type=int, default=400)
     p.add_argument("--algorithm", default="wgl-tpu",
                    choices=["cpu", "wgl", "wgl-tpu"])

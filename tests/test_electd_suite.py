@@ -89,6 +89,113 @@ def test_quorum_control_valid_under_partitions(tmp_path):
 
 
 @pytest.mark.slow
+def test_quorum_kill_amnesia_caught(tmp_path):
+    """Crash amnesia: volatile ABD replicas reboot empty, so kill
+    faults (which can wipe every node at once) make a later majority
+    miss acked writes — the checker convicts the quorum mode that was
+    bulletproof under partitions."""
+    for attempt in range(3):
+        done = run_electd(
+            tmp_path / f"a{attempt}",
+            **{"quorum": True, "faults": ["kill"], "time-limit": 12.0,
+               "interval": 1.0, "rate": 40.0, "seed": attempt},
+        )
+        res = done["results"]
+        if res["valid"] is False:
+            kills = [o for o in done["history"]
+                     if o.process == "nemesis" and o.f == "kill"]
+            assert kills, "conviction without a kill?"
+            return
+    pytest.fail(f"3 kill runs never produced amnesia: {res}")
+
+
+@pytest.mark.slow
+def test_quorum_kill_durable_control(tmp_path):
+    """Identical kill schedule with the fsync'd WAL (--durable):
+    replicas replay their log at boot, amnesia is closed, and the
+    checker stays green — proof the conviction above is the volatile
+    state's doing."""
+    done = run_electd(
+        tmp_path,
+        **{"quorum": True, "durable": True, "faults": ["kill"],
+           "time-limit": 10.0, "interval": 1.0, "rate": 40.0},
+    )
+    res = done["results"]
+    assert res["valid"] is True, res
+    kills = [o for o in done["history"]
+             if o.process == "nemesis" and o.f == "kill"]
+    assert kills, "the nemesis never killed anything"
+
+
+@pytest.mark.slow
+def test_wal_replay_restores_state_and_clock(tmp_path):
+    """Deterministic amnesia at the admin protocol: write while one
+    node is blocked, wipe the holders, and the read quorum forgets —
+    volatile forgets, durable remembers."""
+    import subprocess
+    import tempfile
+    import time
+
+    workdir = tempfile.mkdtemp(dir=str(tmp_path))
+    binpath = os.path.join(workdir, "electd")
+    subprocess.run(["g++", "-O2", "-pthread", "-o", binpath,
+                    electd.ELECTD_SRC], check=True)
+    probes = [socket.socket() for _ in range(3)]
+    for s in probes:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in probes]
+    for s in probes:
+        s.close()
+
+    def rpc(port, line, timeout=1.5):
+        with socket.create_connection(("127.0.0.1", port),
+                                      timeout=timeout) as s:
+            s.sendall((line + "\n").encode())
+            return s.recv(4096).decode().strip()
+
+    def spawn(i, durable):
+        peers = ",".join(f"{j}@127.0.0.1:{ports[j]}"
+                         for j in range(3) if j != i)
+        args = [binpath, "--id", str(i), "--port", str(ports[i]),
+                "--peers", peers, "--quorum"]
+        if durable:
+            args += ["--wal", os.path.join(workdir, f"wal{i}")]
+        return subprocess.Popen(args, stderr=subprocess.DEVNULL)
+
+    for durable, expect in ((False, "NIL"), (True, "VAL 7")):
+        procs = {i: spawn(i, durable) for i in range(3)}
+        try:
+            time.sleep(0.6)
+            # n2 misses the write: it refuses traffic from n0 and n1.
+            assert rpc(ports[2], "BLOCK 0") == "OK"
+            assert rpc(ports[2], "BLOCK 1") == "OK"
+            assert rpc(ports[0], "SET x 7") == "OK"   # held by {n0,n1}
+            # Wipe both holders; restart only n1.  Quorum = {n1, n2}.
+            for i in (0, 1):
+                procs[i].kill()
+            time.sleep(0.2)
+            procs[1] = spawn(1, durable)
+            time.sleep(0.5)
+            assert rpc(ports[2], "UNBLOCK *") == "OK"
+            got = rpc(ports[2], "GET x")
+            assert got == expect, (
+                f"durable={durable}: read {got!r}, wanted {expect!r}"
+            )
+            # Clock restoration (the test's other half): the replayed
+            # node's ABD floor must cover the pre-crash timestamp, or
+            # a restarted writer could reuse it and diverge replicas.
+            clock = int(rpc(ports[1], "CLOCK").split()[1])
+            if durable:
+                assert clock >= 1, f"clock floor lost in replay: {clock}"
+            else:
+                assert clock == 0, f"volatile node has clock {clock}?"
+        finally:
+            for pr in procs.values():
+                pr.kill()
+            time.sleep(0.2)
+
+
+@pytest.mark.slow
 def test_split_brain_two_leaders_observable(tmp_path):
     """During a partition isolating the lowest-id node, ROLE must show
     two simultaneous LEADERs (the split brain itself, observed at the
