@@ -80,6 +80,54 @@ def test_headline_bench_cpu_floor():
 
 
 @pytest.mark.slow
+def test_batched_per_key_rate_floor():
+    """The many-keys path (jepsen.independent's realistic shape) gets
+    its own floor (round 4): IndependentChecker over 200 keys x 100
+    ops (20,000 operations) on the 8-device mesh ran at ~1.2k ops/s
+    when the batched kernel started at beam 256, and ~9k once the
+    start beam dropped to the kernel's smallest bucket (32) and the
+    overflow-retry ladder did the climbing (the per-step frontier
+    work scales with start width for EVERY key).  The 4.5k floor
+    catches a generic 2x regression AND fails if the narrow-start
+    lever is ever lost.  Rates are per OPERATION (len(history)/2 —
+    invoke+completion events), matching _timed_wgl_rate's n_ops
+    convention.  Warm-up rep excluded (the ladder's beam buckets
+    each compile once)."""
+    import time
+
+    from jepsen_tpu.checker.linearizable import Linearizable
+    from jepsen_tpu.history.core import history as make_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.parallel.independent import IndependentChecker, kv
+    from jepsen_tpu.parallel.mesh import default_mesh
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    ops = []
+    for i in range(200):
+        h = random_register_history(100, procs=4, info_rate=0.05,
+                                    seed=i)
+        ops += [o.replace(value=kv(f"k{i}", o.value)) for o in h]
+    hist = make_history(ops)
+    chk = IndependentChecker(
+        Linearizable(cas_register(), time_limit_s=600.0)
+    )
+    test = {"mesh": default_mesh(8)}
+    best = None
+    for rep in range(3):
+        t0 = time.monotonic()
+        res = chk.check(test, hist, {})
+        dt = time.monotonic() - t0
+        assert res["valid"] is True, res
+        if rep > 0:
+            best = dt if best is None else min(best, dt)
+    rate = (len(hist) / 2) / best
+    assert rate > 4_500, (
+        f"batched per-key rate regressed: {rate:,.0f} ops/s "
+        f"(floor 4,500 — did the narrow-start beam ladder break?)"
+    )
+
+
+@pytest.mark.slow
 def test_long_history_scaling_floor():
     """Scaling guard (round 4): the checker held ~224k ops/s flat
     from 100k to 10M ops on a single CPU device once two host-side
