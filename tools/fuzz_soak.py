@@ -57,8 +57,15 @@ def main() -> int:
     from jepsen_tpu.ops.wgl import check_wgl_device
 
     # CI sizes top out at 900; the soak adds sizes that cross the
-    # witness window-roll and the >2000-op routing boundary.
-    EXTRA_SIZES = {"cas-register": (1500, 2600)}
+    # witness window-roll and the >2000-op routing boundary for the
+    # register family, and push every other family past its CI max.
+    EXTRA_SIZES = {
+        "cas-register": (1500, 2600),
+        "multi-register": (700,),
+        "mutex": (700,),
+        "fifo-queue": (600,),
+        "unordered-queue": (600,),
+    }
 
     import zlib
 
@@ -96,7 +103,10 @@ def main() -> int:
                         # exact-oracle budget: at 20 s they mostly
                         # time out to unknown and the boundary
                         # coverage would be vacuous.
-                        cpu_budget = 20.0 if size <= 1000 else 60.0
+                        cpu_budget = (
+                            60.0 if size in EXTRA_SIZES.get(name, ())
+                            else 20.0
+                        )
                         cpu = check_wgl_cpu(packed, pm,
                                             time_limit_s=cpu_budget)
                         dev = check_wgl_device(packed, pm,
