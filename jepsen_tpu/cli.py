@@ -135,6 +135,18 @@ def validity_exit(results: Optional[dict]) -> int:
     return EXIT_UNKNOWN
 
 
+def localize_test(t: dict) -> dict:
+    """Default a suite test map to the local topology: every node is a
+    port + data dir on this machine via LocalRemote (the suite CLI
+    mains' shared default — zookeeper.clj:139-145 shape).  Supplying
+    test["remote"] (or --dummy-ssh, which wins in default_remote)
+    overrides."""
+    from .control import LocalRemote
+
+    t.setdefault("remote", LocalRemote())
+    return t
+
+
 def single_test_cmd(
     test_fn: Callable[[dict], dict],
     *,

@@ -426,21 +426,15 @@ def _extra_opts(p) -> None:
 
 
 def main(argv=None) -> int:
-    def _localize(t: dict) -> dict:
-        from ..control import LocalRemote
-
-        t.setdefault("remote", LocalRemote())
-        return t
-
     def suite(opt_map: dict) -> dict:
-        return _localize(electd_test(opt_map))
+        return jcli.localize_test(electd_test(opt_map))
 
     def all_suites(opt_map: dict):
         """test-all: the split-brain conviction run and its ABD quorum
         control group (cli.clj:501-529 pattern)."""
         for quorum in (False, True):
             o = dict(opt_map, quorum=quorum)
-            t = _localize(electd_test(o))
+            t = jcli.localize_test(electd_test(o))
             t["name"] = ("electd-register-quorum" if quorum
                          else "electd-register-unsafe")
             yield t

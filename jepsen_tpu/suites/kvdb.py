@@ -324,13 +324,7 @@ def main(argv=None) -> int:
         # matter are kill -9 + restart (durability) and pause.  The
         # workers still exercise full client concurrency against it.
         t["nodes"] = (opt_map.get("nodes") or ["n1"])[:1]
-        # Default topology is local: the node is a port on this machine
-        # via LocalRemote.  Supplying test["remote"] (or --dummy-ssh,
-        # which wins in default_remote) overrides.
-        from ..control import LocalRemote
-
-        t.setdefault("remote", LocalRemote())
-        return t
+        return jcli.localize_test(t)
 
     def suite(opt_map: dict) -> dict:
         return _localize(kvdb_test(opt_map), opt_map)

@@ -284,14 +284,20 @@ def _extra_opts(p) -> None:
 
 def main(argv=None) -> int:
     def suite(opt_map: dict) -> dict:
-        from ..control import LocalRemote
+        return jcli.localize_test(logd_test(opt_map))
 
-        t = logd_test(opt_map)
-        t.setdefault("remote", LocalRemote())
-        return t
+    def all_suites(opt_map: dict):
+        """test-all: the write-behind conviction run and its --sync
+        control group (cli.clj:501-529 pattern)."""
+        for sync in (False, True):
+            o = dict(opt_map, sync=sync)
+            t = jcli.localize_test(logd_test(o))
+            t["name"] = "logd-kafka-sync" if sync else "logd-kafka"
+            yield t
 
     parser = jcli.single_test_cmd(
-        suite, name="logd", extra_opts=_extra_opts
+        suite, name="logd", extra_opts=_extra_opts,
+        tests_fn=all_suites,
     )
     return jcli.run(parser, argv)
 

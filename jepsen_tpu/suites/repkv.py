@@ -565,14 +565,22 @@ def _extra_opts(p) -> None:
 
 def main(argv=None) -> int:
     def suite(opt_map: dict) -> dict:
-        from ..control import LocalRemote
+        return jcli.localize_test(repkv_test(opt_map))
 
-        t = repkv_test(opt_map)
-        t.setdefault("remote", LocalRemote())
-        return t
+    def all_suites(opt_map: dict):
+        """test-all: the stale-read conviction run and its safe-reads
+        control group (cli.clj:501-529 pattern)."""
+        for safe in (False, True):
+            o = dict(opt_map)
+            o["safe-reads"] = safe
+            t = jcli.localize_test(repkv_test(o))
+            t["name"] = ("repkv-register-safe-reads" if safe
+                         else "repkv-register-unsafe")
+            yield t
 
     parser = jcli.single_test_cmd(
-        suite, name="repkv", extra_opts=_extra_opts
+        suite, name="repkv", extra_opts=_extra_opts,
+        tests_fn=all_suites,
     )
     return jcli.run(parser, argv)
 

@@ -281,21 +281,15 @@ def _extra_opts(p) -> None:
 
 
 def main(argv=None) -> int:
-    def _localize(t: dict) -> dict:
-        from ..control import LocalRemote
-
-        t.setdefault("remote", LocalRemote())
-        return t
-
     def suite(opt_map: dict) -> dict:
-        return _localize(txnd_test(opt_map))
+        return jcli.localize_test(txnd_test(opt_map))
 
     def all_suites(opt_map: dict):
         """test-all: the SI conviction run and its serializable
         control group (cli.clj:501-529 pattern)."""
         for serializable in (False, True):
             o = dict(opt_map, serializable=serializable)
-            t = _localize(txnd_test(o))
+            t = jcli.localize_test(txnd_test(o))
             t["name"] = ("txnd-wr-serializable" if serializable
                          else "txnd-wr-si")
             yield t
