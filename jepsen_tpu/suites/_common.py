@@ -1,0 +1,55 @@
+"""Shared workload plumbing for the demo suites.
+
+The register suites (repkv, electd) drive the same op mix: unique
+monotonically increasing write values (a stale read of an old value is
+then unambiguous — with a small value space a re-write of the same
+value could legitimately explain it) and CAS expected-old values drawn
+from the recent write window so a fraction of CAS ops actually succeed
+and constrain the history (an old value the register never held would
+make every CAS a no-signal FAIL, and the composed stats checker would
+flag the starved op class).
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+from typing import Callable, Optional
+
+from ..generator.core import mix
+
+#: CAS expected-old values come from the last this-many writes.
+CAS_WINDOW = 10
+
+
+def register_workload_gen(
+    rng: random.Random,
+    *,
+    with_cas: bool = True,
+) -> Callable[[], object]:
+    """() -> generator for the read/write[/cas] register mix.  Returns
+    a zero-arg factory because a bare map is one-shot
+    (generator.clj:566-570) — every element must be a fn-generator."""
+    counter = itertools.count(1)
+    last_write = {"v": 1}
+
+    def write():
+        v = next(counter)
+        last_write["v"] = v
+        return {"f": "write", "value": v}
+
+    def cas():
+        hi = last_write["v"]
+        return {"f": "cas",
+                "value": (rng.randrange(max(1, hi - CAS_WINDOW),
+                                        hi + 1),
+                          next(counter))}
+
+    gens: list = [lambda: {"f": "read", "value": None}, write]
+    if with_cas:
+        gens.append(cas)
+
+    def factory():
+        return mix(gens)
+
+    return factory

@@ -47,16 +47,18 @@ from .. import client as jc
 from .. import db as jdb
 from .. import demo as _demo
 from .. import net as jnet
+from ..checker import core as chk
 from ..checker.linearizable import Linearizable
+from ..checker.timeline import Timeline
 from ..control import Session
 from ..control import util as cutil
 from ..generator.core import (
-    mix,
     nemesis as gen_nemesis,
     phases,
     stagger,
     time_limit,
 )
+from ._common import register_workload_gen
 from ..history import FAIL, INFO, OK
 from ..models import cas_register
 from ..nemesis.combined import nemesis_package
@@ -316,7 +318,6 @@ class ElectdClient(jc.Client):
 
 def electd_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137 shape)."""
-    import itertools
     import random
 
     nodes = (opts.get("nodes") or ["n1", "n2", "n3"])[:5]
@@ -334,34 +335,9 @@ def electd_test(opts: dict) -> dict:
                          "the ABD path; unsafe mode is volatile by "
                          "design)")
     rng = random.Random(opts.get("seed"))
-    counter = itertools.count(1)
-
-    last_write = {"v": 1}
-
-    def workload_gen():
-        def write():
-            v = next(counter)
-            last_write["v"] = v
-            return {"f": "write", "value": v}
-
-        gens = [
-            lambda: {"f": "read", "value": None},
-            write,
-        ]
-        if not quorum:
-            # ABD is rw-only; CAS exercises the unsafe leader path.
-            # Expected-old values come from the recent write window so
-            # a fraction of CAS ops actually succeed and constrain the
-            # history (an old value the register never held would make
-            # every CAS a no-signal FAIL).
-            def cas():
-                hi = last_write["v"]
-                return {"f": "cas",
-                        "value": (rng.randrange(max(1, hi - 10), hi + 1),
-                                  next(counter))}
-
-            gens.append(cas)
-        return mix(gens)
+    # ABD is rw-only (CAS needs consensus); CAS exercises the unsafe
+    # leader path.
+    workload_gen = register_workload_gen(rng, with_cas=not quorum)
 
     pkg = nemesis_package({
         "faults": faults,
@@ -395,10 +371,17 @@ def electd_test(opts: dict) -> dict:
         # reads (see ElectdClient.invoke): an empty register is a
         # checkable observation, not an unconstrained read.
         "model": cas_register(0),
-        "checker": Linearizable(
-            algorithm=opts.get("algorithm", "wgl-tpu"),
-            time_limit_s=60.0,
-        ),
+        # The reference's canonical test-map shape composes the safety
+        # checker with timeline + stats renders (zookeeper.clj:112-137)
+        # so every run leaves a browsable trail, convicted or not.
+        "checker": chk.compose({
+            "linear": Linearizable(
+                algorithm=opts.get("algorithm", "wgl-tpu"),
+                time_limit_s=60.0,
+            ),
+            "timeline": Timeline(),
+            "stats": chk.Stats(),
+        }),
         "electd-quorum": quorum,
         "electd-durable": bool(opts.get("durable")),
         "electd-stale-ms": opts.get("stale-ms", 400),

@@ -30,10 +30,13 @@ from .. import demo as _demo
 from .. import client as jc
 from .. import db as jdb
 from .. import net as jnet
+from ..checker import core as chk
 from ..checker.linearizable import Linearizable
+from ..checker.timeline import Timeline
 from ..control import Session
 from ..control import util as cutil
-from ..generator.core import mix, nemesis as gen_nemesis, phases, stagger, time_limit
+from ..generator.core import nemesis as gen_nemesis, phases, stagger, time_limit
+from ._common import register_workload_gen
 from ..history import FAIL, OK, Op
 from ..models import cas_register
 from ..nemesis.combined import nemesis_package
@@ -455,7 +458,6 @@ class RepkvClient(jc.Client):
 
 def repkv_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137 shape)."""
-    import itertools
     import random
 
     nodes = (opts.get("nodes") or ["n1", "n2", "n3"])[:5]
@@ -466,21 +468,7 @@ def repkv_test(opts: dict) -> dict:
         else ["partition"]
     )
     rng = random.Random(opts.get("seed"))
-    # Unique, monotonically increasing write values: a stale read of an
-    # old value is then unambiguous — with a small value space a
-    # re-write of the same value could legitimately explain it.
-    counter = itertools.count(1)
-
-    def workload_gen():
-        # All three must be fn-generators: a bare map is one-shot
-        # (generator.clj:566-570), so a dict in a mix emits once ever.
-        return mix([
-            lambda: {"f": "read", "value": None},
-            lambda: {"f": "write", "value": next(counter)},
-            lambda: {"f": "cas",
-                     "value": (rng.randrange(1, 10) * 7919,
-                               next(counter))},
-        ])
+    workload_gen = register_workload_gen(rng)
 
     pkg_opts = {
         "faults": faults,
@@ -533,10 +521,17 @@ def repkv_test(opts: dict) -> dict:
         "nemesis": pkg["nemesis"],
         "generator": generator,
         "model": cas_register(),
-        "checker": Linearizable(
-            algorithm=opts.get("algorithm", "wgl-tpu"),
-            time_limit_s=60.0,
-        ),
+        # Composed with timeline + stats like the reference's canonical
+        # test maps (zookeeper.clj:112-137): every run leaves a
+        # browsable trail, convicted or not.
+        "checker": chk.compose({
+            "linear": Linearizable(
+                algorithm=opts.get("algorithm", "wgl-tpu"),
+                time_limit_s=60.0,
+            ),
+            "timeline": Timeline(),
+            "stats": chk.Stats(),
+        }),
         "repkv-sync": opts.get("sync", True),
         "repkv-safe-reads": opts.get("safe-reads", False),
         "repkv-failover": "membership" in faults,

@@ -62,7 +62,7 @@ def test_split_brain_lost_updates_caught(tmp_path):
                "interval": 1.0, "seed": attempt},
         )
         res = done["results"]
-        if res["valid"] is False:
+        if res["linear"]["valid"] is False:
             nem = [o for o in done["history"]
                    if o.process == "nemesis"
                    and o.f == "start-partition"]
@@ -82,7 +82,10 @@ def test_quorum_control_valid_under_partitions(tmp_path):
            "time-limit": 10.0, "interval": 1.0, "rate": 40.0},
     )
     res = done["results"]
-    assert res["valid"] is True, res
+    # The LINEAR claim specifically: a composed stats False (an op
+    # class starved by a fault window) is not this test's subject —
+    # the no-fault test above asserts the full composed verdict.
+    assert res["linear"]["valid"] is True, res
     nem_ops = [o for o in done["history"]
                if o.process == "nemesis" and o.f == "start-partition"]
     assert nem_ops, "the nemesis never partitioned anything"
@@ -101,7 +104,7 @@ def test_quorum_kill_amnesia_caught(tmp_path):
                "interval": 1.0, "rate": 40.0, "seed": attempt},
         )
         res = done["results"]
-        if res["valid"] is False:
+        if res["linear"]["valid"] is False:
             kills = [o for o in done["history"]
                      if o.process == "nemesis" and o.f == "kill"]
             assert kills, "conviction without a kill?"
@@ -121,7 +124,9 @@ def test_quorum_kill_durable_control(tmp_path):
            "time-limit": 10.0, "interval": 1.0, "rate": 40.0},
     )
     res = done["results"]
-    assert res["valid"] is True, res
+    # LINEAR claim only (kill windows can starve an op class, which
+    # would fail the composed stats checker without touching safety).
+    assert res["linear"]["valid"] is True, res
     kills = [o for o in done["history"]
              if o.process == "nemesis" and o.f == "kill"]
     assert kills, "the nemesis never killed anything"

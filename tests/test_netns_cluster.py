@@ -248,7 +248,7 @@ def test_repkv_kernel_partition_stale_read_conviction(tmp_path):
                  if op.process == "nemesis"
                  and op.f == "start-partition" and op.type == "info"]
         assert parts, "the nemesis never partitioned"
-        if last["valid"] is False:
+        if last["linear"]["valid"] is False:
             return
     pytest.fail(f"3 kernel-partitioned runs never convicted: {last}")
 
@@ -266,7 +266,9 @@ def test_repkv_kernel_partition_safe_reads_control(tmp_path):
                "sync": True},
         )
     res = done["results"]
-    assert res["valid"] is True, res
+    # LINEAR claim only: a partition window can starve one op class,
+    # which fails the composed stats checker without touching safety.
+    assert res["linear"]["valid"] is True, res
     parts = [op for op in done["history"]
              if op.process == "nemesis" and op.f == "start-partition"]
     assert parts
@@ -303,7 +305,7 @@ def test_electd_kernel_partition_split_brain_conviction(tmp_path):
                  if op.process == "nemesis"
                  and op.f == "start-partition" and op.type == "info"]
         assert parts, "the nemesis never partitioned"
-        if last["valid"] is False:
+        if last["linear"]["valid"] is False:
             return
     pytest.fail(f"3 kernel-partitioned runs never split-brained: {last}")
 
@@ -320,7 +322,9 @@ def test_electd_kernel_partition_quorum_control(tmp_path):
             **{"quorum": True, "faults": ["partition"], "rate": 40.0},
         )
     res = done["results"]
-    assert res["valid"] is True, res
+    # LINEAR claim only: a partition window can starve one op class,
+    # which fails the composed stats checker without touching safety.
+    assert res["linear"]["valid"] is True, res
     parts = [op for op in done["history"]
              if op.process == "nemesis" and op.f == "start-partition"]
     assert parts

@@ -40,7 +40,9 @@ def test_safe_reads_valid_under_partitions(tmp_path):
     done = run_repkv(tmp_path, **{"safe-reads": True,
                                   "faults": ["partition"]})
     res = done["results"]
-    assert res["valid"] is True, res
+    # LINEAR claim only: a partition window can starve one op class,
+    # which fails the composed stats checker without touching safety.
+    assert res["linear"]["valid"] is True, res
     # The nemesis actually partitioned something.
     nem_ops = [o for o in done["history"]
                if o.process == "nemesis" and o.f == "start-partition"]
@@ -58,7 +60,10 @@ def test_stale_backup_reads_caught(tmp_path):
                "time-limit": 10.0, "interval": 1.0, "seed": attempt},
         )
         res = done["results"]
-        if res["valid"] is False:
+        # The LINEAR component specifically: the composed checker also
+        # carries stats/timeline, and a False from those would not be
+        # the stale read this test exists to catch.
+        if res["linear"]["valid"] is False:
             return  # caught the stale read
     pytest.fail(f"3 partitioned runs never produced a violation: {res}")
 
@@ -69,8 +74,10 @@ def test_primary_reflection_and_kill_recovery(tmp_path):
                                   "time-limit": 6.0})
     res = done["results"]
     # Kills hit random nodes; killed-primary windows make writes fail,
-    # which is fine — validity must hold because reads are safe.
-    assert res["valid"] in (True, "unknown"), res
+    # which is fine — LINEARIZABILITY must hold because reads are
+    # safe.  (The composed stats checker may legitimately flag an op
+    # class starved by a kill window; that is not this test's claim.)
+    assert res["linear"]["valid"] in (True, "unknown"), res
 
 
 @pytest.mark.slow
@@ -177,7 +184,7 @@ def test_grow_shrink_package_drives_real_group(tmp_path):
             o for o in leaves
             if (o.ext or {}).get("resp") == "OK"
         ]
-        if done["results"]["valid"] is False and ok_leaves:
+        if done["results"]["linear"]["valid"] is False and ok_leaves:
             convicted = done["results"]
             break
     assert convicted is not None, (
@@ -198,7 +205,8 @@ def test_grow_shrink_safe_reads_control(tmp_path):
            "view-interval": 0.3, "rate": 80.0},
     )
     res = done["results"]
-    assert res["valid"] is True, res
+    # LINEAR claim only (see test_safe_reads_valid_under_partitions).
+    assert res["linear"]["valid"] is True, res
     h = done["history"]
     leaves = [o for o in h if o.f == "leave" and o.type == "info"]
     assert leaves, "membership never shrank the group"
