@@ -21,8 +21,20 @@
 // any key read has a newer version than the snapshot — backward
 // OCC), which closes the skew window: the control group.
 //
+// --read-committed drops BOTH the snapshot and commit validation:
+// each read is its own statement against the latest committed state
+// (lock taken and released per read), and writes apply blindly.
+// That is READ COMMITTED — no dirty reads (only committed versions
+// are ever visible), but read skew (a multi-key read straddling a
+// concurrent commit) and lost updates (two read-modify-writes off
+// the same stale read) are both admitted.  The bank workload's
+// conserved-total invariant convicts exactly this level, the way the
+// reference's bank test convicts weak MySQL/Galera settings
+// (tests/bank.clj:56-120); snapshot isolation is its control group.
+//
 // --think-us N sleeps between snapshot acquisition and commit
-// validation, widening the race window so short test runs reliably
+// validation (and, under --read-committed, between the per-statement
+// reads), widening the race window so short test runs reliably
 // exhibit the anomaly (a production system's window is its
 // transaction duration; we just make ours honest and visible).
 //
@@ -30,10 +42,17 @@
 //   TXN r <k> [r <k2> ...] w <k> <v> ...\n
 //     -> OK [<read-val-or-NIL> per r, in order]\n   committed
 //     -> ABORT\n                                    conflict: nothing applied
+//   TRANSFER <from> <to> <amount>\n    server-side read-modify-write
+//     -> OK\n          committed: from -= amount, to += amount
+//     -> NSF\n         insufficient funds: nothing applied
+//     -> ABORT\n       first-committer-wins conflict: nothing applied
 //   PING\n -> PONG\n
 //
-// Values are integers; writes are expected globally unique per key
-// (the elle rw-register workload guarantees this).
+// --init <key> <value> (repeatable) seeds a committed version before
+// the listener opens — bank accounts exist race-free from op one.
+//
+// Values are integers; TXN writes are expected globally unique per
+// key (the elle rw-register workload guarantees this).
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
@@ -63,7 +82,13 @@ static long long g_commit_seq = 0;
 static std::mutex g_mu;  // guards g_store + g_commit_seq
 
 static bool g_serializable = false;
+static bool g_read_committed = false;
 static long g_think_us = 2000;
+
+static void think() {
+  if (g_think_us > 0)
+    std::this_thread::sleep_for(std::chrono::microseconds(g_think_us));
+}
 
 struct ReadOp {
   std::string key;
@@ -97,9 +122,20 @@ static long long newest_seq(const std::string &key) {
 
 static std::string run_txn(const std::vector<ReadOp> &reads,
                            const std::vector<WriteOp> &writes) {
-  long long snap;
+  long long snap = 0;
   std::vector<std::pair<bool, long long>> results(reads.size());
-  {
+  if (g_read_committed) {
+    // Each read is its own statement: lock per read, latest committed
+    // version, think between statements.  A commit landing in a gap
+    // is exactly read skew.
+    for (size_t i = 0; i < reads.size(); i++) {
+      if (i > 0) think();
+      std::lock_guard<std::mutex> lk(g_mu);
+      long long v = 0;
+      results[i].first = read_at(reads[i].key, g_commit_seq, &v);
+      results[i].second = v;
+    }
+  } else {
     std::lock_guard<std::mutex> lk(g_mu);
     snap = g_commit_seq;
     for (size_t i = 0; i < reads.size(); i++) {
@@ -111,16 +147,17 @@ static std::string run_txn(const std::vector<ReadOp> &reads,
 
   // The transaction "thinks" between snapshot and commit — the window
   // in which a concurrent committer can invalidate its premises.
-  if (g_think_us > 0 && !writes.empty())
-    std::this_thread::sleep_for(std::chrono::microseconds(g_think_us));
+  if (!writes.empty()) think();
 
   {
     std::lock_guard<std::mutex> lk(g_mu);
-    for (const auto &w : writes)
-      if (newest_seq(w.key) > snap) return "ABORT";
-    if (g_serializable)
-      for (const auto &r : reads)
-        if (newest_seq(r.key) > snap) return "ABORT";
+    if (!g_read_committed) {
+      for (const auto &w : writes)
+        if (newest_seq(w.key) > snap) return "ABORT";
+      if (g_serializable)
+        for (const auto &r : reads)
+          if (newest_seq(r.key) > snap) return "ABORT";
+    }
     if (!writes.empty()) {
       long long seq = ++g_commit_seq;
       for (const auto &w : writes)
@@ -137,6 +174,57 @@ static std::string run_txn(const std::vector<ReadOp> &reads,
       out << " NIL";
   }
   return out.str();
+}
+
+// Server-side read-modify-write: from -= amount, to += amount.  The
+// balances the writes are computed FROM come out of the same
+// isolation machinery as TXN reads — a snapshot (validated
+// first-committer-wins at commit) or, under --read-committed,
+// per-statement latest reads applied blindly, which is where lost
+// updates and skewed totals come from.
+static std::string run_transfer(const std::string &from,
+                                const std::string &to,
+                                long long amount) {
+  // Self-transfers would push two same-seq versions of one key and
+  // negative amounts would bypass the NSF check — either mints or
+  // destroys money under EVERY isolation level, which the bank
+  // checker would then blame on isolation.  Malformed, not a txn.
+  if (from == to || amount <= 0) return "ERR bad transfer";
+  long long snap = 0, bal_from = 0, bal_to = 0;
+  bool have_from = false, have_to = false;
+  if (g_read_committed) {
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      have_from = read_at(from, g_commit_seq, &bal_from);
+    }
+    think();
+    {
+      std::lock_guard<std::mutex> lk(g_mu);
+      have_to = read_at(to, g_commit_seq, &bal_to);
+    }
+  } else {
+    std::lock_guard<std::mutex> lk(g_mu);
+    snap = g_commit_seq;
+    have_from = read_at(from, snap, &bal_from);
+    have_to = read_at(to, snap, &bal_to);
+  }
+  if (!have_from || bal_from < amount) return "NSF";
+
+  think();
+
+  {
+    std::lock_guard<std::mutex> lk(g_mu);
+    if (!g_read_committed) {
+      // Write set = {from, to}; read set is the same, so SI and
+      // serializable validation coincide for transfers.
+      if (newest_seq(from) > snap || newest_seq(to) > snap)
+        return "ABORT";
+    }
+    long long seq = ++g_commit_seq;
+    g_store[from].push_back({seq, bal_from - amount});
+    g_store[to].push_back({seq, have_to ? bal_to + amount : amount});
+  }
+  return "OK";
 }
 
 static void serve(int fd) {
@@ -177,6 +265,13 @@ static void serve(int fd) {
         }
       }
       resp = bad ? "ERR bad txn" : run_txn(reads, writes);
+    } else if (cmd == "TRANSFER") {
+      std::string from, to;
+      long long amount;
+      if (ss >> from >> to >> amount)
+        resp = run_transfer(from, to, amount);
+      else
+        resp = "ERR bad transfer";
     } else {
       resp = "ERR unknown command";
     }
@@ -199,9 +294,15 @@ int main(int argc, char **argv) {
       listen_addr = argv[++i];
     else if (a == "--serializable")
       g_serializable = true;
+    else if (a == "--read-committed")
+      g_read_committed = true;
     else if (a == "--think-us" && i + 1 < argc)
       g_think_us = atol(argv[++i]);
-    else {
+    else if (a == "--init" && i + 2 < argc) {
+      std::string key = argv[++i];
+      long long value = atoll(argv[++i]);
+      g_store[key].push_back({++g_commit_seq, value});
+    } else {
       fprintf(stderr, "unknown arg %s\n", a.c_str());
       return 2;
     }
@@ -225,7 +326,9 @@ int main(int argc, char **argv) {
   listen(srv, 64);
   fprintf(stderr, "txnd listening on %s:%d (%s, think %ld us)\n",
           listen_addr.c_str(), port,
-          g_serializable ? "serializable" : "snapshot-isolation",
+          g_read_committed ? "read-committed"
+          : g_serializable ? "serializable"
+                           : "snapshot-isolation",
           g_think_us);
   for (;;) {
     int fd = accept(srv, nullptr, nullptr);

@@ -14,7 +14,7 @@ from __future__ import annotations
 
 import itertools
 import random
-from typing import Callable, Optional
+from typing import Callable
 
 from ..generator.core import mix
 

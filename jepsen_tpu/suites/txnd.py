@@ -18,6 +18,16 @@ order under first-committer-wins, so the declared-semantics inference
 is sound here.  `--serializable` makes txnd validate read sets too
 (backward OCC), closing the window: the control group passes under
 the identical workload.
+
+A second workload aims one level lower: `--workload bank` runs the
+conserved-total transfer test (workloads/bank.py, tests/bank.clj)
+against txnd in `--read-committed` mode, where per-statement reads
+and blind writes admit read skew and lost updates — reads see totals
+that don't add up, and concurrent transfers permanently corrupt the
+ledger.  Snapshot isolation is bank's CONTROL group (SI's consistent
+snapshots and first-committer-wins preserve the total), which is the
+textbook hierarchy in one binary: read committed fails bank, SI
+passes bank but fails rw-register, serializable passes both.
 """
 
 from __future__ import annotations
@@ -41,6 +51,7 @@ from ..generator.core import FnGen, clients, stagger, time_limit
 from ..generator import nemesis as gen_nemesis
 from ..history import FAIL, INFO, OK, Op
 from ..nemesis.combined import nemesis_package
+from ..workloads import bank
 
 TXND_SRC = _demo.source("txnd")
 
@@ -90,6 +101,10 @@ class TxndDB(jdb.DB):
             args += ["--listen", "0.0.0.0"]
         if test.get("txnd-serializable"):
             args.append("--serializable")
+        if test.get("txnd-read-committed"):
+            args.append("--read-committed")
+        for key, value in sorted((test.get("txnd-init") or {}).items()):
+            args += ["--init", str(key), str(value)]
         cutil.start_daemon(
             sess, p["bin"], *args, pidfile=p["pid"], logfile=p["log"]
         )
@@ -133,7 +148,7 @@ class TxndClient(jc.Client):
         self.f: Optional[Any] = None
 
     def open(self, test: dict, node: Any) -> "TxndClient":
-        c = TxndClient()
+        c = type(self)()
         if test.get("txnd-local", True):
             host = "127.0.0.1"
         else:
@@ -191,19 +206,94 @@ class TxndClient(jc.Client):
             pass
 
 
+class TxndBankClient(TxndClient):
+    """Bank ops over the same line protocol: reads are one TXN over
+    every account (snapshot-consistent under SI, per-statement under
+    --read-committed); transfers are the server-side TRANSFER
+    read-modify-write.  tests/bank.clj's client shape."""
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        accounts = test.get("accounts") or []
+        try:
+            if op.f == "read":
+                parts = ["TXN"]
+                for a in accounts:
+                    parts += ["r", f"a{a}"]
+                self.f.write(" ".join(parts) + "\n")
+            else:
+                t = op.value
+                self.f.write(
+                    f"TRANSFER a{t['from']} a{t['to']} {t['amount']}\n"
+                )
+            self.f.flush()
+            resp = self.f.readline()
+        except (socket.timeout, TimeoutError, OSError) as e:
+            return op.complete(INFO, error=f"io: {e}")
+        if not resp:
+            return op.complete(INFO, error="connection closed")
+        resp = resp.strip()
+        if resp in ("ABORT", "NSF"):
+            # Nothing was applied: definitely did not happen.
+            return op.complete(
+                FAIL,
+                error="insufficient funds" if resp == "NSF" else None,
+            )
+        if not resp.startswith("OK"):
+            return op.complete(INFO, error=resp)
+        if op.f != "read":
+            return op.complete(OK)
+        raw = resp.split()[1:]
+        balances = {
+            a: int(raw[i])
+            for i, a in enumerate(accounts)
+            if i < len(raw) and raw[i] != "NIL"
+        }
+        return op.complete(OK, value=balances)
+
+
 def txnd_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137 shape)."""
     nodes = (opts.get("nodes") or ["n1"])[:1]  # single-node system
     faults = set(
         opts["faults"] if opts.get("faults") is not None else []
     )
-    gen_txns = FnGen(WrGen(
-        key_count=opts.get("key-count", 4),
-        min_txn_length=2,
-        max_txn_length=opts.get("max-txn-length", 4),
-        rng=random.Random(opts.get("seed")),
-    ))
-    workload_gen = stagger(1.0 / opts.get("rate", 150), gen_txns)
+    workload = opts.get("workload", "wr")
+    extra: dict = {}
+    if workload == "bank":
+        accounts = list(range(opts.get("accounts", 8)))
+        total = opts.get("total-amount", bank.DEFAULT_TOTAL)
+        base_gen = bank.generator(
+            accounts, rng=random.Random(opts.get("seed"))
+        )
+        client: jc.Client = TxndBankClient()
+        checkers: dict = {"bank": bank.BankChecker()}
+        name = "txnd-bank"
+        extra = {
+            "accounts": accounts,
+            "total-amount": total,
+            # All funds start on account 0 (tests/bank.clj's shape);
+            # seeded server-side before the listener opens, so every
+            # read sees a full ledger.
+            "txnd-init": {f"a{a}": (total if a == accounts[0] else 0)
+                          for a in accounts},
+        }
+    else:
+        base_gen = FnGen(WrGen(
+            key_count=opts.get("key-count", 4),
+            min_txn_length=2,
+            max_txn_length=opts.get("max-txn-length", 4),
+            rng=random.Random(opts.get("seed")),
+        ))
+        client = TxndClient()
+        checkers = {
+            "elle-wr": WrChecker(
+                consistency_model=opts.get("consistency-model",
+                                           "serializable"),
+                sequential_keys=True,
+            ),
+        }
+        name = "txnd-wr"
+    workload_gen = stagger(1.0 / opts.get("rate", 150), base_gen)
     if faults:
         pkg = nemesis_package({
             "faults": faults,
@@ -235,28 +325,23 @@ def txnd_test(opts: dict) -> dict:
         nemesis = NoopNemesis()
 
     store_root = os.path.abspath(opts.get("store-dir") or "store")
+    checkers.update({"timeline": Timeline(), "stats": chk.Stats()})
     return {
-        "name": "txnd-wr",
+        "name": name,
         "nodes": nodes,
         "db": TxndDB(),
-        "client": TxndClient(),
+        "client": client,
         "nemesis": nemesis,
         "generator": generator,
-        "checker": chk.compose({
-            "elle-wr": WrChecker(
-                consistency_model=opts.get("consistency-model",
-                                           "serializable"),
-                sequential_keys=True,
-            ),
-            "timeline": Timeline(),
-            "stats": chk.Stats(),
-        }),
+        "checker": chk.compose(checkers),
         "txnd-serializable": bool(opts.get("serializable")),
+        "txnd-read-committed": bool(opts.get("read-committed")),
         "txnd-think-us": opts.get("think-us", 2000),
         "txnd-dir": opts.get("txnd-dir") or os.path.join(
             store_root, "txnd-data"
         ),
         "txnd-base-port": cutil.hashed_base_port(store_root, BASE_PORT),
+        **extra,
     }
 
 
@@ -272,9 +357,17 @@ def _extra_opts(p) -> None:
     p.add_argument("--key-count", type=int, default=4)
     p.add_argument("--max-txn-length", type=int, default=4)
     p.add_argument("--think-us", type=int, default=2000)
+    p.add_argument("--workload", default="wr", choices=["wr", "bank"],
+                   help="wr: elle rw-register (write skew); bank: "
+                   "conserved-total transfers (read skew / lost "
+                   "updates under --read-committed)")
+    p.add_argument("--accounts", type=int, default=8)
     p.add_argument("--serializable", action="store_true",
                    help="validate read sets at commit (the control "
                    "group: closes the write-skew window)")
+    p.add_argument("--read-committed", action="store_true",
+                   help="per-statement reads, no commit validation "
+                   "(the bank workload's conviction target)")
     p.add_argument("--consistency-model", default="serializable",
                    choices=["serializable", "repeatable-read",
                             "read-committed", "read-uncommitted"])
@@ -285,13 +378,23 @@ def main(argv=None) -> int:
         return jcli.localize_test(txnd_test(opt_map))
 
     def all_suites(opt_map: dict):
-        """test-all: the SI conviction run and its serializable
-        control group (cli.clj:501-529 pattern)."""
+        """test-all: each workload's conviction run and its control
+        group (cli.clj:501-529 pattern) — wr convicts SI vs the
+        serializable control; bank convicts read committed vs the SI
+        control."""
         for serializable in (False, True):
-            o = dict(opt_map, serializable=serializable)
+            o = dict(opt_map, workload="wr", serializable=serializable)
             t = jcli.localize_test(txnd_test(o))
             t["name"] = ("txnd-wr-serializable" if serializable
                          else "txnd-wr-si")
+            yield t
+        for read_committed in (True, False):
+            o = dict(opt_map, workload="bank",
+                     serializable=False,
+                     **{"read-committed": read_committed})
+            t = jcli.localize_test(txnd_test(o))
+            t["name"] = ("txnd-bank-read-committed" if read_committed
+                         else "txnd-bank-si")
             yield t
 
     parser = jcli.single_test_cmd(

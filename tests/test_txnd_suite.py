@@ -66,6 +66,49 @@ def test_serializable_control_group_valid(tmp_path):
 
 
 @pytest.mark.slow
+def test_bank_read_committed_convicted(tmp_path):
+    """The bank workload against --read-committed txnd: per-statement
+    reads admit read skew and blind writes admit lost updates, so
+    reads must observe totals != 100 — the reference's classic bank
+    conviction (tests/bank.clj:56-120) against a real server."""
+    last = None
+    for attempt in range(3):
+        done = run_txnd(
+            tmp_path / f"a{attempt}",
+            workload="bank",
+            seed=attempt,
+            **{"read-committed": True},
+        )
+        res = done["results"]
+        last = res
+        if res["bank"]["valid"] is False:
+            bad = res["bank"]["bad-reads"]
+            assert bad and any(
+                any(p.startswith("wrong-total") for p in r["problems"])
+                for r in bad
+            ), res["bank"]
+            return
+    pytest.fail(f"3 read-committed runs never skewed a total: {last}")
+
+
+@pytest.mark.slow
+def test_bank_snapshot_isolation_control_valid(tmp_path):
+    """SI is bank's control group: consistent snapshot reads +
+    first-committer-wins transfers preserve the total even under the
+    identical contended workload."""
+    done = run_txnd(tmp_path, workload="bank")
+    res = done["results"]
+    assert res["valid"] is True, res
+    reads = [o for o in done["history"]
+             if o.type == "ok" and o.f == "read"]
+    transfers = [o for o in done["history"]
+                 if o.type == "ok" and o.f == "transfer"]
+    assert len(reads) > 50, len(reads)
+    assert transfers, "no transfer ever committed?"
+    assert res["bank"]["read-count"] == len(reads)
+
+
+@pytest.mark.slow
 def test_aborts_are_fails_not_infos(tmp_path):
     """First-committer-wins aborts must come back FAIL (definitely not
     applied) — an INFO would make the checker treat the txn as
