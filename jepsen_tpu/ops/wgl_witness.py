@@ -191,7 +191,7 @@ def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
 
 
 def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
-                       interpret: bool):
+                       interpret: bool, unroll: int = 8):
     """The easy-path barrier sweep as a Pallas TPU kernel.
 
     The XLA `lax.scan` version pays ~30 µs of small-op critical path
@@ -222,6 +222,8 @@ def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    UNROLL = max(1, unroll)
+
     def kernel(start_ref, bars_ref, mbits_ref, states_ref, alive_ref,
                states_out, alive_out, death_ref):
         lane = jax.lax.broadcasted_iota(jnp.int32, (1, B), 1)
@@ -236,13 +238,21 @@ def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
             k, _, _, died = c
             return jnp.logical_and(k < K, jnp.logical_not(died))
 
-        def body(c):
-            k, states, alive, _ = c
-            a = bars_ref[0, k]
-            real = bars_ref[2, k] != 0   # scalar bool
-            bf = bars_ref[3, k]
-            ba0 = bars_ref[4, k]
-            ba1 = bars_ref[5, k]
+        # One barrier's transition, guarded so a finished (dead or
+        # past-the-end) carry passes through unchanged.  The guard is
+        # what lets the while body UNROLL U barriers per iteration:
+        # the live-chip measurement behind it is ~5.2 us/barrier at
+        # U=1 — Mosaic's per-iteration loop machinery (cond eval +
+        # carry) costs more than the barrier math itself, the same
+        # finding as the round-2 XLA-scan measurement, one level down.
+        def step1(k, states, alive, died):
+            kk = jnp.minimum(k, K - 1)
+            a = bars_ref[0, kk]
+            valid = jnp.logical_and(k < K, jnp.logical_not(died))
+            real = jnp.logical_and(valid, bars_ref[2, kk] != 0)
+            bf = bars_ref[3, kk]
+            ba0 = bars_ref[4, kk]
+            ba1 = bars_ref[5, kk]
             bits = mbits_ref[a]
             has = (bits >> lane) & 1                   # (1, B) i32
             ns, legal_b = jax_step_rows(states, bf, ba0, ba1)
@@ -250,12 +260,19 @@ def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
             surv_pass = alive & has
             surv_dir = alive & (1 - has) & legal
             new_alive = surv_pass | surv_dir
-            died = real & (new_alive.max() == 0)       # scalar bool
-            commit_i = jnp.where(real & ~died, 1, 0)   # scalar i32
+            died_k = real & (new_alive.max() == 0)     # scalar bool
+            commit_i = jnp.where(real & ~died_k, 1, 0)  # scalar i32
             take = commit_i * surv_dir                 # (1, B) i32
             st = jnp.where(take != 0, ns, states)
             al = commit_i * new_alive + (1 - commit_i) * alive
-            return (jnp.where(died, k, k + 1), st, al, died)
+            k2 = jnp.where(valid & ~died_k, k + 1, k)
+            return k2, st, al, died | died_k
+
+        def body(c):
+            k, states, alive, died = c
+            for _ in range(UNROLL):
+                k, states, alive, died = step1(k, states, alive, died)
+            return (k, states, alive, died)
 
         k, states, alive, died = jax.lax.while_loop(
             cond, body, (start, states0, alive0, jnp.bool_(False))

@@ -162,15 +162,14 @@ class TxndClient(jc.Client):
         c.f = c.sock.makefile("rw", encoding="utf-8", newline="\n")
         return c
 
-    def invoke(self, test: dict, op: Op) -> Op:
-        parts = ["TXN"]
-        for mop in op.value or []:
-            if mop[0] == "r":
-                parts += ["r", f"k{mop[1]}"]
-            else:
-                parts += ["w", f"k{mop[1]}", str(mop[2])]
+    def _roundtrip(self, line: str, op: Op):
+        """One request/response cycle with the shared error
+        classification: io trouble / truncation -> INFO (outcome
+        unknown), server-side rejection before any write applied
+        (ABORT/NSF) -> FAIL, anything else unrecognized -> INFO.
+        Returns the response string, or a completed Op."""
         try:
-            self.f.write(" ".join(parts) + "\n")
+            self.f.write(line + "\n")
             self.f.flush()
             resp = self.f.readline()
         except (socket.timeout, TimeoutError, OSError) as e:
@@ -178,12 +177,27 @@ class TxndClient(jc.Client):
         if not resp:
             return op.complete(INFO, error="connection closed")
         resp = resp.strip()
-        if resp == "ABORT":
-            # First-committer-wins rejected the txn before applying
-            # anything: definitely did not happen.
-            return op.complete(FAIL)
+        if resp in ("ABORT", "NSF"):
+            # Rejected before applying anything: definitely did not
+            # happen.
+            return op.complete(
+                FAIL,
+                error="insufficient funds" if resp == "NSF" else None,
+            )
         if not resp.startswith("OK"):
             return op.complete(INFO, error=resp)
+        return resp
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        parts = ["TXN"]
+        for mop in op.value or []:
+            if mop[0] == "r":
+                parts += ["r", f"k{mop[1]}"]
+            else:
+                parts += ["w", f"k{mop[1]}", str(mop[2])]
+        resp = self._roundtrip(" ".join(parts), op)
+        if isinstance(resp, Op):
+            return resp
         reads = resp.split()[1:]
         filled = []
         i = 0
@@ -214,32 +228,15 @@ class TxndBankClient(TxndClient):
 
     def invoke(self, test: dict, op: Op) -> Op:
         accounts = test.get("accounts") or []
-        try:
-            if op.f == "read":
-                parts = ["TXN"]
-                for a in accounts:
-                    parts += ["r", f"a{a}"]
-                self.f.write(" ".join(parts) + "\n")
-            else:
-                t = op.value
-                self.f.write(
-                    f"TRANSFER a{t['from']} a{t['to']} {t['amount']}\n"
-                )
-            self.f.flush()
-            resp = self.f.readline()
-        except (socket.timeout, TimeoutError, OSError) as e:
-            return op.complete(INFO, error=f"io: {e}")
-        if not resp:
-            return op.complete(INFO, error="connection closed")
-        resp = resp.strip()
-        if resp in ("ABORT", "NSF"):
-            # Nothing was applied: definitely did not happen.
-            return op.complete(
-                FAIL,
-                error="insufficient funds" if resp == "NSF" else None,
-            )
-        if not resp.startswith("OK"):
-            return op.complete(INFO, error=resp)
+        if op.f == "read":
+            line = " ".join(["TXN"] + [x for a in accounts
+                                       for x in ("r", f"a{a}")])
+        else:
+            t = op.value
+            line = f"TRANSFER a{t['from']} a{t['to']} {t['amount']}"
+        resp = self._roundtrip(line, op)
+        if isinstance(resp, Op):
+            return resp
         if op.f != "read":
             return op.complete(OK)
         raw = resp.split()[1:]
@@ -383,7 +380,11 @@ def main(argv=None) -> int:
         serializable control; bank convicts read committed vs the SI
         control."""
         for serializable in (False, True):
-            o = dict(opt_map, workload="wr", serializable=serializable)
+            # Force RC off: a stray --read-committed would otherwise
+            # override --serializable in the binary and convict the
+            # control group for the wrong reason.
+            o = dict(opt_map, workload="wr", serializable=serializable,
+                     **{"read-committed": False})
             t = jcli.localize_test(txnd_test(o))
             t["name"] = ("txnd-wr-serializable" if serializable
                          else "txnd-wr-si")

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""Tunnel/dispatch cost diagnostic for the axon-tunneled TPU chip.
+
+The round-4 TPU witness profile (TPU_WITNESS_PROFILE.json) shows the
+sweep at 97.5% of witness time on TPU (1.147 s) vs 0.15 s on CPU —
+an inversion of the CPU profile where the chain dominates.  The
+sweep's device work is ~3 jitted chunk calls, each carrying ~2.4 MB
+of host-planned block tensors, so the candidate explanations are
+(a) tunnel dispatch round-trip latency, (b) tunnel host->device
+bandwidth, or (c) genuinely slow on-device sweep (Pallas while_loop
+underutilizing the VPU).  This measures (a) and (b) directly:
+
+  dispatch_us    — per-call latency of a tiny jitted op incl. a
+                   blocking fetch of its () result (the sync pattern
+                   the witness driver uses between chunk calls)
+  h2d_mb_s       — device_put bandwidth at 1/4/16 MB
+  d2h_mb_s       — device_get bandwidth at the same sizes
+  kernel_us      — per-iteration cost of a 10k-iteration on-device
+                   while_loop doing sweep-shaped (8-lane) vector work,
+                   amortized: separates on-chip loop speed from the
+                   transfer story
+
+Prints one JSON line.  Run under a timeout; the tunnel can wedge.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    rec: dict = {"platform": dev.platform}
+
+    # --- dispatch round trip ---
+    @jax.jit
+    def tiny(x):
+        return x + 1
+
+    x = jnp.zeros((8,), jnp.int32)
+    tiny(x).block_until_ready()
+    t0 = time.monotonic()
+    n = 30
+    for _ in range(n):
+        tiny(x).block_until_ready()
+    rec["dispatch_us"] = round((time.monotonic() - t0) / n * 1e6)
+
+    # --- transfer bandwidth ---
+    import numpy as np
+
+    for mb in (1, 4, 16):
+        a = np.zeros((mb << 20) // 4, np.int32)
+        t0 = time.monotonic()
+        d = jax.device_put(a, dev)
+        d.block_until_ready()
+        h2d = time.monotonic() - t0
+        t0 = time.monotonic()
+        np.asarray(d)
+        d2h = time.monotonic() - t0
+        rec[f"h2d_{mb}mb_s"] = round(h2d, 4)
+        rec[f"d2h_{mb}mb_s"] = round(d2h, 4)
+    rec["h2d_mb_s"] = round(16 / rec["h2d_16mb_s"], 1)
+    rec["d2h_mb_s"] = round(16 / rec["d2h_16mb_s"], 1)
+
+    # --- on-device serial loop, sweep-shaped work ---
+    B, SW = 8, 4
+    ITER = 10_000
+
+    @jax.jit
+    def loop(states, alive):
+        def body(c):
+            k, st, al = c
+            ns = st + k
+            legal = (ns[0] & 1) == 0
+            al2 = al & legal
+            st2 = jnp.where(al2, ns, st)
+            return k + 1, st2, al2 | al
+        k, st, al = jax.lax.while_loop(
+            lambda c: c[0] < ITER, body,
+            (jnp.int32(0), states, alive),
+        )
+        return st, al
+
+    st = jnp.zeros((SW, B), jnp.int32)
+    al = jnp.ones((B,), jnp.bool_)
+    loop(st, al)[0].block_until_ready()
+    t0 = time.monotonic()
+    loop(st, al)[0].block_until_ready()
+    rec["kernel_us_per_iter"] = round(
+        (time.monotonic() - t0) / ITER * 1e6, 2
+    )
+
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
