@@ -97,6 +97,13 @@ BATTERY = [
                          "--ops", "100000", "--reps", "1",
                          "--platform", "default"],
      "TPU_WITNESS_PROFILE.json", 900.0),
+    # The long-history scale point (the reference's own perf shape is
+    # 1M ops, core_test.clj:127-132).  A wedge killed the first
+    # attempt mid-run at 2026-07-31T10:55Z; retried per-window here.
+    ("profile_witness_1m", [sys.executable, "tools/profile_witness.py",
+                            "--ops", "1000000", "--reps", "1",
+                            "--platform", "default"],
+     "TPU_WITNESS_PROFILE_1M.json", 900.0),
 ]
 
 
