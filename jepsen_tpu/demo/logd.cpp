@@ -15,8 +15,18 @@
 // Client protocol (one request per line):
 //   SEND <k> <v>             -> OFF <offset>
 //   POLL <k> <pos> <limit>   -> MSGS <next_pos> [<off>:<v> ...]
+//   DEQ <k> <limit>          -> DEQD [<v> ...] | EMPTY
 //   COMMIT <k1,k2,...>       -> OK
 //   PING                     -> PONG
+//
+// DEQ is the queue face of the log: a SERVER-side shared cursor per
+// key (one consumer group) hands each record to exactly one caller.
+// The cursor lives in process memory only, deliberately: a restart
+// rewinds it to zero and redelivers — classic at-least-once, which
+// the total-queue checker reports as duplicates but does NOT convict.
+// What it does convict is records that can never come out at all:
+// in write-behind mode a SIGKILL drops acked-but-unflushed SENDs from
+// the WAL, and no amount of redelivery brings those back.
 //
 // The interesting physics — why kills produce checker-visible
 // anomalies: SEND acknowledges from memory, and a flusher thread
@@ -59,6 +69,9 @@ std::mutex g_mu;
 // Value "" is a transaction marker / burned offset: it occupies an
 // offset but is never delivered to polls.
 std::map<std::string, std::vector<std::string>> g_logs;
+// Shared consumer-group cursors for DEQ — in-memory only (see header
+// comment: rewind-on-restart is the at-least-once demo physics).
+std::map<std::string, size_t> g_cursors;
 std::deque<std::string> g_pending;  // WAL lines not yet written
 std::condition_variable g_flush_cv;
 bool g_sync = false;
@@ -141,6 +154,24 @@ void serve(int fd) {
       }
       if (g_sync) flush_pending_locked(g_wal);
       resp = "OK";
+    } else if (cmd == "DEQ") {
+      std::string k;
+      size_t limit = 1;
+      in >> k >> limit;
+      if (limit == 0) limit = 1;
+      std::lock_guard<std::mutex> l(g_mu);
+      auto& log = g_logs[k];
+      size_t& cur = g_cursors[k];
+      std::ostringstream out;
+      size_t n = 0;
+      while (cur < log.size() && n < limit) {
+        if (!log[cur].empty()) {  // markers burn offsets, not values
+          out << " " << log[cur];
+          n++;
+        }
+        cur++;
+      }
+      resp = n ? "DEQD" + out.str() : "EMPTY";
     } else if (cmd == "POLL") {
       std::string k;
       size_t pos = 0, limit = 32;

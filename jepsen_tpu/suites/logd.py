@@ -31,8 +31,9 @@ from .. import db as jdb
 from ..control import Session
 from ..control import util as cutil
 from ..generator.core import time_limit
-from ..history import INFO, OK
+from ..history import FAIL, INFO, OK
 from ..workloads import kafka as kafka_wl
+from ..workloads import queue as queue_wl
 
 LOGD_SRC = _demo.source("logd")
 BASE_PORT = 7520
@@ -131,7 +132,7 @@ class LogdClient(jc.Client):
         self.positions: dict[Any, int] = {}
 
     def open(self, test, node):
-        c = LogdClient()
+        c = type(self)()
         c.sock = socket.create_connection(
             ("127.0.0.1", node_port(test)), timeout=2.0
         )
@@ -203,6 +204,33 @@ class LogdClient(jc.Client):
             pass
 
 
+class LogdQueueClient(LogdClient):
+    """workloads/queue.py ops over logd's DEQ face: enqueue = SEND to
+    one partition, dequeue = one record off the server-side shared
+    cursor.  EMPTY completes :fail (definitely took nothing) —
+    total-queue only counts :ok dequeues."""
+
+    QUEUE_KEY = "q0"
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "enqueue":
+                resp = self._round_trip(
+                    f"SEND {self.QUEUE_KEY} {op.value}"
+                )
+                if not resp.startswith("OFF "):
+                    return op.complete(INFO, error=resp)
+                return op.complete(OK)
+            resp = self._round_trip(f"DEQ {self.QUEUE_KEY} 1")
+            if resp == "EMPTY":
+                return op.complete(FAIL, error="empty")
+            if not resp.startswith("DEQD "):
+                return op.complete(INFO, error=resp)
+            return op.complete(OK, value=int(resp.split()[1]))
+        except (socket.timeout, TimeoutError) as e:
+            return op.complete(INFO, error=f"timeout: {e}")
+
+
 def logd_test(opts: dict) -> dict:
     """Test-map assembly: workloads/kafka.py workload + real broker +
     DB-kill nemesis (kvdb_test shape)."""
@@ -211,27 +239,48 @@ def logd_test(opts: dict) -> dict:
 
     opts = dict(opts or {})
     store_root = os.path.abspath(opts.get("store-dir") or "store")
-    wl = kafka_wl.workload({
-        "key-count": opts.get("key-count", 4),
-        "max-txn-length": opts.get("max-txn-length", 4),
-        # Keys must outlive a kill+restart cycle for the broker's
-        # offset reuse to land on a still-active key (that's what
-        # turns crash loss into inconsistent-offsets/lost-write
-        # findings); the default 128-write retirement is ~1s at the
-        # suite's default rate — too short.
-        "max-writes-per-key": opts.get("max-writes-per-key", 1024),
-        "seed": opts.get("seed", 45100),
-        "final-polls": opts.get("final-polls", 16),
-        # No injected faults: the REAL broker supplies the anomalies.
-        "faults": set(),
-    })
-    wl["client"] = LogdClient()
+    if opts.get("workload", "kafka") == "queue":
+        # Queue face (DEQ's server-side shared cursor): total-queue
+        # convicts write-behind loss; at-least-once redelivery after
+        # restarts shows up as duplicates, which is reported, not
+        # convicted.  Kill faults only: a paused broker can consume a
+        # record whose reply the timed-out client never reads — real
+        # at-most-once delivery loss, but not the bug under test.
+        wl = queue_wl.workload({
+            "rate": 0,  # the suite staggers below
+            "drain-ops": opts.get("drain-ops", 8000),
+        })
+        wl["client"] = LogdQueueClient()
+        name = "logd-queue"
+    else:
+        wl = kafka_wl.workload({
+            "key-count": opts.get("key-count", 4),
+            "max-txn-length": opts.get("max-txn-length", 4),
+            # Keys must outlive a kill+restart cycle for the broker's
+            # offset reuse to land on a still-active key (that's what
+            # turns crash loss into inconsistent-offsets/lost-write
+            # findings); the default 128-write retirement is ~1s at the
+            # suite's default rate — too short.
+            "max-writes-per-key": opts.get("max-writes-per-key", 1024),
+            "seed": opts.get("seed", 45100),
+            "final-polls": opts.get("final-polls", 16),
+            # No injected faults: the REAL broker supplies the anomalies.
+            "faults": set(),
+        })
+        wl["client"] = LogdClient()
+        name = "logd-kafka"
 
     # NB: an explicit empty list means "no faults" — `or` would
     # silently turn it into the kill default.
     faults = set(
         opts["faults"] if opts.get("faults") is not None else ["kill"]
     )
+    if opts.get("workload", "kafka") == "queue":
+        # Enforce the queue branch's kill-only requirement (comment
+        # above): a paused broker consumes a record whose reply the
+        # timed-out client never reads, and with no restart the cursor
+        # never rewinds — a false "lost" conviction even under --sync.
+        faults -= {"pause"}
     pkg = nemesis_package({
         "faults": faults,
         "interval": opts.get("interval", 2.0),
@@ -251,7 +300,7 @@ def logd_test(opts: dict) -> dict:
         generator = phases(generator, gen_nemesis(pkg["final-generator"]))
 
     test = {
-        "name": "logd-kafka",
+        "name": name,
         "nodes": (opts.get("nodes") or ["n1"])[:1],
         "db": LogdDB(),
         "client": wl["client"],
@@ -278,6 +327,11 @@ def _extra_opts(p) -> None:
     p.add_argument("--rate", type=float, default=150.0)
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--flush-ms", type=int, default=75)
+    p.add_argument("--workload", default="kafka",
+                   choices=["kafka", "queue"],
+                   help="kafka: transactional log checker; queue: "
+                   "total-queue over the DEQ shared cursor")
+    p.add_argument("--drain-ops", type=int, default=8000)
     p.add_argument("--sync", action="store_true",
                    help="flush the WAL before acking (control group)")
 
@@ -287,13 +341,15 @@ def main(argv=None) -> int:
         return jcli.localize_test(logd_test(opt_map))
 
     def all_suites(opt_map: dict):
-        """test-all: the write-behind conviction run and its --sync
-        control group (cli.clj:501-529 pattern)."""
-        for sync in (False, True):
-            o = dict(opt_map, sync=sync)
-            t = jcli.localize_test(logd_test(o))
-            t["name"] = "logd-kafka-sync" if sync else "logd-kafka"
-            yield t
+        """test-all: each workload's write-behind conviction run and
+        its --sync control group (cli.clj:501-529 pattern)."""
+        for workload in ("kafka", "queue"):
+            for sync in (False, True):
+                o = dict(opt_map, sync=sync, workload=workload)
+                t = jcli.localize_test(logd_test(o))
+                t["name"] = (f"logd-{workload}-sync" if sync
+                             else f"logd-{workload}")
+                yield t
 
     parser = jcli.single_test_cmd(
         suite, name="logd", extra_opts=_extra_opts,

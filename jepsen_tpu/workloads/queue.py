@@ -1,0 +1,64 @@
+"""Total-queue workload: every acked enqueue must eventually come out.
+
+The reference's queue suites (rabbitmq/disque/chronos-shaped) pair a
+mixed enqueue/dequeue generator with `checker.total-queue`
+(checker.clj:648-708) and a final DRAIN phase that keeps dequeuing
+after faults heal, so "still sitting in the queue at test end" is
+never mistaken for "lost".  This module reproduces that shape as a
+reusable workload map: `{generator, final-generator, checker}` with
+unique integer enqueue values.
+
+Semantics the checker enforces (and the drain makes fair):
+  lost        acked enqueue that never came out — CONVICTS
+  unexpected  dequeue of a value never even attempted — CONVICTS
+  duplicated  redelivery (at-least-once) — reported, allowed
+  recovered   indeterminate enqueue that surfaced — reported, allowed
+"""
+
+from __future__ import annotations
+
+import itertools
+from typing import Optional
+
+from ..checker import core as chk
+from ..checker.timeline import Timeline
+from ..generator.core import FnGen, clients, limit, mix, stagger
+
+
+def workload(opts: Optional[dict] = None) -> dict:
+    opts = dict(opts or {})
+    counter = itertools.count(1)
+
+    def enqueue():
+        return {"f": "enqueue", "value": next(counter)}
+
+    def dequeue():
+        return {"f": "dequeue", "value": None}
+
+    # 2:1 enqueue:dequeue keeps a backlog building, so a crash window
+    # usually holds acked-but-undelivered records — the thing the
+    # checker exists to catch.
+    gen = mix([FnGen(enqueue), FnGen(enqueue), FnGen(dequeue)])
+    rate = opts.get("rate", 150.0)
+    if rate:
+        gen = stagger(1.0 / rate, gen)
+
+    # Drain budget: every record in the post-heal log needs one
+    # successful single-record dequeue, plus EMPTY misses.  Bounded
+    # well above any log this workload's op budget can produce
+    # (duplicates included: each restart rewinds the shared cursor
+    # once, and the log never exceeds total enqueue attempts).
+    drain_ops = opts.get("drain-ops", 8000)
+
+    return {
+        "name": "total-queue",
+        "generator": gen,
+        "final-generator": clients(
+            limit(drain_ops, FnGen(dequeue))
+        ),
+        "checker": chk.compose({
+            "total-queue": chk.TotalQueue(),
+            "timeline": Timeline(),
+            "stats": chk.Stats(),
+        }),
+    }

@@ -93,6 +93,42 @@ def test_faultless_smoke(tmp_path):
 
 
 @pytest.mark.slow
+def test_queue_kill_loses_acked_enqueues(tmp_path):
+    """The queue face of the same bug: total-queue (checker.clj:648-708)
+    must convict acked enqueues the write-behind WAL dropped — records
+    the post-heal drain can never produce, no matter how much
+    at-least-once redelivery happens."""
+    # No seed kwarg: the queue workload is deterministic apart from
+    # kill timing, so retry diversity comes from the unseeded global
+    # RNG's schedule, not from seeding.
+    for attempt in range(3):
+        done = run_logd(tmp_path / f"a{attempt}", workload="queue",
+                        **{"faults": ["kill"]})
+        res = done["results"]
+        sub = res["total-queue"]
+        if res["valid"] is False and sub["lost-count"] > 0:
+            assert not sub["unexpected"], sub
+            return
+    pytest.fail(f"3 queue kill runs never lost an acked enqueue: {res}")
+
+
+@pytest.mark.slow
+def test_queue_sync_control_drains_clean(tmp_path):
+    """Identical kills with write-through acks: nothing lost, nothing
+    unexpected.  Duplicates are expected and allowed — every restart
+    rewinds the in-memory shared cursor (at-least-once)."""
+    done = run_logd(tmp_path, workload="queue",
+                    **{"faults": ["kill"], "sync": True})
+    res = done["results"]
+    sub = res["total-queue"]
+    assert res["valid"] is True, res
+    assert sub["lost-count"] == 0 and not sub["unexpected"], sub
+    # The run actually queued and drained things.
+    assert sub["acknowledged-count"] > 100, sub
+    assert sub["ok-count"] >= sub["acknowledged-count"] - sub["lost-count"] > 0
+
+
+@pytest.mark.slow
 def test_commit_markers_burn_real_offsets(tmp_path):
     """Multi-mop txns emit COMMIT markers; polls must observe genuine
     offset gaps (non-contiguous offsets with nothing ever delivered in
