@@ -124,6 +124,22 @@ std::string handle(const std::string &line) {
     g_sets[k].insert(v);
     return "OK";
   }
+  if (op == "INCR") {
+    // Atomic add under the global mutex: the counter workload's
+    // CONTROL op.  (Its conviction arm never calls this — clients do
+    // GET + SET round trips whose interleavings lose updates.)
+    std::string k, d;
+    in >> k >> d;
+    if (k.empty() || d.empty()) return "ERR usage";
+    long long cur = 0;
+    auto it = g_kv.find(k);
+    if (it != g_kv.end()) cur = atoll(it->second.c_str());
+    long long next = cur + atoll(d.c_str());
+    std::string nv = std::to_string(next);
+    log_op("SET " + k + " " + nv);
+    g_kv[k] = nv;
+    return "VAL " + nv;
+  }
   if (op == "MEMBERS") {
     std::string k;
     in >> k;

@@ -21,6 +21,7 @@ from __future__ import annotations
 import os
 import random
 import socket
+import time
 from typing import Any, Optional
 
 from .. import client as jc
@@ -142,7 +143,7 @@ class KvdbClient(jc.Client):
         self.node: Any = None
 
     def open(self, test: dict, node: Any) -> "KvdbClient":
-        c = KvdbClient(self.register, self.set_key)
+        c = type(self)(self.register, self.set_key)
         c.node = node
         port = node_port(test, node)
         if test.get("kvdb-local", True):
@@ -208,6 +209,72 @@ class KvdbClient(jc.Client):
             pass
 
 
+class KvdbCounterClient(KvdbClient):
+    """Counter ops on one key.  The conviction arm increments the way
+    naive clients actually do — GET, think, SET — whose interleavings
+    LOSE concurrent updates; `atomic` uses the server's INCR (one
+    round trip under the store's mutex), the control group.  The think
+    pause is the honest client-side analog of txnd's --think-us: a
+    real deployment's window is its read-modify-write latency, ours is
+    just made visible."""
+
+    COUNTER_KEY = "ctr"
+
+    def __init__(self, register: str = "reg", set_key: str = "s"):
+        super().__init__(register, set_key)
+        self.atomic = False
+        self.think_s = 0.002
+
+    def open(self, test: dict, node: Any) -> "KvdbCounterClient":
+        c = super().open(test, node)
+        c.atomic = bool(test.get("kvdb-atomic-incr"))
+        c.think_s = test.get("kvdb-rmw-think-s", 0.002)
+        return c
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        k = self.COUNTER_KEY
+        try:
+            if op.f == "read":
+                resp = self._round_trip(f"GET {k}")
+                v = 0 if resp == "NIL" else int(resp.split(" ", 1)[1])
+                return op.complete(OK, value=v)
+            if op.f != "add":
+                raise ValueError(f"unknown f {op.f!r}")
+            if self.atomic:
+                resp = self._round_trip(f"INCR {k} {op.value}")
+                return op.complete(
+                    OK if resp.startswith("VAL ") else INFO, error=None
+                )
+            resp = self._round_trip(f"GET {k}")
+            cur = 0 if resp == "NIL" else int(resp.split(" ", 1)[1])
+            if self.think_s:
+                time.sleep(self.think_s)
+            resp = self._round_trip(f"SET {k} {cur + op.value}")
+            return op.complete(OK if resp == "OK" else INFO, error=None)
+        except (socket.timeout, TimeoutError) as e:
+            return op.complete(INFO, error=f"timeout: {e}")
+
+
+def counter_workload(opts: dict) -> dict:
+    """tests in checker.clj:749-819's shape: positive adds + reads;
+    the conviction is lost updates dragging reads below the acked
+    lower bound."""
+    rng = random.Random(opts.get("seed"))
+    return {
+        "client": KvdbCounterClient(),
+        "generator": mix([
+            FnGen(lambda: {"f": "read"}),
+            FnGen(lambda: {"f": "add", "value": 1 + rng.randrange(5)}),
+            FnGen(lambda: {"f": "add", "value": 1 + rng.randrange(5)}),
+        ]),
+        "checker": chk.compose({
+            "counter": chk.CounterChecker(),
+            "timeline": Timeline(),
+            "stats": chk.Stats(),
+        }),
+    }
+
+
 def register_workload(opts: dict) -> dict:
     rng = random.Random(opts.get("seed"))
     return {
@@ -250,13 +317,17 @@ def set_workload(opts: dict) -> dict:
 def kvdb_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137)."""
     workload_name = opts.get("workload", "register")
-    wl = (register_workload if workload_name == "register"
-          else set_workload)(opts)
+    wl = {"register": register_workload, "set": set_workload,
+          "counter": counter_workload}[workload_name](opts)
     # NB: an explicit empty list means "no faults" — `or` would
     # silently substitute the default (the logd bug, round 3).
+    # Counter defaults faultless: its anomaly is the client's RMW
+    # race, surfaced by plain concurrency (the txnd pattern) — a kill
+    # would add durability loss both arms share, muddying the control.
+    default_faults = [] if workload_name == "counter" else ["kill"]
     faults = set(
         opts["faults"] if opts.get("faults") is not None
-        else ["kill"]
+        else default_faults
     )
     pkg = nemesis_package({
         "faults": faults,
@@ -284,6 +355,8 @@ def kvdb_test(opts: dict) -> dict:
         "checker": wl["checker"],
         "kvdb-fsync": opts.get("fsync", True),
         "kvdb-buffer": opts.get("buffer", 0),
+        "kvdb-atomic-incr": bool(opts.get("atomic-incr")),
+        "kvdb-rmw-think-s": opts.get("rmw-think-s", 0.002),
     }
     store_root = os.path.abspath(opts.get("store-dir") or "store")
     test["kvdb-dir"] = opts.get("kvdb-dir") or os.path.join(
@@ -300,7 +373,11 @@ def kvdb_test(opts: dict) -> dict:
 
 def _extra_opts(p) -> None:
     p.add_argument("--workload", default="register",
-                   choices=["register", "set"])
+                   choices=["register", "set", "counter"])
+    p.add_argument("--atomic-incr", action="store_true",
+                   help="counter workload: use the server's atomic "
+                   "INCR (the control group) instead of racy GET+SET")
+    p.add_argument("--rmw-think-s", type=float, default=0.002)
     p.add_argument("--faults", action="append", default=None,
                    choices=["kill", "pause", "partition"],
                    help="fault types (repeatable; default kill)")
@@ -338,6 +415,18 @@ def main(argv=None) -> int:
                 t = _localize(kvdb_test(o), o)
                 t["name"] = f"kvdb-{workload}-{'-'.join(faults)}"
                 yield t
+        # Counter pair: racy-RMW conviction and its atomic control
+        # (faultless — the race is the anomaly).
+        for atomic in (False, True):
+            # faults=[] explicitly: inheriting e.g. --faults kill from
+            # opt_map would add durability loss both arms share and
+            # falsely convict the atomic control.
+            o = dict(opt_map, workload="counter", faults=[],
+                     **{"atomic-incr": atomic})
+            t = _localize(kvdb_test(o), o)
+            t["name"] = ("kvdb-counter-atomic" if atomic
+                         else "kvdb-counter-rmw")
+            yield t
 
     parser = jcli.single_test_cmd(
         suite, name="kvdb", extra_opts=_extra_opts, tests_fn=all_suites

@@ -62,6 +62,41 @@ def test_set_workload_fsync_safe(tmp_path):
     assert code == cli.EXIT_VALID
 
 
+def test_counter_rmw_loses_updates(tmp_path):
+    """Naive GET+SET increments race: reads must fall below the acked
+    lower bound and the counter checker convicts (checker.clj:749-819)
+    — no faults, the concurrency is the anomaly."""
+    for attempt in range(3):
+        code = run_suite(
+            tmp_path / f"a{attempt}", "--workload", "counter",
+            "--time-limit", "6", "--rate", "200",
+            "--concurrency", "8", "--seed", str(attempt),
+        )
+        if code == cli.EXIT_INVALID:
+            d = store.latest(str(tmp_path / f"a{attempt}" / "store"))
+            tf = store.load(d)
+            res = tf.results
+            assert res["counter"]["error-count"] > 0, res
+            tf.close()
+            return
+    pytest.fail("3 racy-RMW counter runs never lost an update")
+
+
+def test_counter_atomic_incr_control(tmp_path):
+    """The server-side INCR under the same workload: every read within
+    bounds."""
+    code = run_suite(
+        tmp_path, "--workload", "counter", "--atomic-incr",
+        "--time-limit", "6", "--rate", "200", "--concurrency", "8",
+    )
+    assert code == cli.EXIT_VALID
+    d = store.latest(str(tmp_path / "store"))
+    tf = store.load(d)
+    res = tf.results
+    assert res["counter"]["reads"] > 50, res
+    tf.close()
+
+
 @pytest.mark.slow
 def test_file_corruption_truncate_loses_acked_writes(tmp_path):
     """The file-corruption faults produce a REAL conviction end to
