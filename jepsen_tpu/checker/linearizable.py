@@ -130,6 +130,12 @@ class Linearizable(Checker):
                 # ACROSS-keys axis (parallel/independent.py) — the two
                 # compose badly if conflated.
                 mesh=(test or {}).get("search-mesh"),
+                # Long-search checkpointing (SURVEY.md §5): when the
+                # store gives this checker a directory, the witness
+                # persists its inter-chunk carry there, and a
+                # re-`analyze` after a kill or budget expiry resumes
+                # instead of restarting.
+                checkpoint_dir=(opts or {}).get("dir"),
             )
         except RuntimeError as e:
             # No usable accelerator (backend init failure): the CPU

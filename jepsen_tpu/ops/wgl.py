@@ -386,6 +386,7 @@ def check_wgl_device(
     witness: bool = True,
     width_hint: int = 0,
     mesh: Any = None,
+    checkpoint_dir: Optional[str] = None,
 ) -> WGLResult:
     """Decides linearizability of one packed history on the default JAX
     device.
@@ -456,6 +457,7 @@ def check_wgl_device(
         wres = check_wgl_witness(
             packed, pm, info_window=NARROW_INFO_WINDOW,
             time_limit_s=remaining(), width_hint=width_hint,
+            checkpoint_dir=checkpoint_dir,
         )
         if wres is None and not timed_out() and plan_drops(
             packed, info_window=NARROW_INFO_WINDOW
@@ -463,6 +465,7 @@ def check_wgl_device(
             wres = check_wgl_witness(
                 packed, pm, info_window=WIDE_INFO_WINDOW,
                 time_limit_s=remaining(), width_hint=width_hint,
+                checkpoint_dir=checkpoint_dir,
             )
         if wres is not None:
             return wres

@@ -58,6 +58,8 @@ frontier proves nothing: callers fall back to the exact frontier BFS
 
 from __future__ import annotations
 
+import hashlib
+import os
 import time
 from typing import Any, Optional
 
@@ -81,6 +83,82 @@ NARROW_INFO_WINDOW = 512
 WIDE_INFO_WINDOW = 4096
 
 _chunk_fn_cache: dict[tuple, Any] = {}
+
+
+#: Minimum elapsed seconds before a checkpoint is worth writing: short
+#: searches finish in milliseconds and would pay a device->host carry
+#: transfer + npz write per chunk for a file that is deleted moments
+#: later.  A blown budget saves regardless — that is precisely the
+#: run whose progress a resume recovers.
+CKPT_MIN_ELAPSED_S = 5.0
+
+
+def _ckpt_key(packed: PackedOps, pm: PackedModel, B: int, W: int,
+              SW: int, K: int, NB: int,
+              info_window: Optional[int]) -> str:
+    """Digest binding a checkpoint to one (history, model, search
+    shape) triple.  The FULL packed arrays are hashed — a collision
+    here would resume the wrong search and corrupt a verdict, so no
+    sampling shortcuts (~0.25 s at 10M rows, microseconds at bench
+    sizes, amortized over minutes of resumable work).  The model's
+    identity and initial state are in the key because the carry's
+    beam states only mean anything under the transition function
+    that computed them."""
+    h = hashlib.sha256()
+    h.update(np.int64(
+        [packed.n, B, W, SW, K, NB, -1 if info_window is None
+         else info_window]
+    ).tobytes())
+    h.update(getattr(pm, "name", type(pm).__name__).encode())
+    h.update(np.ascontiguousarray(
+        np.asarray(pm.init_state, dtype=np.int64)
+    ).tobytes())
+    for name in ("inv", "ret", "process", "status", "f", "a0", "a1"):
+        h.update(np.ascontiguousarray(getattr(packed, name)).tobytes())
+    return h.hexdigest()
+
+
+def _ckpt_load(path: str, key: str):
+    """-> (next_chunk_c0, member, states, alive) or None."""
+    import zipfile
+
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            if str(z["key"]) != key:
+                return None
+            return (int(z["c0"]), z["member"], z["states"], z["alive"])
+    except (FileNotFoundError, OSError, KeyError, ValueError,
+            zipfile.BadZipFile):
+        # Missing, foreign, or torn (np.savez never fsyncs, so a hard
+        # kill mid-save can install a partial zip): restart from
+        # block zero rather than crash the analysis.
+        return None
+
+
+def _ckpt_save(path: str, key: str, c0: int, member: np.ndarray,
+               states: np.ndarray, alive: np.ndarray) -> None:
+    # NB: np.savez appends ".npz" to names that lack it — the tmp
+    # name must already end in .npz or os.replace misses the real
+    # file and the except clause eats the evidence.
+    tmp = path + ".tmp.npz"
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        np.savez(tmp, key=key, c0=np.int64(c0), member=member,
+                 states=states, alive=alive)
+        os.replace(tmp, path)
+    except OSError:
+        # Checkpointing is best-effort: a full disk must not cost
+        # the verdict.
+        pass
+
+
+def _ckpt_remove(path: Optional[str]) -> None:
+    if path is None:
+        return
+    try:
+        os.remove(path)
+    except OSError:
+        pass
 
 
 def _state_hash_vec(sw: int, seed: int = 0xA11CE) -> np.ndarray:
@@ -640,12 +718,24 @@ def check_wgl_witness(
     time_limit_s: Optional[float] = None,
     pallas: str = "auto",
     compact: int = -1,
+    checkpoint_dir: Optional[str] = None,
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
 
     Returns an exact `WGLResult(valid=True)` when a witness linearization
     survives, or None when the search dies / overflows / times out —
     meaning "escalate to the exact search", never "invalid".
+
+    `checkpoint_dir`: when set, the inter-chunk carry (member window,
+    beam states, alive mask + the block cursor) is persisted there
+    after every chunk call (~32k barriers), keyed by a digest of the
+    packed history and every shape knob.  A later call on the same
+    history resumes from the last completed chunk instead of block
+    zero — SURVEY.md §5's "checkpoint long searches": a time-limited
+    or killed analysis pass doesn't forfeit progress, `analyze`
+    re-runs pick up where they stopped.  The file is removed when the
+    search concludes (witness found or frontier died); only a
+    budget-expiry exit leaves it behind.
 
     `width_hint` forces at least that window width so a warm-up run can
     pre-compile the kernels a bigger history will use (see plan_width).
@@ -727,7 +817,34 @@ def check_wgl_witness(
     identity_perm = np.arange(W, dtype=np.int32)
     prev_active: Optional[np.ndarray] = None
 
-    for c0 in range(0, len(blocks), NB):
+    ckpt_path = ckpt_key = None
+    c0_start = 0
+    if checkpoint_dir is not None:
+        ckpt_key = _ckpt_key(packed, pm, B, W, SW, K, NB, info_window)
+        # The key prefix in the filename keeps CONCURRENT searches
+        # sharing one dir (per-key checks under IndependentChecker's
+        # thread pool all get the same opts["dir"]) from clobbering —
+        # or tearing — each other's files.
+        ckpt_path = os.path.join(
+            checkpoint_dir, f"wgl-witness-{ckpt_key[:16]}.ckpt.npz"
+        )
+        saved = _ckpt_load(ckpt_path, ckpt_key)
+        if saved is not None:
+            c0_start, member_np, states_np, alive_np2 = saved
+            member = jnp.asarray(member_np)
+            states = jnp.asarray(states_np)
+            alive = jnp.asarray(alive_np2)
+            # The resumed chunk's first re-gather keys off the LAST
+            # block of the chunk before it; blocks are recomputed
+            # deterministically from the packed history, so only the
+            # cursor needed saving.  A cursor past the end (the last
+            # chunk saved c0 + NB > len) clamps: the loop is skipped
+            # and the final alive check concludes from the carry.
+            c0_start = min(c0_start, len(blocks))
+            if c0_start > 0:
+                prev_active = blocks[c0_start - 1][2]
+
+    for c0 in range(c0_start, len(blocks), NB):
         chunk_blocks = blocks[c0 : c0 + NB]
         nblk = len(chunk_blocks)
         bars_np = np.zeros((NB, 6, K), dtype=np.int32)
@@ -805,12 +922,23 @@ def check_wgl_witness(
                 info_window=info_window, max_window=max_window,
                 width_hint=width_hint, time_limit_s=remaining,
                 pallas="off", compact=compact,
+                checkpoint_dir=checkpoint_dir,
             )
         if failed_now:
+            _ckpt_remove(ckpt_path)  # concluded: a resume can't help
             return None
-        if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
-            return None
+        budget_blown = (time_limit_s is not None
+                        and time.monotonic() - t0 > time_limit_s)
+        if ckpt_path is not None and (
+            budget_blown or time.monotonic() - t0 > CKPT_MIN_ELAPSED_S
+        ):
+            _ckpt_save(ckpt_path, ckpt_key, c0 + NB,
+                       np.asarray(member), np.asarray(states),
+                       np.asarray(alive))
+        if budget_blown:
+            return None  # budget blown: the checkpoint stays for resume
 
+    _ckpt_remove(ckpt_path)
     if not bool(alive.any()):
         return None
     return WGLResult(

@@ -1,0 +1,140 @@
+"""Witness-search checkpointing (SURVEY.md §5: "the rebuild's checker
+should checkpoint long searches").
+
+The contract: a budget-expired witness run leaves a per-search
+wgl-witness-<key>.ckpt.npz in the checkpoint dir (keyed by history +
+model + search shape, so concurrent per-key searches sharing one store
+dir never collide); a later identical call resumes from the saved
+block cursor (not block zero) and reaches the identical verdict; any
+CONCLUDED search — witness found or frontier died — removes the file;
+checkpoints from a different history/shape, corrupt files, and torn
+zips are all ignored.
+"""
+
+import glob
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history.packed import pack_history
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.ops.wgl_witness import (
+    _ckpt_key,
+    check_wgl_witness,
+)
+from jepsen_tpu.utils.histgen import random_register_history
+
+PM = cas_register().packed()
+
+
+def packed_history(n=30_000, info=0.05, seed=45100):
+    h = random_register_history(n, procs=8, info_rate=info, seed=seed)
+    return pack_history(h, PM.encode)
+
+
+def ckpts(tmp_path):
+    return sorted(glob.glob(str(tmp_path / "wgl-witness-*.ckpt.npz")))
+
+
+def ckpt_path_for(tmp_path, packed, W):
+    key = _ckpt_key(packed, PM, 8, W, PM.state_width, 1024, 32, 512)
+    return key, tmp_path / f"wgl-witness-{key[:16]}.ckpt.npz"
+
+
+def test_budget_expiry_checkpoints_and_resume_completes(tmp_path):
+    packed = packed_history()
+    # Warm the kernel so the timed run's budget bounds search, not
+    # compilation.
+    assert check_wgl_witness(packed, PM).valid is True
+
+    # A budget that expires after the first chunk: the run must give
+    # up (None => escalate) but leave its progress on disk — the
+    # blown budget forces the save even under CKPT_MIN_ELAPSED_S.
+    res = check_wgl_witness(packed, PM, time_limit_s=1e-9,
+                            checkpoint_dir=str(tmp_path))
+    assert res is None
+    files = ckpts(tmp_path)
+    assert len(files) == 1, files
+    with np.load(files[0]) as z:
+        saved_c0 = int(z["c0"])
+    assert saved_c0 > 0
+
+    # Resume: same call, full budget.  It must finish valid and clean
+    # up the checkpoint.
+    res2 = check_wgl_witness(packed, PM, checkpoint_dir=str(tmp_path))
+    assert res2 is not None and res2.valid is True
+    assert not ckpts(tmp_path)
+
+
+def test_resume_skips_completed_blocks(tmp_path):
+    """The resumed run must do strictly less device work: plant a
+    checkpoint claiming every block is done and a dead beam — if the
+    engine re-swept from block zero the (valid) history would revive
+    the frontier and return a witness; honoring the cursor means it
+    sees only the dead carry and escalates."""
+    packed = packed_history()
+    assert check_wgl_witness(packed, PM).valid is True  # sanity: valid
+
+    from jepsen_tpu.ops.wgl_witness import plan_width
+
+    W = plan_width(packed)
+    key, path = ckpt_path_for(tmp_path, packed, W)
+    np.savez(str(path), key=key, c0=np.int64(10**6),
+             member=np.zeros((W, 8), dtype=bool),
+             states=np.zeros((8, PM.state_width), dtype=np.int32),
+             alive=np.zeros(8, dtype=bool))
+    res = check_wgl_witness(packed, PM, checkpoint_dir=str(tmp_path),
+                            width_hint=W)
+    assert res is None, "engine ignored the checkpoint cursor"
+
+
+def test_mismatched_checkpoint_is_ignored(tmp_path):
+    packed = packed_history()
+    other = packed_history(seed=7)
+    from jepsen_tpu.ops.wgl_witness import plan_width
+
+    W = plan_width(packed)
+    # A checkpoint keyed to a DIFFERENT history, planted at THIS
+    # search's path: the key check inside the file must reject it and
+    # the search concludes valid from scratch.
+    foreign_key = _ckpt_key(other, PM, 8, W, PM.state_width, 1024, 32,
+                            512)
+    _, path = ckpt_path_for(tmp_path, packed, W)
+    np.savez(str(path), key=foreign_key, c0=np.int64(10**6),
+             member=np.zeros((W, 8), dtype=bool),
+             states=np.zeros((8, PM.state_width), dtype=np.int32),
+             alive=np.zeros(8, dtype=bool))
+    res = check_wgl_witness(packed, PM, checkpoint_dir=str(tmp_path),
+                            width_hint=W)
+    assert res is not None and res.valid is True
+
+
+def test_concluded_search_removes_checkpoint(tmp_path):
+    packed = packed_history(n=5_000)
+    res = check_wgl_witness(packed, PM, checkpoint_dir=str(tmp_path))
+    assert res is not None and res.valid is True
+    assert not ckpts(tmp_path)
+
+
+@pytest.mark.parametrize("payload", [
+    b"not an npz",
+    None,  # torn zip: a real npz truncated mid-file
+])
+def test_corrupt_checkpoint_is_ignored(tmp_path, payload):
+    packed = packed_history(n=5_000)
+    from jepsen_tpu.ops.wgl_witness import plan_width
+
+    W = plan_width(packed)
+    _, path = ckpt_path_for(tmp_path, packed, W)
+    if payload is None:
+        np.savez(str(path), key="x", c0=np.int64(1),
+                 member=np.zeros((W, 8), dtype=bool),
+                 states=np.zeros((8, PM.state_width), dtype=np.int32),
+                 alive=np.zeros(8, dtype=bool))
+        blob = path.read_bytes()
+        path.write_bytes(blob[: len(blob) // 2])  # torn mid-save
+    else:
+        path.write_bytes(payload)
+    res = check_wgl_witness(packed, PM, checkpoint_dir=str(tmp_path),
+                            width_hint=W)
+    assert res is not None and res.valid is True
