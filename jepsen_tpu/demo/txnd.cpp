@@ -38,10 +38,16 @@
 // exhibit the anomaly (a production system's window is its
 // transaction duration; we just make ours honest and visible).
 //
-// Protocol (line-based TCP, one txn per line, executed server-side):
-//   TXN r <k> [r <k2> ...] w <k> <v> ...\n
+// Protocol (line-based TCP, one txn per line, executed server-side;
+// micro-ops execute in client order with intra-txn visibility):
+//   TXN [r <k> | w <k> <v> | a <k> <v>] ...\n
 //     -> OK [<read-val-or-NIL> per r, in order]\n   committed
+//        (list keys read back comma-joined: "1,2,3")
 //     -> ABORT\n                                    conflict: nothing applied
+//   `a` appends to a comma-joined list — the elle list-append
+//   workload's mop, a read-modify-write that rides the same
+//   isolation machinery (FCW guards it under SI; --read-committed
+//   computes it off a per-statement read and loses appends).
 //   TRANSFER <from> <to> <amount>\n    server-side read-modify-write
 //     -> OK\n          committed: from -= amount, to += amount
 //     -> NSF\n         insufficient funds: nothing applied
@@ -74,7 +80,7 @@
 
 struct Version {
   long long seq;
-  long long value;
+  std::string value;  // int string (wr/bank) or comma list (append)
 };
 
 static std::map<std::string, std::vector<Version>> g_store;
@@ -90,17 +96,17 @@ static void think() {
     std::this_thread::sleep_for(std::chrono::microseconds(g_think_us));
 }
 
-struct ReadOp {
+// One transaction micro-op, in client order: 'r' read, 'w' blind
+// write, 'a' list-append (read-modify-write of a comma-joined list).
+struct Mop {
+  char type;
   std::string key;
-};
-struct WriteOp {
-  std::string key;
-  long long value;
+  std::string value;
 };
 
 // Latest committed value of key visible at `snap`; false if none.
 static bool read_at(const std::string &key, long long snap,
-                    long long *out) {
+                    std::string *out) {
   auto it = g_store.find(key);
   if (it == g_store.end()) return false;
   const auto &vs = it->second;
@@ -120,55 +126,84 @@ static long long newest_seq(const std::string &key) {
   return it->second.back().seq;
 }
 
-static std::string run_txn(const std::vector<ReadOp> &reads,
-                           const std::vector<WriteOp> &writes) {
+static std::string run_txn(const std::vector<Mop> &mops) {
   long long snap = 0;
-  std::vector<std::pair<bool, long long>> results(reads.size());
+  std::vector<std::pair<bool, std::string>> results;  // per 'r' mop
+  // Txn-local effects: later mops of this txn see earlier ones
+  // (standard intra-txn visibility; elle's intermediate-read analysis
+  // depends on it).  Committed atomically at the end.
+  std::map<std::string, std::string> buffered;
+
+  // Reads a key as this txn sees it mid-flight: its own buffered
+  // write first, else the committed version at `at`.
+  auto visible = [&](const std::string &k, long long at,
+                     std::string *out) -> bool {
+    auto b = buffered.find(k);
+    if (b != buffered.end()) {
+      *out = b->second;
+      return true;
+    }
+    return read_at(k, at, out);
+  };
+
+  auto apply = [&](const Mop &m, long long at) {
+    std::string v;
+    if (m.type == 'r') {
+      bool have = visible(m.key, at, &v);
+      results.push_back({have, v});
+    } else if (m.type == 'w') {
+      buffered[m.key] = m.value;
+    } else {  // 'a': append to the list this txn can see
+      bool have = visible(m.key, at, &v);
+      buffered[m.key] = have && !v.empty() ? v + "," + m.value
+                                           : m.value;
+    }
+  };
+
   if (g_read_committed) {
-    // Each read is its own statement: lock per read, latest committed
-    // version, think between statements.  A commit landing in a gap
-    // is exactly read skew.
-    for (size_t i = 0; i < reads.size(); i++) {
+    // Each mop is its own statement: lock per statement, latest
+    // committed state, think between statements.  A commit landing
+    // in a gap is read skew; an append computed off a stale read is
+    // a lost append.
+    for (size_t i = 0; i < mops.size(); i++) {
       if (i > 0) think();
       std::lock_guard<std::mutex> lk(g_mu);
-      long long v = 0;
-      results[i].first = read_at(reads[i].key, g_commit_seq, &v);
-      results[i].second = v;
+      apply(mops[i], g_commit_seq);
     }
   } else {
     std::lock_guard<std::mutex> lk(g_mu);
     snap = g_commit_seq;
-    for (size_t i = 0; i < reads.size(); i++) {
-      long long v = 0;  // read_at leaves it untouched on miss
-      results[i].first = read_at(reads[i].key, snap, &v);
-      results[i].second = v;
-    }
+    for (const auto &m : mops) apply(m, snap);
   }
 
   // The transaction "thinks" between snapshot and commit — the window
   // in which a concurrent committer can invalidate its premises.
-  if (!writes.empty()) think();
+  if (!buffered.empty()) think();
 
   {
     std::lock_guard<std::mutex> lk(g_mu);
     if (!g_read_committed) {
-      for (const auto &w : writes)
-        if (newest_seq(w.key) > snap) return "ABORT";
+      // First-committer-wins on the write set (appends included:
+      // they read the key they write, so FCW also guards their
+      // read-modify-write premise).
+      for (const auto &w : buffered)
+        if (newest_seq(w.first) > snap) return "ABORT";
       if (g_serializable)
-        for (const auto &r : reads)
-          if (newest_seq(r.key) > snap) return "ABORT";
+        for (const auto &m : mops)
+          if (m.type == 'r' && newest_seq(m.key) > snap)
+            return "ABORT";
     }
-    if (!writes.empty()) {
+    if (!buffered.empty()) {
       long long seq = ++g_commit_seq;
-      for (const auto &w : writes)
-        g_store[w.key].push_back({seq, w.value});
+      for (const auto &w : buffered)
+        g_store[w.first].push_back({seq, w.second});
     }
   }
 
   std::ostringstream out;
   out << "OK";
   for (const auto &res : results) {
-    if (res.first)
+    if (res.first && !res.second.empty())
       out << " " << res.second;
     else
       out << " NIL";
@@ -190,24 +225,27 @@ static std::string run_transfer(const std::string &from,
   // destroys money under EVERY isolation level, which the bank
   // checker would then blame on isolation.  Malformed, not a txn.
   if (from == to || amount <= 0) return "ERR bad transfer";
-  long long snap = 0, bal_from = 0, bal_to = 0;
+  long long snap = 0;
+  std::string raw_from, raw_to;
   bool have_from = false, have_to = false;
   if (g_read_committed) {
     {
       std::lock_guard<std::mutex> lk(g_mu);
-      have_from = read_at(from, g_commit_seq, &bal_from);
+      have_from = read_at(from, g_commit_seq, &raw_from);
     }
     think();
     {
       std::lock_guard<std::mutex> lk(g_mu);
-      have_to = read_at(to, g_commit_seq, &bal_to);
+      have_to = read_at(to, g_commit_seq, &raw_to);
     }
   } else {
     std::lock_guard<std::mutex> lk(g_mu);
     snap = g_commit_seq;
-    have_from = read_at(from, snap, &bal_from);
-    have_to = read_at(to, snap, &bal_to);
+    have_from = read_at(from, snap, &raw_from);
+    have_to = read_at(to, snap, &raw_to);
   }
+  long long bal_from = atoll(raw_from.c_str());
+  long long bal_to = atoll(raw_to.c_str());
   if (!have_from || bal_from < amount) return "NSF";
 
   think();
@@ -221,8 +259,9 @@ static std::string run_transfer(const std::string &from,
         return "ABORT";
     }
     long long seq = ++g_commit_seq;
-    g_store[from].push_back({seq, bal_from - amount});
-    g_store[to].push_back({seq, have_to ? bal_to + amount : amount});
+    g_store[from].push_back({seq, std::to_string(bal_from - amount)});
+    g_store[to].push_back(
+        {seq, std::to_string(have_to ? bal_to + amount : amount)});
   }
   return "OK";
 }
@@ -245,26 +284,34 @@ static void serve(int fd) {
     if (cmd == "PING") {
       resp = "PONG";
     } else if (cmd == "TXN") {
-      std::vector<ReadOp> reads;
-      std::vector<WriteOp> writes;
+      std::vector<Mop> mops;
       std::string op;
       bool bad = false;
       while (ss >> op) {
         if (op == "r") {
           std::string k;
           if (!(ss >> k)) { bad = true; break; }
-          reads.push_back({k});
-        } else if (op == "w") {
-          std::string k;
-          long long v;
+          mops.push_back({'r', k, ""});
+        } else if (op == "w" || op == "a") {
+          std::string k, v;
           if (!(ss >> k >> v)) { bad = true; break; }
-          writes.push_back({k, v});
+          // Values are integers on the wire (appends build the comma
+          // lists server-side).  The old `>> long long` rejected
+          // garbage; keep that guard — a committed non-numeric value
+          // would silently zero bank balances via atoll later.
+          size_t p = (v[0] == '-') ? 1 : 0;
+          if (p >= v.size() ||
+              v.find_first_not_of("0123456789", p) != std::string::npos) {
+            bad = true;
+            break;
+          }
+          mops.push_back({op[0], k, v});
         } else {
           bad = true;
           break;
         }
       }
-      resp = bad ? "ERR bad txn" : run_txn(reads, writes);
+      resp = bad ? "ERR bad txn" : run_txn(mops);
     } else if (cmd == "TRANSFER") {
       std::string from, to;
       long long amount;
@@ -300,7 +347,7 @@ int main(int argc, char **argv) {
       g_think_us = atol(argv[++i]);
     else if (a == "--init" && i + 2 < argc) {
       std::string key = argv[++i];
-      long long value = atoll(argv[++i]);
+      std::string value = argv[++i];
       g_store[key].push_back({++g_commit_seq, value});
     } else {
       fprintf(stderr, "unknown arg %s\n", a.c_str());

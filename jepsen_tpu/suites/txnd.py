@@ -188,13 +188,19 @@ class TxndClient(jc.Client):
             return op.complete(INFO, error=resp)
         return resp
 
+    #: Protocol verb for non-read mops; the append subclass swaps it.
+    WRITE_VERB = "w"
+
+    def _parse_read(self, raw: str):
+        return None if raw == "NIL" else int(raw)
+
     def invoke(self, test: dict, op: Op) -> Op:
         parts = ["TXN"]
         for mop in op.value or []:
             if mop[0] == "r":
                 parts += ["r", f"k{mop[1]}"]
             else:
-                parts += ["w", f"k{mop[1]}", str(mop[2])]
+                parts += [self.WRITE_VERB, f"k{mop[1]}", str(mop[2])]
         resp = self._roundtrip(" ".join(parts), op)
         if isinstance(resp, Op):
             return resp
@@ -205,9 +211,7 @@ class TxndClient(jc.Client):
             if mop[0] == "r":
                 raw = reads[i] if i < len(reads) else "NIL"
                 i += 1
-                filled.append(
-                    ["r", mop[1], None if raw == "NIL" else int(raw)]
-                )
+                filled.append(["r", mop[1], self._parse_read(raw)])
             else:
                 filled.append(mop)
         return op.complete(OK, value=filled)
@@ -218,6 +222,17 @@ class TxndClient(jc.Client):
                 self.sock.close()
         except OSError:
             pass
+
+
+class TxndAppendClient(TxndClient):
+    """elle list-append mops over the `a` protocol verb: appends ride
+    the server's MVCC read-modify-write, reads come back comma-joined
+    and are filled into the mop list the AppendChecker consumes."""
+
+    WRITE_VERB = "a"
+
+    def _parse_read(self, raw: str):
+        return [] if raw == "NIL" else [int(x) for x in raw.split(",")]
 
 
 class TxndBankClient(TxndClient):
@@ -256,7 +271,22 @@ def txnd_test(opts: dict) -> dict:
     )
     workload = opts.get("workload", "wr")
     extra: dict = {}
-    if workload == "bank":
+    if workload == "append":
+        from ..checker.elle import AppendChecker, AppendGen
+
+        base_gen = FnGen(AppendGen(
+            key_count=opts.get("key-count", 10),
+            max_txn_length=opts.get("max-txn-length", 4),
+            rng=random.Random(opts.get("seed")),
+        ))
+        client: jc.Client = TxndAppendClient()
+        checkers: dict = {
+            "elle-append": AppendChecker(
+                opts.get("consistency-model", "serializable")
+            ),
+        }
+        name = "txnd-append"
+    elif workload == "bank":
         accounts = list(range(opts.get("accounts", 8)))
         total = opts.get("total-amount", bank.DEFAULT_TOTAL)
         base_gen = bank.generator(
@@ -354,8 +384,10 @@ def _extra_opts(p) -> None:
     p.add_argument("--key-count", type=int, default=4)
     p.add_argument("--max-txn-length", type=int, default=4)
     p.add_argument("--think-us", type=int, default=2000)
-    p.add_argument("--workload", default="wr", choices=["wr", "bank"],
-                   help="wr: elle rw-register (write skew); bank: "
+    p.add_argument("--workload", default="wr",
+                   choices=["wr", "append", "bank"],
+                   help="wr: elle rw-register (write skew); append: "
+                   "elle list-append over MVCC lists; bank: "
                    "conserved-total transfers (read skew / lost "
                    "updates under --read-committed)")
     p.add_argument("--accounts", type=int, default=8)
@@ -379,16 +411,18 @@ def main(argv=None) -> int:
         group (cli.clj:501-529 pattern) — wr convicts SI vs the
         serializable control; bank convicts read committed vs the SI
         control."""
-        for serializable in (False, True):
-            # Force RC off: a stray --read-committed would otherwise
-            # override --serializable in the binary and convict the
-            # control group for the wrong reason.
-            o = dict(opt_map, workload="wr", serializable=serializable,
-                     **{"read-committed": False})
-            t = jcli.localize_test(txnd_test(o))
-            t["name"] = ("txnd-wr-serializable" if serializable
-                         else "txnd-wr-si")
-            yield t
+        for workload in ("wr", "append"):
+            for serializable in (False, True):
+                # Force RC off: a stray --read-committed would
+                # otherwise override --serializable in the binary and
+                # convict the control group for the wrong reason.
+                o = dict(opt_map, workload=workload,
+                         serializable=serializable,
+                         **{"read-committed": False})
+                t = jcli.localize_test(txnd_test(o))
+                t["name"] = (f"txnd-{workload}-serializable"
+                             if serializable else f"txnd-{workload}-si")
+                yield t
         for read_committed in (True, False):
             o = dict(opt_map, workload="bank",
                      serializable=False,

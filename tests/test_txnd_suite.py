@@ -66,6 +66,44 @@ def test_serializable_control_group_valid(tmp_path):
 
 
 @pytest.mark.slow
+def test_append_si_write_skew_convicted(tmp_path):
+    """The flagship elle workload against the real MVCC store: SI
+    admits anti-dependency cycles over list-appends that
+    serializability forbids; the list-append checker must convict
+    with a cycle anomaly and leave its artifact trail."""
+    last = None
+    for attempt in range(3):
+        done = run_txnd(tmp_path / f"a{attempt}", workload="append",
+                        seed=attempt)
+        res = done["results"]
+        last = res
+        sub = res["elle-append"]
+        if sub["valid"] is False:
+            bad = set(sub["anomaly-types"])
+            assert bad & {"G2-item", "G2", "G-single"}, sub
+            trail = (tmp_path / f"a{attempt}" / "store" / "txnd-append"
+                     / "latest" / "elle-append")
+            assert (trail / "anomalies.json").exists()
+            return
+    pytest.fail(f"3 SI append runs never exhibited write skew: {last}")
+
+
+@pytest.mark.slow
+def test_append_serializable_control_valid(tmp_path):
+    done = run_txnd(tmp_path, workload="append", serializable=True)
+    res = done["results"]
+    assert res["valid"] is True, res
+    oks = [o for o in done["history"]
+           if o.type == "ok" and o.f == "txn"]
+    assert len(oks) > 100, len(oks)
+    # Reads actually observed lists (the protocol round-trips them).
+    assert any(
+        mop[0] == "r" and mop[2]
+        for o in oks for mop in (o.value or [])
+    )
+
+
+@pytest.mark.slow
 def test_bank_read_committed_convicted(tmp_path):
     """The bank workload against --read-committed txnd: per-statement
     reads admit read skew and blind writes admit lost updates, so
