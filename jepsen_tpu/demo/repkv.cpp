@@ -359,6 +359,44 @@ void serve(int fd) {
         }
       }
       if (mutated) await_acks(seq);
+    } else if (cmd == "ADD") {
+      // Set face: primary-only atomic append to a comma-joined
+      // element list, replicated INCREMENTALLY (one small
+      // "REPL .. ADD k v" line per element — a full-list SET line
+      // would outgrow the 4096-byte request buffers within a
+      // minute-long run and tear on the wire).  The per-peer queue
+      // retains lines until ACKed and ships them FIFO, so a healed
+      // partition converges; DURING it, backups serve frozen lists —
+      // the staleness the set-full checker measures.
+      std::string k, v;
+      in >> k >> v;
+      long long seq = 0;
+      bool mutated = false;
+      {
+        std::lock_guard<std::mutex> l(g_mu);
+        if (!g_primary) {
+          resp = "ERR notprimary";
+        } else {
+          std::string& cur = g_kv[k];
+          cur = cur.empty() ? v : cur + "," + v;
+          mutated = true;
+          seq = ++g_seq;
+          std::ostringstream repl;
+          repl << "REPL " << g_id << " " << seq << " ADD " << k << " "
+               << v << "\n";
+          enqueue_all_g_mu_held(repl.str());
+          resp = "OK";
+        }
+      }
+      if (mutated) await_acks(seq);
+    } else if (cmd == "MEMBERS") {
+      std::string k;
+      in >> k;
+      std::lock_guard<std::mutex> l(g_mu);
+      auto it = g_kv.find(k);
+      resp = (it == g_kv.end() || it->second.empty())
+                 ? "NIL"
+                 : ("VAL " + it->second);
     } else if (cmd == "REPL") {
       int from;
       long long seq;
@@ -380,6 +418,9 @@ void serve(int fd) {
         if (seq > applied) {
           if (op == "VIEW") {
             views_changed = install_view(atoll(k.c_str()), v);
+          } else if (op == "ADD") {
+            std::string& cur = g_kv[k];
+            cur = cur.empty() ? v : cur + "," + v;
           } else {
             g_kv[k] = v;
           }

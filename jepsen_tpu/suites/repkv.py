@@ -394,7 +394,7 @@ class RepkvClient(jc.Client):
         self.node: Any = None
 
     def open(self, test, node):
-        c = RepkvClient(self.key)
+        c = type(self)(self.key)
         c.node = node
         primary = (
             discover_primary(test)
@@ -456,6 +456,32 @@ class RepkvClient(jc.Client):
                 pass
 
 
+class RepkvSetClient(RepkvClient):
+    """Set face: atomic ADDs at the primary, MEMBERS reads from the
+    client's own node.  A partitioned backup's list freezes, so its
+    reads omit acknowledged elements — exactly the stale reads the
+    set-full checker's per-element lifecycle analysis measures
+    (checker.clj:487-612), and convicts when linearizable=True."""
+
+    def __init__(self, key: str = "s"):
+        super().__init__(key)
+
+    def invoke(self, test, op):
+        if op.f == "add":
+            resp = self._round_trip(self.write_sock,
+                                    f"ADD {self.key} {op.value}")
+            if resp == "OK":
+                return op.complete(OK)
+            return op.complete(FAIL, error=resp)
+        resp = self._round_trip(self.read_sock, f"MEMBERS {self.key}")
+        if resp == "NIL":
+            return op.complete(OK, value=[])
+        vals = resp.split(" ", 1)[1]
+        return op.complete(
+            OK, value=[int(v) for v in vals.split(",") if v]
+        )
+
+
 def repkv_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137 shape)."""
     import random
@@ -468,7 +494,32 @@ def repkv_test(opts: dict) -> dict:
         else ["partition"]
     )
     rng = random.Random(opts.get("seed"))
-    workload_gen = register_workload_gen(rng)
+    workload_name = opts.get("workload", "register")
+    if workload_name == "set":
+        from ..workloads import register_set
+
+        def workload_gen():
+            return register_set.generator(
+                full=True, read_fraction=0.5, rng=rng
+            )
+
+        client = RepkvSetClient()
+        checkers = {
+            # linearizable=True: a read invoked after an add completed
+            # that omits the element is a violation — which is what
+            # unsafe (own-node) reads against lagging replication
+            # produce.  The safe-reads control passes the same bar.
+            "set-full": chk.SetFull(linearizable=True),
+        }
+    else:
+        workload_gen = register_workload_gen(rng)
+        client = RepkvClient()
+        checkers = {
+            "linear": Linearizable(
+                algorithm=opts.get("algorithm", "wgl-tpu"),
+                time_limit_s=60.0,
+            ),
+        }
 
     pkg_opts = {
         "faults": faults,
@@ -512,26 +563,20 @@ def repkv_test(opts: dict) -> dict:
         generator = phases(generator, gen_nemesis(pkg["final-generator"]))
 
     store_root = os.path.abspath(opts.get("store-dir") or "store")
+    # Composed with timeline + stats like the reference's canonical
+    # test maps (zookeeper.clj:112-137): every run leaves a browsable
+    # trail, convicted or not.
+    checkers.update({"timeline": Timeline(), "stats": chk.Stats()})
     test = {
-        "name": "repkv-register",
+        "name": f"repkv-{workload_name}",
         "nodes": nodes,
         "db": RepkvDB(),
         "net": RepkvNet(),
-        "client": RepkvClient(),
+        "client": client,
         "nemesis": pkg["nemesis"],
         "generator": generator,
         "model": cas_register(),
-        # Composed with timeline + stats like the reference's canonical
-        # test maps (zookeeper.clj:112-137): every run leaves a
-        # browsable trail, convicted or not.
-        "checker": chk.compose({
-            "linear": Linearizable(
-                algorithm=opts.get("algorithm", "wgl-tpu"),
-                time_limit_s=60.0,
-            ),
-            "timeline": Timeline(),
-            "stats": chk.Stats(),
-        }),
+        "checker": chk.compose(checkers),
         "repkv-sync": opts.get("sync", True),
         "repkv-safe-reads": opts.get("safe-reads", False),
         "repkv-failover": "membership" in faults,
@@ -541,6 +586,17 @@ def repkv_test(opts: dict) -> dict:
         "repkv-base-port": cutil.hashed_base_port(store_root,
                                                   BASE_PORT),
     }
+    if workload_name == "set":
+        # set-full needs reads AFTER the last add to witness every
+        # element's fate (trailing adds otherwise leave the verdict
+        # unknown) — register_set's until-ok final-read
+        # (generator.clj:1470).
+        from ..workloads import register_set
+
+        test["final-generator"] = time_limit(
+            opts.get("final-time-limit", 20.0),
+            stagger(0.05, register_set.final_generator()),
+        )
     return test
 
 
@@ -550,6 +606,11 @@ def _extra_opts(p) -> None:
                             "grow-shrink"])
     p.add_argument("--rate", type=float, default=100.0)
     p.add_argument("--interval", type=float, default=3.0)
+    p.add_argument("--workload", default="register",
+                   choices=["register", "set"],
+                   help="register: linearizable reads/writes/cas; "
+                   "set: atomic adds + member reads under the "
+                   "set-full lifecycle analysis")
     p.add_argument("--no-sync", dest="sync", action="store_false",
                    help="fully asynchronous replication")
     p.add_argument("--safe-reads", action="store_true",
@@ -565,13 +626,14 @@ def main(argv=None) -> int:
     def all_suites(opt_map: dict):
         """test-all: the stale-read conviction run and its safe-reads
         control group (cli.clj:501-529 pattern)."""
-        for safe in (False, True):
-            o = dict(opt_map)
-            o["safe-reads"] = safe
-            t = jcli.localize_test(repkv_test(o))
-            t["name"] = ("repkv-register-safe-reads" if safe
-                         else "repkv-register-unsafe")
-            yield t
+        for workload in ("register", "set"):
+            for safe in (False, True):
+                o = dict(opt_map, workload=workload)
+                o["safe-reads"] = safe
+                t = jcli.localize_test(repkv_test(o))
+                t["name"] = (f"repkv-{workload}-safe-reads" if safe
+                             else f"repkv-{workload}-unsafe")
+                yield t
 
     parser = jcli.single_test_cmd(
         suite, name="repkv", extra_opts=_extra_opts,

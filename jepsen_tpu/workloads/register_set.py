@@ -36,17 +36,22 @@ class InMemorySetClient(jc.Client):
         return True
 
 
-def generator(full: bool = False):
+def generator(full: bool = False, read_fraction: float = 0.1,
+              rng=None):
     """Unique adds, then a final read retried until it succeeds
     (the zookeeper.clj:120-127 shape).  With full=True, reads are
-    interleaved throughout for the set-full checker."""
+    interleaved throughout at `read_fraction` for the set-full
+    checker — staleness-hunting suites want a dense read stream
+    (repkv uses 0.5)."""
+    import random as _random
+
     counter = itertools.count()
     adds = FnGen(lambda: {"f": "add", "value": next(counter)})
     if full:
-        import random
+        r = rng or _random
 
         def step():
-            if random.random() < 0.1:
+            if r.random() < read_fraction:
                 return {"f": "read"}
             return {"f": "add", "value": next(counter)}
 

@@ -69,6 +69,40 @@ def test_stale_backup_reads_caught(tmp_path):
 
 
 @pytest.mark.slow
+def test_set_full_convicts_stale_backup_members(tmp_path):
+    """The set face: partitioned backups serve frozen MEMBERS lists,
+    so reads invoked after an add's ack omit the element — set-full's
+    per-element lifecycle analysis (checker.clj:487-612) must convict
+    under linearizable=True (stale or lost elements reported)."""
+    for attempt in range(3):
+        done = run_repkv(
+            tmp_path / f"a{attempt}", workload="set",
+            **{"safe-reads": False, "faults": ["partition"],
+               "time-limit": 10.0, "interval": 1.0, "seed": attempt},
+        )
+        res = done["results"]
+        sub = res["set-full"]
+        if sub["valid"] is False:
+            assert sub["stale-count"] > 0 or sub["lost-count"] > 0, sub
+            assert not sub["unexpected"], sub  # phantoms would be a bug
+            return
+    pytest.fail(f"3 partitioned set runs never went stale: {res}")
+
+
+@pytest.mark.slow
+def test_set_full_safe_reads_control(tmp_path):
+    """Primary-routed MEMBERS reads under the identical partition
+    schedule: every element's lifecycle checks out."""
+    done = run_repkv(tmp_path, workload="set",
+                     **{"safe-reads": True, "faults": ["partition"]})
+    res = done["results"]
+    sub = res["set-full"]
+    assert sub["valid"] is True, sub
+    assert sub["ok-count"] > 50, sub
+    assert sub["lost-count"] == 0 and not sub["unexpected"], sub
+
+
+@pytest.mark.slow
 def test_primary_reflection_and_kill_recovery(tmp_path):
     done = run_repkv(tmp_path, **{"safe-reads": True, "faults": ["kill"],
                                   "time-limit": 6.0})
