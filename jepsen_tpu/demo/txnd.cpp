@@ -65,7 +65,9 @@
 #include <netinet/tcp.h>
 #include <signal.h>
 #include <stdio.h>
+#include <stdint.h>
 #include <stdlib.h>
+#include <time.h>
 #include <string.h>
 #include <sys/socket.h>
 #include <unistd.h>
@@ -92,8 +94,17 @@ static bool g_read_committed = false;
 static long g_think_us = 2000;
 
 static void think() {
-  if (g_think_us > 0)
-    std::this_thread::sleep_for(std::chrono::microseconds(g_think_us));
+  // Uniform in [0, 2*think_us] (mean = think_us): real transactions
+  // have VARIED durations, and heterogeneity is load-bearing for
+  // observability — with a fixed gap, every read in a group sits on
+  // the same side of the write-separation threshold, so e.g. the
+  // long-fork anomaly's two contradictory read directions can never
+  // coexist (measured: 82 partial-sighting groups, all one-sided).
+  if (g_think_us <= 0) return;
+  thread_local unsigned seed =
+      (unsigned)(uintptr_t)&seed ^ (unsigned)time(nullptr);
+  long us = (long)(rand_r(&seed) % (2 * g_think_us + 1));
+  std::this_thread::sleep_for(std::chrono::microseconds(us));
 }
 
 // One transaction micro-op, in client order: 'r' read, 'w' blind

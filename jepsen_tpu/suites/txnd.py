@@ -286,6 +286,21 @@ def txnd_test(opts: dict) -> dict:
             ),
         }
         name = "txnd-append"
+    elif workload == "long-fork":
+        from ..workloads import long_fork
+
+        # Plain r/w mops — TxndClient speaks them as-is.  The
+        # conviction target is --read-committed: per-statement reads
+        # observe two writers' commits in contradictory orders (the
+        # long fork, long_fork.clj:1-60); SI's consistent snapshots
+        # forbid it, so the DEFAULT mode is this workload's control.
+        base_gen = long_fork.generator(
+            opts.get("group-size", 2),
+            random.Random(opts.get("seed")),
+        )
+        client = TxndClient()
+        checkers = {"long-fork": long_fork.LongForkChecker()}
+        name = "txnd-long-fork"
     elif workload == "bank":
         accounts = list(range(opts.get("accounts", 8)))
         total = opts.get("total-amount", bank.DEFAULT_TOTAL)
@@ -363,7 +378,17 @@ def txnd_test(opts: dict) -> dict:
         "checker": chk.compose(checkers),
         "txnd-serializable": bool(opts.get("serializable")),
         "txnd-read-committed": bool(opts.get("read-committed")),
-        "txnd-think-us": opts.get("think-us", 2000),
+        # Per-workload think default — long-fork needs a wide
+        # inter-statement gap (a fork requires one reader's gap to
+        # straddle BOTH write commits while another reader lands
+        # between them; at 2 ms the straddle never happens in a short
+        # run).  The CLI flag leaves it None so this default applies
+        # through both entry paths.
+        "txnd-think-us": (
+            opts.get("think-us")
+            if opts.get("think-us") is not None
+            else (20000 if workload == "long-fork" else 2000)
+        ),
         "txnd-dir": opts.get("txnd-dir") or os.path.join(
             store_root, "txnd-data"
         ),
@@ -383,13 +408,17 @@ def _extra_opts(p) -> None:
     p.add_argument("--interval", type=float, default=3.0)
     p.add_argument("--key-count", type=int, default=4)
     p.add_argument("--max-txn-length", type=int, default=4)
-    p.add_argument("--think-us", type=int, default=2000)
+    p.add_argument("--think-us", type=int, default=None,
+                   help="mean transaction think window in us "
+                   "(default 2000; 20000 for --workload long-fork)")
     p.add_argument("--workload", default="wr",
-                   choices=["wr", "append", "bank"],
+                   choices=["wr", "append", "bank", "long-fork"],
                    help="wr: elle rw-register (write skew); append: "
                    "elle list-append over MVCC lists; bank: "
                    "conserved-total transfers (read skew / lost "
-                   "updates under --read-committed)")
+                   "updates under --read-committed); long-fork: "
+                   "contradictory read orders under --read-committed")
+    p.add_argument("--group-size", type=int, default=2)
     p.add_argument("--accounts", type=int, default=8)
     p.add_argument("--serializable", action="store_true",
                    help="validate read sets at commit (the control "
@@ -423,14 +452,16 @@ def main(argv=None) -> int:
                 t["name"] = (f"txnd-{workload}-serializable"
                              if serializable else f"txnd-{workload}-si")
                 yield t
-        for read_committed in (True, False):
-            o = dict(opt_map, workload="bank",
-                     serializable=False,
-                     **{"read-committed": read_committed})
-            t = jcli.localize_test(txnd_test(o))
-            t["name"] = ("txnd-bank-read-committed" if read_committed
-                         else "txnd-bank-si")
-            yield t
+        for workload in ("bank", "long-fork"):
+            for read_committed in (True, False):
+                o = dict(opt_map, workload=workload,
+                         serializable=False,
+                         **{"read-committed": read_committed})
+                t = jcli.localize_test(txnd_test(o))
+                t["name"] = (f"txnd-{workload}-read-committed"
+                             if read_committed
+                             else f"txnd-{workload}-si")
+                yield t
 
     parser = jcli.single_test_cmd(
         suite, name="txnd", extra_opts=_extra_opts,

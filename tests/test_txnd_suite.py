@@ -104,6 +104,38 @@ def test_append_serializable_control_valid(tmp_path):
 
 
 @pytest.mark.slow
+def test_long_fork_read_committed_convicted(tmp_path):
+    """Per-statement reads under --read-committed observe two writers'
+    commits in contradictory orders — the long fork
+    (long_fork.clj:1-60) — which SI's consistent snapshots forbid."""
+    last = None
+    for attempt in range(3):
+        done = run_txnd(
+            tmp_path / f"a{attempt}", workload="long-fork",
+            seed=attempt, **{"read-committed": True},
+        )
+        res = done["results"]
+        last = res
+        if res["long-fork"]["valid"] is False:
+            assert res["long-fork"]["forks"], res["long-fork"]
+            return
+    pytest.fail(f"3 RC long-fork runs never forked: {last}")
+
+
+@pytest.mark.slow
+def test_long_fork_si_control_valid(tmp_path):
+    done = run_txnd(tmp_path, workload="long-fork")
+    res = done["results"]
+    assert res["valid"] is True, res
+    group_reads = [
+        o for o in done["history"]
+        if o.type == "ok" and o.f == "txn" and o.value
+        and all(m[0] == "r" for m in o.value) and len(o.value) > 1
+    ]
+    assert len(group_reads) > 50, len(group_reads)
+
+
+@pytest.mark.slow
 def test_bank_read_committed_convicted(tmp_path):
     """The bank workload against --read-committed txnd: per-statement
     reads admit read skew and blind writes admit lost updates, so
