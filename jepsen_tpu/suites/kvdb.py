@@ -231,6 +231,24 @@ class KvdbCounterClient(KvdbClient):
         c.think_s = test.get("kvdb-rmw-think-s", 0.002)
         return c
 
+    def _racy_rmw(self, delta: int) -> Optional[int]:
+        """The naive GET / think / SET increment.  Returns the value
+        written, or None when the SET reply was unrecognized (caller
+        completes INFO).  The think pause is the honest client-side
+        analog of txnd's --think-us — a real deployment's window is
+        its read-modify-write latency, ours is just made visible."""
+        resp = self._round_trip(f"GET {self.COUNTER_KEY}")
+        cur = 0 if resp == "NIL" else int(resp.split(" ", 1)[1])
+        if self.think_s:
+            time.sleep(self.think_s)
+        nxt = cur + delta
+        resp = self._round_trip(f"SET {self.COUNTER_KEY} {nxt}")
+        return nxt if resp == "OK" else None
+
+    def _atomic_incr(self, delta: int) -> Optional[int]:
+        resp = self._round_trip(f"INCR {self.COUNTER_KEY} {delta}")
+        return int(resp.split()[1]) if resp.startswith("VAL ") else None
+
     def invoke(self, test: dict, op: Op) -> Op:
         k = self.COUNTER_KEY
         try:
@@ -240,19 +258,48 @@ class KvdbCounterClient(KvdbClient):
                 return op.complete(OK, value=v)
             if op.f != "add":
                 raise ValueError(f"unknown f {op.f!r}")
-            if self.atomic:
-                resp = self._round_trip(f"INCR {k} {op.value}")
-                return op.complete(
-                    OK if resp.startswith("VAL ") else INFO, error=None
-                )
-            resp = self._round_trip(f"GET {k}")
-            cur = 0 if resp == "NIL" else int(resp.split(" ", 1)[1])
-            if self.think_s:
-                time.sleep(self.think_s)
-            resp = self._round_trip(f"SET {k} {cur + op.value}")
-            return op.complete(OK if resp == "OK" else INFO, error=None)
+            incr = self._atomic_incr if self.atomic else self._racy_rmw
+            applied = incr(op.value)
+            if applied is None:
+                return op.complete(INFO, error="unrecognized reply")
+            return op.complete(OK)
         except (socket.timeout, TimeoutError) as e:
             return op.complete(INFO, error=f"timeout: {e}")
+
+
+class KvdbIdClient(KvdbCounterClient):
+    """ID generation on one key (checker.clj:710-747's quarry): the
+    conviction arm computes its next id with the naive GET+SET round
+    trip and returns it — two racers read the same current value and
+    hand out the SAME id.  The atomic arm returns INCR's result,
+    unique by construction."""
+
+    COUNTER_KEY = "ids"
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        if op.f != "generate":
+            raise ValueError(f"unknown f {op.f!r}")
+        try:
+            incr = self._atomic_incr if self.atomic else self._racy_rmw
+            new_id = incr(1)
+            if new_id is None:
+                return op.complete(INFO, error="unrecognized reply")
+            return op.complete(OK, value=new_id)
+        except (socket.timeout, TimeoutError) as e:
+            return op.complete(INFO, error=f"timeout: {e}")
+
+
+def ids_workload(opts: dict) -> dict:
+    """Every acknowledged generate must return a distinct id."""
+    return {
+        "client": KvdbIdClient(),
+        "generator": FnGen(lambda: {"f": "generate"}),
+        "checker": chk.compose({
+            "unique-ids": chk.UniqueIds(),
+            "timeline": Timeline(),
+            "stats": chk.Stats(),
+        }),
+    }
 
 
 def counter_workload(opts: dict) -> dict:
@@ -318,13 +365,15 @@ def kvdb_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137)."""
     workload_name = opts.get("workload", "register")
     wl = {"register": register_workload, "set": set_workload,
-          "counter": counter_workload}[workload_name](opts)
+          "counter": counter_workload,
+          "ids": ids_workload}[workload_name](opts)
     # NB: an explicit empty list means "no faults" — `or` would
     # silently substitute the default (the logd bug, round 3).
     # Counter defaults faultless: its anomaly is the client's RMW
     # race, surfaced by plain concurrency (the txnd pattern) — a kill
     # would add durability loss both arms share, muddying the control.
-    default_faults = [] if workload_name == "counter" else ["kill"]
+    default_faults = ([] if workload_name in ("counter", "ids")
+                      else ["kill"])
     faults = set(
         opts["faults"] if opts.get("faults") is not None
         else default_faults
@@ -373,10 +422,11 @@ def kvdb_test(opts: dict) -> dict:
 
 def _extra_opts(p) -> None:
     p.add_argument("--workload", default="register",
-                   choices=["register", "set", "counter"])
+                   choices=["register", "set", "counter", "ids"])
     p.add_argument("--atomic-incr", action="store_true",
-                   help="counter workload: use the server's atomic "
-                   "INCR (the control group) instead of racy GET+SET")
+                   help="counter/ids workloads: use the server's "
+                   "atomic INCR (the control group) instead of racy "
+                   "GET+SET")
     p.add_argument("--rmw-think-s", type=float, default=0.002)
     p.add_argument("--faults", action="append", default=None,
                    choices=["kill", "pause", "partition"],
@@ -415,18 +465,19 @@ def main(argv=None) -> int:
                 t = _localize(kvdb_test(o), o)
                 t["name"] = f"kvdb-{workload}-{'-'.join(faults)}"
                 yield t
-        # Counter pair: racy-RMW conviction and its atomic control
-        # (faultless — the race is the anomaly).
-        for atomic in (False, True):
-            # faults=[] explicitly: inheriting e.g. --faults kill from
-            # opt_map would add durability loss both arms share and
-            # falsely convict the atomic control.
-            o = dict(opt_map, workload="counter", faults=[],
-                     **{"atomic-incr": atomic})
-            t = _localize(kvdb_test(o), o)
-            t["name"] = ("kvdb-counter-atomic" if atomic
-                         else "kvdb-counter-rmw")
-            yield t
+        # Counter and unique-ids pairs: racy-RMW conviction and the
+        # atomic control (faultless — the race is the anomaly).
+        for workload in ("counter", "ids"):
+            for atomic in (False, True):
+                # faults=[] explicitly: inheriting e.g. --faults kill
+                # from opt_map would add durability loss both arms
+                # share and falsely convict the atomic control.
+                o = dict(opt_map, workload=workload, faults=[],
+                         **{"atomic-incr": atomic})
+                t = _localize(kvdb_test(o), o)
+                t["name"] = (f"kvdb-{workload}-atomic" if atomic
+                             else f"kvdb-{workload}-rmw")
+                yield t
 
     parser = jcli.single_test_cmd(
         suite, name="kvdb", extra_opts=_extra_opts, tests_fn=all_suites

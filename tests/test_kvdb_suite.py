@@ -82,6 +82,38 @@ def test_counter_rmw_loses_updates(tmp_path):
     pytest.fail("3 racy-RMW counter runs never lost an update")
 
 
+def test_unique_ids_rmw_hands_out_duplicates(tmp_path):
+    """ID generation via naive GET+SET: two racers compute the same
+    next id — unique-ids (checker.clj:710-747) convicts."""
+    for attempt in range(3):
+        code = run_suite(
+            tmp_path / f"a{attempt}", "--workload", "ids",
+            "--time-limit", "6", "--rate", "200",
+            "--concurrency", "8", "--seed", str(attempt),
+        )
+        if code == cli.EXIT_INVALID:
+            d = store.latest(str(tmp_path / f"a{attempt}" / "store"))
+            tf = store.load(d)
+            res = tf.results
+            assert res["unique-ids"]["duplicated-count"] > 0, res
+            tf.close()
+            return
+    pytest.fail("3 racy-RMW id runs never duplicated an id")
+
+
+def test_unique_ids_atomic_incr_control(tmp_path):
+    code = run_suite(
+        tmp_path, "--workload", "ids", "--atomic-incr",
+        "--time-limit", "6", "--rate", "200", "--concurrency", "8",
+    )
+    assert code == cli.EXIT_VALID
+    d = store.latest(str(tmp_path / "store"))
+    tf = store.load(d)
+    res = tf.results
+    assert res["unique-ids"]["acknowledged-count"] > 200, res
+    tf.close()
+
+
 def test_counter_atomic_incr_control(tmp_path):
     """The server-side INCR under the same workload: every read within
     bounds."""
