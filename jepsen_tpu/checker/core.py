@@ -593,7 +593,11 @@ class LogFilePattern(Checker):
 
     def check(self, test, history, opts):
         matches = []
-        store_dir = opts.get("store_dir") or test.get("store_dir")
+        # opts["dir"] is the RUN dir — where snarf_logs puts
+        # <node>/<logfile> (core.py analyze / db.py snarf_logs);
+        # the explicit keys are unit-test/manual overrides.
+        store_dir = (opts.get("store_dir") or opts.get("dir")
+                     or test.get("store_dir"))
         if store_dir:
             for node in test.get("nodes", []):
                 path = os.path.join(store_dir, str(node), self.filename)

@@ -225,6 +225,34 @@ void election_loop() {
       }
       if (was_leader && lower_live >= 0) {
         // Stepping down on heal: pull the surviving leader's state.
+        // The distress line below is the log-file-pattern checker's
+        // quarry (checker.clj:863-905's role: server-side events the
+        // history can't see) — wholesale adoption is precisely the
+        // moment this node's split-brain acks become lies.
+        size_t local;
+        {
+          std::lock_guard<std::mutex> l(g_mu);
+          local = g_kv.size();
+        }
+        if (local > 0) {
+          // Gated on actually holding data: distress requires
+          // something to lose.  (Boot self-election is already
+          // prevented by main()'s heartbeat grace priming; what this
+          // gate suppresses is the data-LESS step-down — a follower
+          // that briefly self-elected during a heartbeat hiccup or a
+          // partition in which it never acked a write.  Cost: a
+          // split-brain loser that only served reads steps down
+          // silently, so the log evidence is strictly a subset of
+          // the history evidence — the checker pair in
+          // suites/electd.py treats it as corroboration, not as the
+          // primary verdict.)
+          fprintf(stderr,
+                  "electd id=%d STEPPING DOWN to leader %d: adopting "
+                  "remote state wholesale (replacing %zu local "
+                  "entries)\n",
+                  g_id, lower_live, local);
+          fflush(stderr);
+        }
         for (auto& p : g_peers) {
           if (p.id != lower_live) continue;
           std::string resp =

@@ -379,6 +379,14 @@ def electd_test(opts: dict) -> dict:
                 algorithm=opts.get("algorithm", "wgl-tpu"),
                 time_limit_s=60.0,
             ),
+            # Server-side evidence the history can't carry
+            # (checker.clj:863-905's role): a step-down's wholesale
+            # state adoption is the moment split-brain acks become
+            # lies, and electd logs it.  Quorum mode never elects, so
+            # the control group can't match.
+            "log-step-down": chk.LogFilePattern(
+                r"STEPPING DOWN .* wholesale", "electd.log"
+            ),
             "timeline": Timeline(),
             "stats": chk.Stats(),
         }),

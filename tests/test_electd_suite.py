@@ -62,11 +62,22 @@ def test_split_brain_lost_updates_caught(tmp_path):
                "interval": 1.0, "seed": attempt},
         )
         res = done["results"]
-        if res["linear"]["valid"] is False:
+        lsd = res["log-step-down"]
+        # Server-side corroboration (checker.clj:863-905's role): the
+        # healed loser logged its wholesale state adoption and the
+        # log-file-pattern checker found it in the snarfed node logs.
+        # The log evidence is a strict SUBSET of the history evidence
+        # (a loser that only served reads, or died before the heal
+        # beat, steps down silently — see electd.cpp's gate), so the
+        # attempt loop retries until BOTH channels convict rather
+        # than asserting the subset on the first history conviction.
+        if res["linear"]["valid"] is False and lsd["valid"] is False:
             nem = [o for o in done["history"]
                    if o.process == "nemesis"
                    and o.f == "start-partition"]
             assert nem, "conviction without a partition?"
+            assert lsd["count"] > 0, lsd
+            assert "STEPPING DOWN" in lsd["matches"][0]["line"], lsd
             return
     pytest.fail(f"3 partitioned runs never split-brained: {res}")
 
