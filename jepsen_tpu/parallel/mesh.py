@@ -36,6 +36,47 @@ def default_mesh(n_devices: Optional[int] = None, axis: str = "keys"):
     return mesh
 
 
+def multihost_init(coordinator: str, num_processes: int,
+                   process_id: int) -> None:
+    """Joins this process to a multi-host JAX cluster (the DCN analog
+    of the reference's control-plane fan-out; its NCCL/MPI role is
+    played by XLA collectives here).  After it returns,
+    `jax.devices()` is the GLOBAL device list, so `default_mesh()`
+    spans every host with no further changes.
+
+    Mesh-axis guidance for multi-host runs:
+      * the "keys" axis (per-key batched WGL, elle screens) has NO
+        cross-key communication — shard it across hosts freely; the
+        only DCN traffic is the initial scatter and final gather.
+      * the "beam" axis (frontier sharding of ONE search,
+        ops/wgl.py) all-gathers candidates every round — keep that
+        mesh within one host's ICI domain (pass the local slice of
+        jax.devices() to Mesh) or the collective rides DCN every
+        barrier block.
+
+    Call BEFORE any other JAX use: jax.distributed.initialize refuses
+    an already-initialized backend, so there is no late-join path (a
+    prior default_mesh()/jax.devices() call makes this raise).  Not
+    exercised in this repo's CI (single process); the call is a thin,
+    argument-validated delegate to jax.distributed.initialize, which
+    blocks until all `num_processes` join."""
+    if not coordinator or ":" not in coordinator:
+        raise ValueError(
+            f"coordinator must be host:port, got {coordinator!r}"
+        )
+    if not (0 <= process_id < num_processes):
+        raise ValueError(
+            f"process_id {process_id} outside [0, {num_processes})"
+        )
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
 def shard_map_compat():
     """(shard_map, replication-check kwargs) across jax versions: the
     stable `jax.shard_map` (>= 0.8) renamed check_rep -> check_vma.

@@ -93,3 +93,17 @@ def test_sharded_explored_counts_sane(mesh):
     )
     assert single.valid is True and sharded.valid is True
     assert sharded.configs_explored > 0
+
+
+def test_multihost_init_validates_arguments():
+    """The multi-host entry point rejects malformed coordination args
+    BEFORE delegating to jax.distributed (which would block waiting
+    for peers); the real join isn't exercisable in single-process CI."""
+    import pytest
+
+    from jepsen_tpu.parallel.mesh import multihost_init
+
+    with pytest.raises(ValueError, match="host:port"):
+        multihost_init("nocolon", 2, 0)
+    with pytest.raises(ValueError, match="outside"):
+        multihost_init("h:1234", 2, 5)
