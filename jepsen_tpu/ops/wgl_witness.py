@@ -698,7 +698,64 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         )
         return member, states, alive, failed
 
-    return jax.jit(chunk)
+    def chunk_idx(member, states, alive, failed, bar_idx, act_idx,
+                  nbars, nws, perm, present, k0s,
+                  fA, a0A, a1A, retA, invA, rankA):
+        """transfer="indices" entry: identical semantics to `chunk`,
+        but the (NB, 6, K) bars and (NB, 5, W) tab tables are built
+        ON DEVICE from per-block row-index arrays + the once-uploaded
+        per-row tables (fA/a0A/a1A/retA/invA/rankA) — ~3x less
+        host->device traffic per chunk, which is what the tunneled
+        chip's ~50 MB/s uplink actually charges for.
+
+        Padding contracts: bar_idx pads with 0 (masked by j >= nb:
+        real=0 rows commit nothing), act_idx pads with packed.n
+        (> every real row index, so searchsorted stays monotone;
+        gathers clamp under jit and the nw mask discards the lanes).
+        """
+        jcol = jnp.arange(K, dtype=jnp.int32)
+        wcol = jnp.arange(W, dtype=jnp.int32)
+
+        def body(carry, xs):
+            member, states, alive, failed = carry
+            bar_b, act_b, nb, nw, perm_b, present_b, k0 = xs
+            member = jnp.where(present_b[:, None], member[perm_b],
+                               False)
+            real = (jcol < nb).astype(jnp.int32)
+            bars_b = jnp.stack([
+                jnp.searchsorted(act_b, bar_b).astype(jnp.int32),
+                retA[bar_b],
+                real,
+                fA[bar_b],
+                a0A[bar_b],
+                a1A[bar_b],
+            ])
+            valid_w = wcol < nw
+            tab_b = jnp.stack([
+                jnp.where(valid_w, invA[act_b], INF),
+                jnp.where(valid_w, fA[act_b], 0),
+                jnp.where(valid_w, a0A[act_b], 0),
+                jnp.where(valid_w, a1A[act_b], 0),
+                jnp.where(valid_w, rankA[act_b], NO_BAR),
+            ])
+
+            def run(_):
+                return run_block(member, states, alive, bars_b, tab_b,
+                                 k0)
+
+            def skip(_):
+                return member, states, alive, jnp.bool_(False)
+
+            m, s, al, f2 = jax.lax.cond(~failed, run, skip, None)
+            return (m, s, al, failed | f2), None
+
+        (member, states, alive, failed), _ = jax.lax.scan(
+            body, (member, states, alive, failed),
+            (bar_idx, act_idx, nbars, nws, perm, present, k0s),
+        )
+        return member, states, alive, failed
+
+    return jax.jit(chunk), jax.jit(chunk_idx)
 
 
 def check_wgl_witness(
@@ -719,12 +776,21 @@ def check_wgl_witness(
     pallas: str = "auto",
     compact: int = -1,
     checkpoint_dir: Optional[str] = None,
+    transfer: str = "full",
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
 
     Returns an exact `WGLResult(valid=True)` when a witness linearization
     survives, or None when the search dies / overflows / times out —
     meaning "escalate to the exact search", never "invalid".
+
+    `transfer`: "full" ships the pre-gathered (NB,6,K)+(NB,5,W) block
+    tables per chunk call; "indices" uploads the per-row tables once
+    and ships only small row-index arrays per chunk, rebuilding the
+    tables on device — ~3x less H2D, which matters on the tunneled
+    chip (~50 MB/s measured, tools/tunnel_diag.py).  Identical
+    verdicts by construction; parity-tested.  Default stays "full"
+    until the win is measured on silicon.
 
     `checkpoint_dir`: when set, the inter-chunk carry (member window,
     beam states, alive mask + the block cursor) is persisted there
@@ -793,17 +859,32 @@ def check_wgl_witness(
             W // 2, info_window if info_window is not None else W // 8
         ))
 
+    if transfer not in ("full", "indices"):
+        raise ValueError(f"unknown transfer mode {transfer!r}")
+
     # The step fn itself keys the cache (strong ref): an id() key
     # can collide after GC address reuse and serve the wrong
     # model's transition kernel.
     key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
-    fn = _chunk_fn_cache.get(key)
-    if fn is None:
-        fn = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
-                            pallas_mode=pallas,
-                            jax_step_rows=pm.jax_step_rows,
-                            compact=compact)
-        _chunk_fn_cache[key] = fn
+    fns = _chunk_fn_cache.get(key)
+    if fns is None:
+        fns = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
+                             pallas_mode=pallas,
+                             jax_step_rows=pm.jax_step_rows,
+                             compact=compact)
+        _chunk_fn_cache[key] = fns
+    fn, fn_idx = fns
+
+    row_tables = None
+    if transfer == "indices":
+        # One upload per check; subsequent chunk calls pass these
+        # already-resident arrays, which jit does NOT re-transfer.
+        dev = jax.devices()[0]
+        row_tables = tuple(
+            jax.device_put(np.ascontiguousarray(a, dtype=np.int32), dev)
+            for a in (packed.f, packed.a0, packed.a1, ret32, inv32,
+                      np.minimum(bar_rank, NO_BAR))
+        )
 
     member = jnp.zeros((W, B), dtype=bool)
     states = jnp.tile(
@@ -847,31 +928,45 @@ def check_wgl_witness(
     for c0 in range(c0_start, len(blocks), NB):
         chunk_blocks = blocks[c0 : c0 + NB]
         nblk = len(chunk_blocks)
-        bars_np = np.zeros((NB, 6, K), dtype=np.int32)
-        bars_np[:, 1, :] = INF
-        tab_np = np.zeros((NB, 5, W), dtype=np.int32)
         perm_np = np.tile(identity_perm, (NB, 1))
         present_np = np.ones((NB, W), dtype=bool)
         k0s_np = np.zeros(NB, dtype=np.int32)
+        if transfer == "indices":
+            # Per-chunk payload: row-INDEX arrays only; the tables are
+            # rebuilt on device from the once-uploaded row_tables.
+            bar_idx_np = np.zeros((NB, K), dtype=np.int32)
+            act_idx_np = np.full((NB, W), packed.n, dtype=np.int32)
+            nbars_np = np.zeros(NB, dtype=np.int32)
+            nws_np = np.zeros(NB, dtype=np.int32)
+        else:
+            bars_np = np.zeros((NB, 6, K), dtype=np.int32)
+            bars_np[:, 1, :] = INF
+            tab_np = np.zeros((NB, 5, W), dtype=np.int32)
 
         for bi, (k0, block_bars, active) in enumerate(chunk_blocks):
             nw = len(active)
             nb = len(block_bars)
             k0s_np[bi] = k0
-            bars_np[bi, 0, :nb] = np.searchsorted(active, block_bars)
-            bars_np[bi, 1, :nb] = ret32[block_bars]
-            bars_np[bi, 2, :nb] = 1
-            bars_np[bi, 3, :nb] = packed.f[block_bars]
-            bars_np[bi, 4, :nb] = packed.a0[block_bars]
-            bars_np[bi, 5, :nb] = packed.a1[block_bars]
-            row = tab_np[bi]
-            row[0, :] = INF
-            row[0, :nw] = inv32[active]
-            row[1, :nw] = packed.f[active]
-            row[2, :nw] = packed.a0[active]
-            row[3, :nw] = packed.a1[active]
-            row[4, :] = NO_BAR
-            row[4, :nw] = np.minimum(bar_rank[active], NO_BAR)
+            if transfer == "indices":
+                bar_idx_np[bi, :nb] = block_bars
+                act_idx_np[bi, :nw] = active
+                nbars_np[bi] = nb
+                nws_np[bi] = nw
+            else:
+                bars_np[bi, 0, :nb] = np.searchsorted(active, block_bars)
+                bars_np[bi, 1, :nb] = ret32[block_bars]
+                bars_np[bi, 2, :nb] = 1
+                bars_np[bi, 3, :nb] = packed.f[block_bars]
+                bars_np[bi, 4, :nb] = packed.a0[block_bars]
+                bars_np[bi, 5, :nb] = packed.a1[block_bars]
+                row = tab_np[bi]
+                row[0, :] = INF
+                row[0, :nw] = inv32[active]
+                row[1, :nw] = packed.f[active]
+                row[2, :nw] = packed.a0[active]
+                row[3, :nw] = packed.a1[active]
+                row[4, :] = NO_BAR
+                row[4, :nw] = np.minimum(bar_rank[active], NO_BAR)
             if prev_active is None:
                 # Very first block: nothing to re-gather; member is
                 # all-False already, so a full wipe is a no-op.
@@ -886,12 +981,21 @@ def check_wgl_witness(
             prev_active = active
 
         try:
-            member, states, alive, failed = fn(
-                member, states, alive, failed,
-                jnp.asarray(bars_np), jnp.asarray(tab_np),
-                jnp.asarray(perm_np), jnp.asarray(present_np),
-                jnp.asarray(k0s_np),
-            )
+            if transfer == "indices":
+                member, states, alive, failed = fn_idx(
+                    member, states, alive, failed,
+                    jnp.asarray(bar_idx_np), jnp.asarray(act_idx_np),
+                    jnp.asarray(nbars_np), jnp.asarray(nws_np),
+                    jnp.asarray(perm_np), jnp.asarray(present_np),
+                    jnp.asarray(k0s_np), *row_tables,
+                )
+            else:
+                member, states, alive, failed = fn(
+                    member, states, alive, failed,
+                    jnp.asarray(bars_np), jnp.asarray(tab_np),
+                    jnp.asarray(perm_np), jnp.asarray(present_np),
+                    jnp.asarray(k0s_np),
+                )
             # One sync per chunk (~32k barriers): early exit + time
             # budget.  The sync ALSO belongs inside the try — jitted
             # dispatch is asynchronous, so execution-time failures
@@ -922,7 +1026,7 @@ def check_wgl_witness(
                 info_window=info_window, max_window=max_window,
                 width_hint=width_hint, time_limit_s=remaining,
                 pallas="off", compact=compact,
-                checkpoint_dir=checkpoint_dir,
+                checkpoint_dir=checkpoint_dir, transfer=transfer,
             )
         if failed_now:
             _ckpt_remove(ckpt_path)  # concluded: a resume can't help

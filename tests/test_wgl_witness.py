@@ -192,3 +192,37 @@ def test_witness_plain_register(pm):
     p = pack_history(h, rm.encode)
     res = check_wgl_witness(p, rm)
     assert res is not None and res.valid is True
+
+
+def test_transfer_indices_parity():
+    """transfer="indices" (on-device table building from once-uploaded
+    row tables) must reach identical verdicts to the default "full"
+    path — valid histories at window-rolling sizes, and an invalid
+    history escalating (None) on both."""
+    from jepsen_tpu.history import history as mk_history, Op
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops.wgl_witness import check_wgl_witness
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    pm = cas_register().packed()
+    for n, info, seed in [(2_000, 0.1, 7), (30_000, 0.08, 2)]:
+        h = random_register_history(n, procs=10, info_rate=info,
+                                    seed=seed)
+        packed = pack_history(h, pm.encode)
+        a = check_wgl_witness(packed, pm, transfer="full")
+        b = check_wgl_witness(packed, pm, transfer="indices")
+        assert (a is None) == (b is None), (n, a, b)
+        if a is not None:
+            assert a.valid == b.valid
+
+    bad = mk_history([
+        Op(type="invoke", process=0, f="write", value=1, index=0,
+           time=0),
+        Op(type="ok", process=0, f="write", value=1, index=1, time=1),
+        Op(type="invoke", process=1, f="read", value=None, index=2,
+           time=2),
+        Op(type="ok", process=1, f="read", value=2, index=3, time=3),
+    ])
+    pb = pack_history(bad, pm.encode)
+    assert check_wgl_witness(pb, pm, transfer="indices") is None

@@ -104,6 +104,12 @@ BATTERY = [
                             "--ops", "1000000", "--reps", "1",
                             "--platform", "default"],
      "TPU_WITNESS_PROFILE_1M.json", 900.0),
+    # H2D transfer-mode A/B: "indices" exists for exactly this chip's
+    # ~50 MB/s uplink; CPU measures neutral, so only a live chip can
+    # decide whether to flip the default.
+    ("transfer_ab", [sys.executable, "tools/transfer_ab.py",
+                     "--reps", "1", "--platform", "default"],
+     "TPU_TRANSFER_AB.json", 900.0),
 ]
 
 
