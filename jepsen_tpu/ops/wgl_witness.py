@@ -84,6 +84,15 @@ WIDE_INFO_WINDOW = 4096
 
 _chunk_fn_cache: dict[tuple, Any] = {}
 
+#: Negative-cache sentinel: a key mapping to this means Mosaic
+#: deterministically rejected the kernel build for that config —
+#: subsequent checks go straight to the scan sweep without re-paying
+#: the lowering probe (one redundant probe + traceback per key per
+#: analysis pass under IndependentChecker's thread pool otherwise).
+#: Transient runtime flakes use cache EVICTION instead, so the next
+#: check re-attempts the kernel.
+_BUILD_FAILED = object()
+
 
 #: Minimum elapsed seconds before a checkpoint is worth writing: short
 #: searches finish in milliseconds and would pay a device->host carry
@@ -862,16 +871,62 @@ def check_wgl_witness(
     if transfer not in ("full", "indices"):
         raise ValueError(f"unknown transfer mode {transfer!r}")
 
+    def _retry_on_scan(why: str):
+        """Shared fallback: log, deduct elapsed budget, restart this
+        search on the XLA-scan sweep.  Every caller-visible kwarg is
+        reproduced exactly once here — keep it that way so a future
+        parameter can't be silently dropped on one fallback path."""
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s; retrying witness on the XLA scan sweep", why,
+            exc_info=True,
+        )
+        if time_limit_s is not None:
+            remaining = time_limit_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                return None  # budget blown: escalate directly
+        else:
+            remaining = None
+        return check_wgl_witness(
+            packed, pm, beam=beam, bars_per_block=bars_per_block,
+            blocks_per_call=blocks_per_call, depth=depth,
+            info_window=info_window, max_window=max_window,
+            width_hint=width_hint, time_limit_s=remaining,
+            pallas="off", compact=compact,
+            checkpoint_dir=checkpoint_dir, transfer=transfer,
+        )
+
     # The step fn itself keys the cache (strong ref): an id() key
     # can collide after GC address reuse and serve the wrong
     # model's transition kernel.
     key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
     fns = _chunk_fn_cache.get(key)
+    if fns is _BUILD_FAILED:
+        # Mosaic deterministically rejected this kernel earlier in the
+        # process: skip the probe and run the scan sweep directly.
+        # Single fetch then compare — a second .get() would race with
+        # a concurrent thread storing the sentinel (IndependentChecker
+        # pool) and leak it to the tuple unpack below.  "off" keys
+        # never hold the sentinel, so this fetch can't see it.
+        pallas = "off"
+        key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
+        fns = _chunk_fn_cache.get(key)
     if fns is None:
-        fns = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
-                             pallas_mode=pallas,
-                             jax_step_rows=pm.jax_step_rows,
-                             compact=compact)
+        try:
+            fns = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
+                                 pallas_mode=pallas,
+                                 jax_step_rows=pm.jax_step_rows,
+                                 compact=compact)
+        except Exception:
+            # Kernel BUILD failures (pallas_call construction, Mosaic
+            # lowering probes) need the same safety net as execution
+            # failures below: a flaky tunneled chip must not cost the
+            # verdict.
+            if pallas != "on":
+                raise
+            _chunk_fn_cache[key] = _BUILD_FAILED
+            return _retry_on_scan("pallas kernel build failed")
         _chunk_fn_cache[key] = fns
     fn, fn_idx = fns
 
@@ -1006,28 +1061,11 @@ def check_wgl_witness(
                 raise
             # A Mosaic compile or transient runtime failure on the
             # tunneled chip must not cost the verdict: evict the
-            # kernel and restart this search on the XLA-scan sweep.
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "pallas sweep failed; retrying witness on the XLA "
-                "scan sweep", exc_info=True,
-            )
+            # kernel (transient — the next check may succeed, unlike
+            # the deterministic build-failure negative cache above)
+            # and restart this search on the XLA-scan sweep.
             _chunk_fn_cache.pop(key, None)
-            if time_limit_s is not None:
-                remaining = time_limit_s - (time.monotonic() - t0)
-                if remaining <= 0:
-                    return None  # budget blown: escalate directly
-            else:
-                remaining = None
-            return check_wgl_witness(
-                packed, pm, beam=beam, bars_per_block=bars_per_block,
-                blocks_per_call=blocks_per_call, depth=depth,
-                info_window=info_window, max_window=max_window,
-                width_hint=width_hint, time_limit_s=remaining,
-                pallas="off", compact=compact,
-                checkpoint_dir=checkpoint_dir, transfer=transfer,
-            )
+            return _retry_on_scan("pallas sweep failed")
         if failed_now:
             _ckpt_remove(ckpt_path)  # concluded: a resume can't help
             return None

@@ -133,7 +133,9 @@ def test_pallas_runtime_failure_falls_back_to_scan(monkeypatch):
         if pallas_mode == "on":
             def boom(*a, **k):
                 raise RuntimeError("Mosaic failed to compile TPU kernel")
-            return boom
+            # Real contract: (fn, fn_idx) — both must blow up at CALL
+            # time (the jitted dispatch path), not at build time.
+            return boom, boom
         return real_make(B, W, SW, K, D, NB, jax_step,
                          pallas_mode=pallas_mode,
                          jax_step_rows=jax_step_rows,
@@ -147,6 +149,68 @@ def test_pallas_runtime_failure_falls_back_to_scan(monkeypatch):
         w._chunk_fn_cache.clear()
     assert _verdict(r) is True
     assert calls == ["on", "off"]
+
+
+def test_pallas_build_failure_falls_back_to_scan(monkeypatch):
+    """A failure while BUILDING the Pallas kernel (pallas_call
+    construction / Mosaic lowering probe, before any chunk executes)
+    must also retry on the XLA-scan sweep — round-4's fallback only
+    covered the chunk call itself."""
+    import jepsen_tpu.ops.wgl_witness as w
+
+    pm = cas_register().packed()
+    h = random_register_history(512, procs=4, info_rate=0.1, seed=9)
+    p = pack_history(h, pm.encode)
+
+    real_make = w._make_chunk_fn
+    calls = []
+
+    def fake_make(B, W, SW, K, D, NB, jax_step, pallas_mode="off",
+                  jax_step_rows=None, compact=0):
+        calls.append(pallas_mode)
+        if pallas_mode == "on":
+            raise RuntimeError("Mosaic lowering rejected kernel")
+        return real_make(B, W, SW, K, D, NB, jax_step,
+                         pallas_mode=pallas_mode,
+                         jax_step_rows=jax_step_rows,
+                         compact=compact)
+
+    monkeypatch.setattr(w, "_make_chunk_fn", fake_make)
+    w._chunk_fn_cache.clear()
+    try:
+        r = w.check_wgl_witness(p, pm, pallas="on")
+        assert _verdict(r) is True
+        assert calls == ["on", "off"]
+        # Deterministic build failures are negative-cached: a second
+        # check with the same config must go straight to the scan
+        # sweep without re-paying the lowering probe.
+        calls.clear()
+        r2 = w.check_wgl_witness(p, pm, pallas="on")
+        assert _verdict(r2) is True
+        assert "on" not in calls
+    finally:
+        w._chunk_fn_cache.clear()
+
+
+def test_pallas_build_failure_off_mode_raises(monkeypatch):
+    """Build failures under pallas='off' are programming errors and
+    must surface, not silently recurse."""
+    import jepsen_tpu.ops.wgl_witness as w
+
+    pm = cas_register().packed()
+    h = random_register_history(128, procs=4, info_rate=0.0, seed=3)
+    p = pack_history(h, pm.encode)
+
+    def fake_make(*a, **k):
+        raise RuntimeError("synthetic build failure")
+
+    monkeypatch.setattr(w, "_make_chunk_fn", fake_make)
+    w._chunk_fn_cache.clear()
+    try:
+        with pytest.raises(RuntimeError, match="synthetic build"):
+            w.check_wgl_witness(p, pm, pallas="off")
+    finally:
+        w._chunk_fn_cache.clear()
 
 
 def test_models_without_rows_step_fall_back():
