@@ -176,7 +176,8 @@ def _state_hash_vec(sw: int, seed: int = 0xA11CE) -> np.ndarray:
 
 
 def _plan_blocks(packed: PackedOps, bars_per_block: int,
-                 info_window: Optional[int] = None):
+                 info_window: Optional[int] = None,
+                 rank_override: Optional[np.ndarray] = None):
     """Host-side plan: barrier order, per-block active windows.
 
     `info_window` keeps only the most recently invoked N indeterminate
@@ -209,6 +210,15 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
     bars = ok_rows[np.argsort(ret32[ok_rows], kind="stable")]
     bar_rank = np.full(packed.n, NO_BAR, dtype=np.int64)
     bar_rank[bars] = np.arange(len(bars))
+    if rank_override is not None:
+        # Stream semantics (ops/wgl_stream.py): a non-barrier row may
+        # carry a synthetic rank — once that rank passes, the row is
+        # treated exactly like a retired barrier (implied membership,
+        # excluded from helper candidacy, dropped from later windows).
+        # Barrier rows keep their real ranks: overriding one would
+        # corrupt the sweep order.
+        ov = (rank_override >= 0) & (status != ST_OK)
+        bar_rank[ov] = rank_override[ov]
     is_info = status != ST_OK
     blocks = []
     any_dropped = False
@@ -219,12 +229,13 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
     for k0 in range(0, len(bars), bars_per_block):
         block_bars = bars[k0 : k0 + bars_per_block]
         end_ret = int(ret32[block_bars[-1]])
-        # Leavers: barriers whose rank passed at block start.
+        # Leavers: rows whose rank passed at block start — real
+        # barriers from the previous block, plus override rows whose
+        # synthetic rank passed (equivalent to the previous isin()
+        # against the passed-barrier list: any active barrier with
+        # rank < k0 was by construction in that list).
         if k0:
-            passed = bars[k0 - bars_per_block : k0]
-            keep = np.isin(active, passed, assume_unique=True,
-                           invert=True)
-            active = active[keep]
+            active = active[bar_rank[active] >= k0]
         # Entrants: invoked before this block's last barrier.  New
         # rows have larger indices than everything already active, so
         # concatenation preserves sortedness.
@@ -618,15 +629,15 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             # ---- pallas hybrid: VMEM sweep to the next death point,
             # heavy in XLA, resume — all under one while_loop ----
             def cond_w(c):
-                k, _, _, _, failed = c
+                k, _, _, _, failed, _ = c
                 return (k < K) & ~failed
 
             def body_w(c):
-                k, member, states, alive, failed = c
+                k, member, states, alive, failed, died = c
                 s2, al2, dk = pallas_sweep(k, bars, member, states, alive)
 
                 def clean(_):
-                    return jnp.int32(K), member, s2, al2, failed
+                    return jnp.int32(K), member, s2, al2, failed, died
 
                 def death(_):
                     colv = jax.lax.dynamic_slice(
@@ -636,19 +647,22 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                         member, s2, al2, colv[0], colv[1], colv[3],
                         colv[4], colv[5], k0 + dk,
                     )
-                    return dk + 1, m, s, al, failed | ~done
+                    d2 = jnp.where(~done & (died == NO_BAR),
+                                   k0 + dk, died)
+                    return dk + 1, m, s, al, failed | ~done, d2
 
                 return jax.lax.cond(dk >= K, clean, death, None)
 
-            _, member, states, alive, failed = jax.lax.while_loop(
+            _, member, states, alive, failed, died = jax.lax.while_loop(
                 cond_w, body_w,
-                (jnp.int32(0), member, states, alive, jnp.bool_(False)),
+                (jnp.int32(0), member, states, alive, jnp.bool_(False),
+                 jnp.int32(NO_BAR)),
             )
-            return member, states, alive, failed
+            return member, states, alive, failed, died
 
         # ---- barrier scan: pass/direct inline, heavy behind a cond ----
         def body(carry, xs):
-            member, states, alive, failed = carry
+            member, states, alive, failed, died = carry
             a, r, real, bf, ba0, ba1, k = xs
             has = member[a]
             ns, legal = jax.vmap(
@@ -663,31 +677,33 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                 commit = active & new_alive.any()
                 st = jnp.where((commit & surv_dir)[:, None], ns, states)
                 al = jnp.where(commit, new_alive, alive)
-                return member, st, al, failed
+                return member, st, al, failed, died
 
             def hard(_):
                 m, s, al, done = heavy(
                     member, states, alive, a, r, bf, ba0, ba1, k0 + k
                 )
-                return m, s, al, failed | ~done
+                d2 = jnp.where(~done & (died == NO_BAR), k0 + k, died)
+                return m, s, al, failed | ~done, d2
 
             out = jax.lax.cond(
                 active & ~new_alive.any(), hard, easy, None
             )
             return out, None
 
-        carry0 = (member, states, alive, jnp.bool_(False))
-        (member, states, alive, failed), _ = jax.lax.scan(
+        carry0 = (member, states, alive, jnp.bool_(False),
+                  jnp.int32(NO_BAR))
+        (member, states, alive, failed, died), _ = jax.lax.scan(
             body, carry0,
             (bars[0], bars[1], bars[2], bars[3], bars[4], bars[5],
              jnp.arange(K, dtype=jnp.int32)),
         )
-        return member, states, alive, failed
+        return member, states, alive, failed, died
 
     def chunk(member, states, alive, failed, bars, tab, perm, present,
               k0s):
         def body(carry, xs):
-            member, states, alive, failed = carry
+            member, states, alive, failed, died = carry
             bars_b, tab_b, perm_b, present_b, k0 = xs
             member = jnp.where(present_b[:, None], member[perm_b],
                                False)
@@ -696,16 +712,18 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                 return run_block(member, states, alive, bars_b, tab_b, k0)
 
             def skip(_):
-                return member, states, alive, jnp.bool_(False)
+                return (member, states, alive, jnp.bool_(False),
+                        jnp.int32(NO_BAR))
 
-            m, s, al, f2 = jax.lax.cond(~failed, run, skip, None)
-            return (m, s, al, failed | f2), None
+            m, s, al, f2, d2 = jax.lax.cond(~failed, run, skip, None)
+            died = jnp.where((d2 != NO_BAR) & (died == NO_BAR), d2, died)
+            return (m, s, al, failed | f2, died), None
 
-        (member, states, alive, failed), _ = jax.lax.scan(
-            body, (member, states, alive, failed),
+        (member, states, alive, failed, died), _ = jax.lax.scan(
+            body, (member, states, alive, failed, jnp.int32(NO_BAR)),
             (bars, tab, perm, present, k0s),
         )
-        return member, states, alive, failed
+        return member, states, alive, failed, died
 
     def chunk_idx(member, states, alive, failed, bar_idx, act_idx,
                   nbars, nws, perm, present, k0s,
@@ -726,7 +744,7 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         wcol = jnp.arange(W, dtype=jnp.int32)
 
         def body(carry, xs):
-            member, states, alive, failed = carry
+            member, states, alive, failed, died = carry
             bar_b, act_b, nb, nw, perm_b, present_b, k0 = xs
             member = jnp.where(present_b[:, None], member[perm_b],
                                False)
@@ -753,16 +771,18 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                                  k0)
 
             def skip(_):
-                return member, states, alive, jnp.bool_(False)
+                return (member, states, alive, jnp.bool_(False),
+                        jnp.int32(NO_BAR))
 
-            m, s, al, f2 = jax.lax.cond(~failed, run, skip, None)
-            return (m, s, al, failed | f2), None
+            m, s, al, f2, d2 = jax.lax.cond(~failed, run, skip, None)
+            died = jnp.where((d2 != NO_BAR) & (died == NO_BAR), d2, died)
+            return (m, s, al, failed | f2, died), None
 
-        (member, states, alive, failed), _ = jax.lax.scan(
-            body, (member, states, alive, failed),
+        (member, states, alive, failed, died), _ = jax.lax.scan(
+            body, (member, states, alive, failed, jnp.int32(NO_BAR)),
             (bar_idx, act_idx, nbars, nws, perm, present, k0s),
         )
-        return member, states, alive, failed
+        return member, states, alive, failed, died
 
     return jax.jit(chunk), jax.jit(chunk_idx)
 
@@ -786,6 +806,8 @@ def check_wgl_witness(
     compact: int = -1,
     checkpoint_dir: Optional[str] = None,
     transfer: str = "full",
+    rank_override: Optional[np.ndarray] = None,
+    out_info: Optional[dict] = None,
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
 
@@ -828,6 +850,20 @@ def check_wgl_witness(
     narrow window is 2.9x end-to-end vs off, while W//8 = 256
     overflows to the full tile at most barriers and wins only 7%).
     0 disables.
+
+    `rank_override`: optional (n,) int array giving NON-barrier rows a
+    synthetic barrier rank (-1 = no override).  Once that rank passes,
+    the row behaves like a retired barrier: implied membership,
+    excluded from helper candidacy, dropped from later windows.  The
+    key-concatenated stream checker (ops/wgl_stream.py) uses this to
+    fence each key's indeterminate ops inside its own segment.
+    Checkpointing is disabled under an override (the checkpoint key
+    does not cover it).
+
+    `out_info`: optional dict the search fills with diagnostics — on
+    failure, "died_at_rank" is the global rank of the first barrier
+    the chain search could not linearize (None if the death point was
+    not localized).
     """
     import jax
     import jax.numpy as jnp
@@ -838,8 +874,10 @@ def check_wgl_witness(
         return WGLResult(valid=True, configs_explored=1,
                          elapsed_s=time.monotonic() - t0)
 
+    if rank_override is not None:
+        checkpoint_dir = None  # ckpt key does not cover the override
     bars, bar_rank, inv32, ret32, blocks, _ = _plan_blocks(
-        packed, bars_per_block, info_window
+        packed, bars_per_block, info_window, rank_override
     )
     n_bars = len(bars)
     if max(len(a) for _, _, a in blocks) > max_window:
@@ -848,6 +886,13 @@ def check_wgl_witness(
     SW = pm.state_width
     B = _bucket(beam, lo=8)
     K = bars_per_block
+    if len(blocks) < blocks_per_call:
+        # Short histories (one chunk): trim the call width to a
+        # bucket of the real block count — padding blocks are no-ops
+        # semantically but still cost K scan iterations each, which
+        # DOMINATES small searches (measured on the 200-key stream:
+        # 22 padding blocks of 32 ≈ 2x the real barrier work).
+        blocks_per_call = _bucket(len(blocks), lo=4)
     D = depth
     NB = blocks_per_call
     W = _bucket(max(max(len(a) for _, _, a in blocks), width_hint, 1))
@@ -895,6 +940,7 @@ def check_wgl_witness(
             width_hint=width_hint, time_limit_s=remaining,
             pallas="off", compact=compact,
             checkpoint_dir=checkpoint_dir, transfer=transfer,
+            rank_override=rank_override, out_info=out_info,
         )
 
     # The step fn itself keys the cache (strong ref): an id() key
@@ -1037,7 +1083,7 @@ def check_wgl_witness(
 
         try:
             if transfer == "indices":
-                member, states, alive, failed = fn_idx(
+                member, states, alive, failed, died = fn_idx(
                     member, states, alive, failed,
                     jnp.asarray(bar_idx_np), jnp.asarray(act_idx_np),
                     jnp.asarray(nbars_np), jnp.asarray(nws_np),
@@ -1045,7 +1091,7 @@ def check_wgl_witness(
                     jnp.asarray(k0s_np), *row_tables,
                 )
             else:
-                member, states, alive, failed = fn(
+                member, states, alive, failed, died = fn(
                     member, states, alive, failed,
                     jnp.asarray(bars_np), jnp.asarray(tab_np),
                     jnp.asarray(perm_np), jnp.asarray(present_np),
@@ -1068,6 +1114,9 @@ def check_wgl_witness(
             return _retry_on_scan("pallas sweep failed")
         if failed_now:
             _ckpt_remove(ckpt_path)  # concluded: a resume can't help
+            if out_info is not None:
+                d = int(died)
+                out_info["died_at_rank"] = d if d != int(NO_BAR) else None
             return None
         budget_blown = (time_limit_s is not None
                         and time.monotonic() - t0 > time_limit_s)
@@ -1082,6 +1131,8 @@ def check_wgl_witness(
 
     _ckpt_remove(ckpt_path)
     if not bool(alive.any()):
+        if out_info is not None:
+            out_info["died_at_rank"] = None  # not localized
         return None
     return WGLResult(
         valid=True,

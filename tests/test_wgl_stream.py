@@ -1,0 +1,184 @@
+"""Key-concatenated stream witness (ops/wgl_stream.py).
+
+Parity bar: every verdict the stream proves True must agree with a
+standalone witness/exact check of that key's subhistory; keys it
+reports None must be settled by the exact engines, never trusted.
+"""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history.packed import ST_OK, pack_history
+from jepsen_tpu.models import cas_register, fifo_queue, register
+from jepsen_tpu.ops.wgl_stream import (
+    F_RESET,
+    check_wgl_witness_stream,
+    concat_packs,
+    stream_model,
+)
+from jepsen_tpu.utils.histgen import random_register_history
+
+
+def _packs(n_keys, n_ops=100, info=0.05, procs=4, bad_keys=()):
+    pm = cas_register().packed()
+    out = []
+    for i in range(n_keys):
+        h = random_register_history(
+            n_ops, procs=procs, info_rate=info, seed=i,
+            bad=(i in bad_keys),
+        )
+        out.append(pack_history(h, pm.encode))
+    return out, pm
+
+
+def test_concat_packs_shape_and_fencing():
+    packs, pm = _packs(5)
+    combined, override, key_of_bar = concat_packs(packs)
+    n_rows = sum(p.n for p in packs)
+    assert combined.n == n_rows + 5  # one RESET per key
+    # Timeline strictly invocation-ordered across the whole stream.
+    assert (np.diff(combined.inv) > 0).all()
+    # Exactly 5 RESET rows, all ok barriers.
+    resets = combined.f == F_RESET
+    assert int(resets.sum()) == 5
+    assert (combined.status[resets] == ST_OK).all()
+    # Barrier count = ok rows + resets; key_of_bar covers them.
+    n_bars = int((combined.status == ST_OK).sum())
+    assert len(key_of_bar) == n_bars
+    assert key_of_bar[0] == 0 and key_of_bar[-1] == 4
+    # Every indeterminate row is fenced at ITS key's reset rank.
+    info_rows = combined.status != ST_OK
+    assert (override[info_rows] >= 0).all()
+    assert (override[~info_rows] == -1).all()
+
+
+def test_stream_all_valid_matches_per_key():
+    packs, pm = _packs(40)
+    v = check_wgl_witness_stream(packs, pm)
+    assert all(x is True for x in v)
+
+
+def test_stream_localizes_bad_keys():
+    packs, pm = _packs(30, bad_keys={7, 19})
+    v = check_wgl_witness_stream(packs, pm)
+    # Bad keys must NOT be proven; every valid key must be.
+    assert v[7] is not True
+    assert v[19] is not True
+    for i, x in enumerate(v):
+        if i not in (7, 19):
+            assert x is True, i
+
+
+def test_stream_first_and_last_key_bad():
+    packs, pm = _packs(10, bad_keys={0, 9})
+    v = check_wgl_witness_stream(packs, pm)
+    assert v[0] is not True and v[9] is not True
+    assert all(v[i] is True for i in range(1, 9))
+
+
+def test_stream_empty_and_tiny_keys():
+    pm = cas_register().packed()
+    from jepsen_tpu.history import INVOKE, OK, parse_literal
+
+    h1 = parse_literal([
+        (0, INVOKE, "write", 1), (0, OK, "write", 1),
+        (1, INVOKE, "read", None), (1, OK, "read", 1),
+    ])
+    packs = [pack_history(h1, pm.encode)]
+    # An empty pack (no client rows) accepts trivially.
+    import numpy as np_
+
+    from jepsen_tpu.history.packed import PackedOps
+    empty = PackedOps(
+        inv=np_.empty(0, np_.int64), ret=np_.empty(0, np_.int64),
+        process=np_.empty(0, np_.int32), status=np_.empty(0, np_.int32),
+        f=np_.empty(0, np_.int32), a0=np_.empty(0, np_.int32),
+        a1=np_.empty(0, np_.int32), src_index=np_.empty(0, np_.int64),
+        preds=np_.empty(0, np_.int64), horizon=np_.empty(0, np_.int64),
+    )
+    v = check_wgl_witness_stream([empty, packs[0], empty], pm)
+    assert v == [True, True, True]
+
+
+def test_stream_model_reset_semantics():
+    pm = cas_register().packed()
+    spm = stream_model(pm)
+    import jax.numpy as jnp
+
+    s = jnp.asarray([3], jnp.int32)
+    ns, legal = spm.jax_step(s, F_RESET, 0, 0)
+    assert bool(legal) is True
+    assert ns.tolist() == list(pm.init_state)
+    # Non-reset codes behave exactly like the base model.
+    for f in range(3):
+        a, la = pm.jax_step(s, f, 1, 2)
+        b, lb = spm.jax_step(s, f, 1, 2)
+        assert a.tolist() == b.tolist() and bool(la) == bool(lb)
+    # Cached: same wrapped model object for the same base.
+    assert stream_model(pm) is spm
+    # py_step agrees.
+    ns_py, legal_py = spm.py_step((3,), F_RESET, 0, 0)
+    assert legal_py is True and tuple(ns_py) == tuple(pm.init_state)
+
+
+def test_stream_rows_step_reset_is_mosaic_shaped():
+    pm = cas_register().packed()
+    spm = stream_model(pm)
+    import jax.numpy as jnp
+
+    states = jnp.asarray([[0, 1, 2, 3]], jnp.int32)  # (SW=1, B=4)
+    ns, legal = spm.jax_step_rows(states, jnp.int32(F_RESET),
+                                  jnp.int32(0), jnp.int32(0))
+    assert ns.shape == states.shape
+    assert (np.asarray(ns) == pm.init_state[0]).all()
+    assert np.asarray(legal).astype(bool).all()
+
+
+def test_stream_other_models():
+    pm = fifo_queue().packed()
+    from jepsen_tpu.history import History, INVOKE, OK, Op
+
+    packs = []
+    for i in range(8):
+        rows = []
+        for j in range(16):
+            rows += [
+                Op(type=INVOKE, f="enqueue", value=j, process=0),
+                Op(type=OK, f="enqueue", value=j, process=0),
+                Op(type=INVOKE, f="dequeue", process=1),
+                Op(type=OK, f="dequeue", value=j, process=1),
+            ]
+        packs.append(pack_history(History(rows), pm.encode))
+    v = check_wgl_witness_stream(packs, pm)
+    assert all(x is True for x in v)
+
+
+def test_stream_time_budget_degrades_to_none():
+    packs, pm = _packs(20)
+    v = check_wgl_witness_stream(packs, pm, time_limit_s=0.0)
+    assert all(x is None for x in v)
+
+
+def test_independent_checker_uses_stream():
+    """End-to-end: IndependentChecker routes short keys through the
+    stream and reports the wgl-tpu-stream algorithm; a bad key is
+    settled exactly (False) by the fallback engines."""
+    from jepsen_tpu.checker.linearizable import Linearizable
+    from jepsen_tpu.history.core import history as make_history
+    from jepsen_tpu.parallel.independent import IndependentChecker, kv
+
+    pm = cas_register()
+    ops = []
+    for i in range(20):
+        h = random_register_history(60, procs=4, info_rate=0.05,
+                                    seed=i, bad=(i == 13))
+        ops += [o.replace(value=kv(f"k{i}", o.value)) for o in h]
+    hist = make_history(ops)
+    chk = IndependentChecker(Linearizable(pm, time_limit_s=600.0))
+    res = chk.check({}, hist, {})
+    assert res["valid"] is False
+    assert res["failures"] == ["k13"]
+    r_ok = res["results"]["k0"]
+    assert r_ok["valid"] is True
+    assert r_ok["algorithm"] == "wgl-tpu-stream"
+    assert res["results"]["k13"]["valid"] is False
