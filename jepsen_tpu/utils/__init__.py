@@ -379,3 +379,19 @@ def sanitize_path_part(part: Any) -> str:
     if not s or set(s) <= {"."}:
         return "_" * max(1, len(s))
     return s
+
+
+def summarize_times(times: Sequence[float]) -> dict:
+    """Median/best/spread summary of measured rep times, the shared
+    shape every measurement tool records (multi-rep evidence: a
+    capture with reps >= 3 is a median, not a mood).  Keys: best_s,
+    median_s, spread_s=[min, max], reps."""
+    ts = sorted(times)
+    if not ts:
+        raise ValueError("no measurements")
+    return {
+        "best_s": round(ts[0], 3),
+        "median_s": round(ts[len(ts) // 2], 3),
+        "spread_s": [round(ts[0], 3), round(ts[-1], 3)],
+        "reps": len(ts),
+    }

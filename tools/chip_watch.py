@@ -88,28 +88,32 @@ def run_capture(name: str, cmd: list[str], artifact: str,
 
 
 BATTERY = [
+    # VERDICT r4 #8: every battery step runs >=3 reps when a window
+    # opens, so the recorded artifacts carry median+spread instead of
+    # a single ±30% sample.  bench.py's own loop is already
+    # median-of-3 and now records reps/spread_s in last-good.
     ("bench", [sys.executable, "bench.py"],
      "BENCH_TPU_LAST_GOOD.json", 1800.0),
     ("compact_ab", [sys.executable, "tools/compact_ab.py",
-                    "--platform", "default", "--reps", "1"],
-     "TPU_COMPACT_AB.json", 900.0),
+                    "--platform", "default", "--reps", "3"],
+     "TPU_COMPACT_AB.json", 1200.0),
     ("profile_witness", [sys.executable, "tools/profile_witness.py",
-                         "--ops", "100000", "--reps", "1",
+                         "--ops", "100000", "--reps", "3",
                          "--platform", "default"],
-     "TPU_WITNESS_PROFILE.json", 900.0),
+     "TPU_WITNESS_PROFILE.json", 1200.0),
     # The long-history scale point (the reference's own perf shape is
     # 1M ops, core_test.clj:127-132).  A wedge killed the first
     # attempt mid-run at 2026-07-31T10:55Z; retried per-window here.
     ("profile_witness_1m", [sys.executable, "tools/profile_witness.py",
-                            "--ops", "1000000", "--reps", "1",
+                            "--ops", "1000000", "--reps", "3",
                             "--platform", "default"],
-     "TPU_WITNESS_PROFILE_1M.json", 900.0),
-    # H2D transfer-mode A/B: "indices" exists for exactly this chip's
-    # ~50 MB/s uplink; CPU measures neutral, so only a live chip can
-    # decide whether to flip the default.
+     "TPU_WITNESS_PROFILE_1M.json", 1200.0),
+    # H2D transfer-mode A/B: "indices"/"device" exist for exactly this
+    # chip's ~50 MB/s uplink; CPU measures neutral, so only a live
+    # chip can decide whether to flip the default.
     ("transfer_ab", [sys.executable, "tools/transfer_ab.py",
-                     "--reps", "1", "--platform", "default"],
-     "TPU_TRANSFER_AB.json", 900.0),
+                     "--reps", "3", "--platform", "default"],
+     "TPU_TRANSFER_AB.json", 1200.0),
 ]
 
 

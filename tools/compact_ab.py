@@ -54,7 +54,7 @@ def main() -> int:
     width = plan_width(packed)
 
     for c in args.compact:
-        best = None
+        times = []
         for rep in range(args.reps + 1):  # rep 0 = compile warm-up
             t0 = time.monotonic()
             res = check_wgl_witness(packed, pm, width_hint=width,
@@ -62,11 +62,13 @@ def main() -> int:
             dt = time.monotonic() - t0
             assert res is not None and res.valid is True, res
             if rep > 0:
-                best = dt if best is None else min(best, dt)
+                times.append(dt)
+        from jepsen_tpu.utils import summarize_times
+
+        s = summarize_times(times)
         print(json.dumps({
-            "ops": args.ops, "compact": c, "W": width,
-            "best_s": round(best, 3),
-            "ops_per_s": round(args.ops / best),
+            "ops": args.ops, "compact": c, "W": width, **s,
+            "ops_per_s": round(args.ops / s["median_s"]),
             "platform": jax.devices()[0].platform,
         }), flush=True)
     return 0

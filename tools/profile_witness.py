@@ -62,7 +62,7 @@ def measure(n_ops: int, reps: int, info_rate: float = 0.05,
     width = plan_width(real)
 
     def timed(packed, label):
-        best = None
+        times = []
         # warm-up compiles the kernel shape for this bucket
         check_wgl_device(packed, pm, time_limit_s=600.0,
                          width_hint=width)
@@ -72,11 +72,15 @@ def measure(n_ops: int, reps: int, info_rate: float = 0.05,
                                    width_hint=width)
             dt = time.monotonic() - t0
             assert res.valid is True, (label, res.valid, res.reason)
-            best = dt if best is None else min(best, dt)
-        return best
+            times.append(dt)
+        times.sort()
+        return times
 
-    t_total = timed(real, "real")
-    t_sweep_raw = timed(easy, "sweep-only")
+    from jepsen_tpu.utils import summarize_times
+
+    real_times = timed(real, "real")
+    t_total = real_times[0]
+    t_sweep_raw = timed(easy, "sweep-only")[0]
     # scale the sweep cost to the real history's barrier count
     scale = real.n_ok / max(1, easy.n_ok)
     t_sweep = t_sweep_raw * scale
@@ -85,6 +89,10 @@ def measure(n_ops: int, reps: int, info_rate: float = 0.05,
         "info_rate": info_rate,
         "barriers": int(real.n_ok),
         "total_s": round(t_total, 3),
+        # Multi-rep evidence (VERDICT r4 #8): median + min/max spread
+        # across the measured reps, so a single capture is auditable
+        # against the chip's observed ±30% run-to-run variance.
+        **summarize_times(real_times),
         "sweep_s": round(t_sweep, 3),
         "chain_s": round(max(0.0, t_total - t_sweep), 3),
         "sweep_pct": round(100.0 * t_sweep / t_total, 1),

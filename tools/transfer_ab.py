@@ -53,18 +53,20 @@ def main() -> int:
     for mode in ("full", "indices"):
         ww.check_wgl_witness(packed, pm, transfer=mode,
                              width_hint=width)  # warm
-        best = None
+        times = []
         for _ in range(args.reps):
             t0 = time.monotonic()
             r = ww.check_wgl_witness(packed, pm, transfer=mode,
                                      width_hint=width)
             dt = time.monotonic() - t0
             assert r is not None and r.valid is True
-            best = dt if best is None else min(best, dt)
+            times.append(dt)
+        from jepsen_tpu.utils import summarize_times
+
+        s = summarize_times(times)
         print(json.dumps({
-            "mode": mode, "ops": args.ops,
-            "best_s": round(best, 3),
-            "ops_per_s": round(args.ops / best),
+            "mode": mode, "ops": args.ops, **s,
+            "ops_per_s": round(args.ops / s["median_s"]),
             "platform": platform,
         }), flush=True)
     return 0
