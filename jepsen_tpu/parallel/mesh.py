@@ -56,10 +56,12 @@ def multihost_init(coordinator: str, num_processes: int,
 
     Call BEFORE any other JAX use: jax.distributed.initialize refuses
     an already-initialized backend, so there is no late-join path (a
-    prior default_mesh()/jax.devices() call makes this raise).  Not
-    exercised in this repo's CI (single process); the call is a thin,
-    argument-validated delegate to jax.distributed.initialize, which
-    blocks until all `num_processes` join."""
+    prior default_mesh()/jax.devices() call makes this raise).
+    Exercised in CI by tests/test_multihost.py: two fresh processes
+    join one cluster over localhost, build the global mesh, and run a
+    cross-process psum.  The call delegates to
+    jax.distributed.initialize, which blocks until all
+    `num_processes` join."""
     if not coordinator or ":" not in coordinator:
         raise ValueError(
             f"coordinator must be host:port, got {coordinator!r}"

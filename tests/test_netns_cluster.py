@@ -328,3 +328,86 @@ def test_electd_kernel_partition_quorum_control(tmp_path):
     parts = [op for op in done["history"]
              if op.process == "nemesis" and op.f == "start-partition"]
     assert parts
+
+
+def test_netem_probe_and_delay_rtt(cluster):
+    """netem on a real kernel — or the committed proof it can't be
+    (VERDICT r4 next-item #7).
+
+    Probes the namespace kernel for the sch_netem qdisc.  If present,
+    this test UPGRADES itself: TcShapingNet.slow() installs a 40 ms
+    delay and the measured TCP round-trip between namespaces must
+    inflate accordingly, then fast() restores it.  On this CI kernel
+    the module is absent, so the probe must fail with exactly
+    "qdisc kind is unknown" (any other failure — missing tc, bad
+    arguments — still fails the test), and tbf on the SAME device
+    must work (isolating the failure to the netem module, not tc or
+    the qdisc machinery).  doc/NETEM_PROBE.md carries the committed
+    transcript.
+    """
+    test = base_test(cluster)
+    with with_sessions(test):
+        sess = test["sessions"]["n1"]
+        with sess.su():
+            probe = sess.exec_star(
+                "tc", "qdisc", "add", "dev", "eth0", "root",
+                "netem", "delay", "40ms",
+            )
+        if probe.get("exit") == 0:
+            # Kernel has netem: exercise the real path end-to-end.
+            with sess.su():
+                sess.exec("tc", "qdisc", "del", "dev", "eth0", "root")
+            server = _spawn_server(cluster, "n2", 7801)
+
+            def best_rtt(timeout=1.5, dials=3):
+                # Best-of-N: a scheduler hiccup or connect retry on a
+                # loaded CI machine inflates single dials by tens of
+                # ms — the flake class perf_utils.rate_until exists
+                # for, applied to RTTs.
+                best = None
+                for _ in range(dials):
+                    t0 = time.monotonic()
+                    assert _dial_from(
+                        cluster, "n1", addr2, 7801, timeout=timeout
+                    ) == "pong"
+                    dt = time.monotonic() - t0
+                    best = dt if best is None else min(best, dt)
+                return best
+
+            try:
+                addr2 = cluster.address_of("n2")
+                base_rtt = best_rtt()
+
+                test["net"].slow(test, mean=40, variance=1)
+                slow_rtt = best_rtt(timeout=5.0)
+                # connect + response = 2 one-way delays minimum; both
+                # endpoints delay egress, so expect >= ~80 ms over
+                # baseline.  Assert half that to absorb scheduler
+                # noise while still proving kernel-level delay.
+                assert slow_rtt - base_rtt > 0.04, (base_rtt, slow_rtt)
+
+                test["net"].fast(test)
+                # Restored: the 40 ms floor the delay imposed is gone.
+                assert best_rtt() < base_rtt + 0.035, base_rtt
+            finally:
+                server.kill()
+            return
+
+        # Module absent: the failure must be the unknown-qdisc error,
+        # and tbf must work on the same device, pinning the gap to
+        # sch_netem itself.
+        perr = (probe.get("err") or "") + (probe.get("out") or "")
+        assert "unknown" in perr.lower(), probe
+        with sess.su():
+            sess.exec(
+                "tc", "qdisc", "add", "dev", "eth0", "root",
+                "tbf", "rate", "1mbit", "burst", "32kbit",
+                "latency", "400ms",
+            )
+            out = sess.exec("tc", "qdisc", "show", "dev", "eth0")
+            assert "tbf" in out
+            sess.exec("tc", "qdisc", "del", "dev", "eth0", "root")
+        # PASSING here means: the absence is exactly the documented
+        # kind (sch_netem missing, everything else healthy).  On a
+        # kernel that gains the module, the branch above runs the
+        # real delay/RTT verification instead.
