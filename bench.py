@@ -162,6 +162,9 @@ def run_bench() -> int:
         # excluded from the measured run (first TPU compile is tens of
         # seconds).  width_hint forces the warm-up onto the same window
         # bucket the real history will use, so its compile hits cache.
+        # (transfer="device"'s span bucket S can still differ between
+        # warm-up and real history — that one extra compile lands in
+        # rep 1 and the median-of-3 below absorbs it.)
         from jepsen_tpu.ops.wgl_witness import plan_width
 
         width = plan_width(packed)

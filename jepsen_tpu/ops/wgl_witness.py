@@ -84,6 +84,11 @@ WIDE_INFO_WINDOW = 4096
 
 _chunk_fn_cache: dict[tuple, Any] = {}
 
+#: transfer="device" entries, keyed (chunk-fn key, span-slice bucket):
+#: separate from _chunk_fn_cache so the span bucket never fragments
+#: the eager (fn, fn_idx) build or its _BUILD_FAILED negative cache.
+_chunk_dev_cache: dict[tuple, Any] = {}
+
 #: Negative-cache sentinel: a key mapping to this means Mosaic
 #: deterministically rejected the kernel build for that config —
 #: subsequent checks go straight to the scan sweep without re-paying
@@ -725,6 +730,47 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         )
         return member, states, alive, failed, died
 
+    jcol = jnp.arange(K, dtype=jnp.int32)
+    wcol = jnp.arange(W, dtype=jnp.int32)
+
+    def idx_block_step(member, states, alive, failed, died,
+                       bar_b, act_b, nb, nw, perm_b, present_b,
+                       k0, fA, a0A, a1A, retA, invA, rankA):
+        """One block: regather member, build bar/tab tables on
+        device from row indices, run.  Shared by the "indices"
+        and "device" transfer modes."""
+        member = jnp.where(present_b[:, None], member[perm_b],
+                           False)
+        real = (jcol < nb).astype(jnp.int32)
+        bars_b = jnp.stack([
+            jnp.searchsorted(act_b, bar_b).astype(jnp.int32),
+            retA[bar_b],
+            real,
+            fA[bar_b],
+            a0A[bar_b],
+            a1A[bar_b],
+        ])
+        valid_w = wcol < nw
+        tab_b = jnp.stack([
+            jnp.where(valid_w, invA[act_b], INF),
+            jnp.where(valid_w, fA[act_b], 0),
+            jnp.where(valid_w, a0A[act_b], 0),
+            jnp.where(valid_w, a1A[act_b], 0),
+            jnp.where(valid_w, rankA[act_b], NO_BAR),
+        ])
+
+        def run(_):
+            return run_block(member, states, alive, bars_b, tab_b,
+                             k0)
+
+        def skip(_):
+            return (member, states, alive, jnp.bool_(False),
+                    jnp.int32(NO_BAR))
+
+        m, s, al, f2, d2 = jax.lax.cond(~failed, run, skip, None)
+        died = jnp.where((d2 != NO_BAR) & (died == NO_BAR), d2, died)
+        return m, s, al, failed | f2, died
+
     def chunk_idx(member, states, alive, failed, bar_idx, act_idx,
                   nbars, nws, perm, present, k0s,
                   fA, a0A, a1A, retA, invA, rankA):
@@ -740,43 +786,15 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         (> every real row index, so searchsorted stays monotone;
         gathers clamp under jit and the nw mask discards the lanes).
         """
-        jcol = jnp.arange(K, dtype=jnp.int32)
-        wcol = jnp.arange(W, dtype=jnp.int32)
-
         def body(carry, xs):
             member, states, alive, failed, died = carry
             bar_b, act_b, nb, nw, perm_b, present_b, k0 = xs
-            member = jnp.where(present_b[:, None], member[perm_b],
-                               False)
-            real = (jcol < nb).astype(jnp.int32)
-            bars_b = jnp.stack([
-                jnp.searchsorted(act_b, bar_b).astype(jnp.int32),
-                retA[bar_b],
-                real,
-                fA[bar_b],
-                a0A[bar_b],
-                a1A[bar_b],
-            ])
-            valid_w = wcol < nw
-            tab_b = jnp.stack([
-                jnp.where(valid_w, invA[act_b], INF),
-                jnp.where(valid_w, fA[act_b], 0),
-                jnp.where(valid_w, a0A[act_b], 0),
-                jnp.where(valid_w, a1A[act_b], 0),
-                jnp.where(valid_w, rankA[act_b], NO_BAR),
-            ])
-
-            def run(_):
-                return run_block(member, states, alive, bars_b, tab_b,
-                                 k0)
-
-            def skip(_):
-                return (member, states, alive, jnp.bool_(False),
-                        jnp.int32(NO_BAR))
-
-            m, s, al, f2, d2 = jax.lax.cond(~failed, run, skip, None)
-            died = jnp.where((d2 != NO_BAR) & (died == NO_BAR), d2, died)
-            return (m, s, al, failed | f2, died), None
+            out = idx_block_step(
+                member, states, alive, failed, died,
+                bar_b, act_b, nb, nw, perm_b, present_b, k0,
+                fA, a0A, a1A, retA, invA, rankA,
+            )
+            return out, None
 
         (member, states, alive, failed, died), _ = jax.lax.scan(
             body, (member, states, alive, failed, jnp.int32(NO_BAR)),
@@ -784,7 +802,89 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         )
         return member, states, alive, failed, died
 
-    return jax.jit(chunk), jax.jit(chunk_idx)
+    def make_chunk_dev(S: int):
+        """Builds the transfer="device" entry for span-slice width S.
+        Separate from the eager (fn, fn_idx) pair so the Pallas sweep
+        build — and its _BUILD_FAILED negative cache — is keyed
+        independently of S: two histories sharing every other shape
+        must not re-pay the Mosaic lowering probe because their spans
+        bucket differently."""
+        return jax.jit(_chunk_dev_for(S))
+
+    def _chunk_dev_for(S: int):
+        def chunk_dev(member, states, alive, failed, prev_act,
+                      k0s, end_rets, los, nbars, cuts, n_total,
+                      fA, a0A, a1A, retA, invA, rankA, icumA, barsA):
+            return _chunk_dev_impl(
+                S, member, states, alive, failed, prev_act,
+                k0s, end_rets, los, nbars, cuts, n_total,
+                fA, a0A, a1A, retA, invA, rankA, icumA, barsA,
+            )
+        return chunk_dev
+
+    def _chunk_dev_impl(S, member, states, alive, failed, prev_act,
+                        k0s, end_rets, los, nbars, cuts, n_total,
+                        fA, a0A, a1A, retA, invA, rankA, icumA, barsA):
+        """transfer="device" entry: the per-block index arrays the
+        "indices" mode ships from the host (~0.7 MB/chunk) are
+        PLANNED ON DEVICE from the once-uploaded row tables — the
+        per-chunk H2D payload shrinks to five (NB,) scalars (~640 B).
+        The host's _plan_blocks stays authoritative for the STATIC
+        facts (W, S buckets, chunk boundaries, per-block scalars);
+        the device reproduces its row sets exactly:
+
+          mask(r) = r entered (inv < end_ret) & rank not passed
+                    (>= k0) & info retention (info_cum > cut)
+
+        over the (lo, lo+S) slice host planning proved covers the
+        window.  `prev_act` (the previous block's window rows, padded
+        with n_total) is carried on device across blocks AND chunk
+        calls, so the member re-gather needs no host round trip.
+        """
+        scol = jnp.arange(S, dtype=jnp.int32)
+
+        def body(carry, xs):
+            member, states, alive, failed, died, prev_act = carry
+            k0, er, lo, nb, cut = xs
+            rows = lo + scol
+            rows_c = jnp.minimum(rows, n_total - 1)
+            inv_r = invA[rows_c]
+            rank_r = rankA[rows_c]
+            icum_r = icumA[rows_c]
+            is_info = rank_r == NO_BAR
+            mask = ((rows < n_total) & (inv_r < er) & (rank_r >= k0)
+                    & (~is_info | (icum_r > cut)))
+            nw = mask.sum()
+            act_local = jnp.nonzero(mask, size=W, fill_value=S)[0]
+            valid_w = wcol < nw
+            act_b = jnp.where(
+                valid_w, lo + jnp.minimum(act_local, S - 1), n_total
+            ).astype(jnp.int32)
+            pos = jnp.searchsorted(prev_act, act_b)
+            pos_c = jnp.clip(pos, 0, W - 1)
+            present_b = ((pos < W) & (prev_act[pos_c] == act_b)
+                         & (act_b < n_total))
+            perm_b = jnp.where(present_b, pos_c, 0)
+            bar_b = jax.lax.dynamic_slice(barsA, (k0,), (K,))
+            out = idx_block_step(
+                member, states, alive, failed, died,
+                bar_b, act_b, nb, nw, perm_b, present_b, k0,
+                fA, a0A, a1A, retA, invA, rankA,
+            )
+            # Padding blocks (nb == 0 with er == 0) must not clobber
+            # the carried window.
+            new_prev = jnp.where(nb > 0, act_b, prev_act)
+            return (*out, new_prev), None
+
+        carry, _ = jax.lax.scan(
+            body,
+            (member, states, alive, failed, jnp.int32(NO_BAR),
+             prev_act),
+            (k0s, end_rets, los, nbars, cuts),
+        )
+        return carry
+
+    return jax.jit(chunk), jax.jit(chunk_idx), make_chunk_dev
 
 
 def check_wgl_witness(
@@ -805,7 +905,7 @@ def check_wgl_witness(
     pallas: str = "auto",
     compact: int = -1,
     checkpoint_dir: Optional[str] = None,
-    transfer: str = "full",
+    transfer: str = "auto",
     rank_override: Optional[np.ndarray] = None,
     out_info: Optional[dict] = None,
 ) -> Optional[WGLResult]:
@@ -819,9 +919,13 @@ def check_wgl_witness(
     tables per chunk call; "indices" uploads the per-row tables once
     and ships only small row-index arrays per chunk, rebuilding the
     tables on device — ~3x less H2D, which matters on the tunneled
-    chip (~50 MB/s measured, tools/tunnel_diag.py).  Identical
-    verdicts by construction; parity-tested.  Default stays "full"
-    until the win is measured on silicon.
+    chip (~50 MB/s measured, tools/tunnel_diag.py); "device" (round 5,
+    VERDICT r4 #1) also PLANS the blocks on device — the per-chunk
+    payload shrinks to five (NB,) scalars and the host's per-block
+    numpy table building disappears entirely.  Identical verdicts by
+    construction; parity-tested including the death rank.  "auto"
+    (default) picks "device" on TPU and "full" elsewhere (on CPU the
+    device IS the host's cores, so host-built tables win).
 
     `checkpoint_dir`: when set, the inter-chunk carry (member window,
     beam states, alive mask + the block cursor) is persisted there
@@ -913,8 +1017,52 @@ def check_wgl_witness(
             W // 2, info_window if info_window is not None else W // 8
         ))
 
-    if transfer not in ("full", "indices"):
+    if transfer not in ("auto", "full", "indices", "device"):
         raise ValueError(f"unknown transfer mode {transfer!r}")
+    if transfer == "auto":
+        # Measured split (round 5): on the tunneled TPU the per-chunk
+        # H2D (~0.7-2 MB at ~50 MB/s) plus the host's per-block numpy
+        # table building (~0.35 s at 100k ops) dominate, so planning
+        # on device wins; on CPU the device IS the host's cores, so
+        # shipping host-built tables is faster (0.46 s vs 0.91 s
+        # best-of-4 on the 100k config).
+        transfer = ("device" if jax.devices()[0].platform == "tpu"
+                    else "full")
+    if transfer == "device" and rank_override is not None:
+        # Device planning derives is_info from rank == NO_BAR, which
+        # an override breaks; the stream path's payloads are small
+        # anyway.  Indices mode keeps the once-uploaded-tables win.
+        transfer = "indices"
+
+    dev_slice = 0
+    dev_plan = None
+    if transfer == "device":
+        # Per-block scalars the device planner consumes — all derived
+        # from the plan the host already built.  hi = first row not
+        # yet invoked at the block's last barrier; lo = the window's
+        # first row; S buckets the widest (lo, hi) span.
+        nblk_all = len(blocks)
+        k0_all = np.empty(nblk_all, dtype=np.int32)
+        er_all = np.empty(nblk_all, dtype=np.int32)
+        lo_all = np.empty(nblk_all, dtype=np.int32)
+        nb_all = np.empty(nblk_all, dtype=np.int32)
+        cut_all = np.full(nblk_all, np.iinfo(np.int32).min,
+                          dtype=np.int32)
+        icum_host = np.cumsum(packed.status != ST_OK).astype(np.int32)
+        span_max = 1
+        for bi, (k0, block_bars, active) in enumerate(blocks):
+            er = int(ret32[block_bars[-1]])
+            hi = int(np.searchsorted(inv32, np.int32(er), side="left"))
+            lo = int(active[0]) if len(active) else hi
+            k0_all[bi] = k0
+            er_all[bi] = er
+            lo_all[bi] = lo
+            nb_all[bi] = len(block_bars)
+            if info_window is not None and hi > 0:
+                cut_all[bi] = int(icum_host[hi - 1]) - info_window
+            span_max = max(span_max, hi - lo)
+        dev_slice = _bucket(span_max, lo=min(W, 1024))
+        dev_plan = (k0_all, er_all, lo_all, nb_all, cut_all, icum_host)
 
     def _retry_on_scan(why: str):
         """Shared fallback: log, deduct elapsed budget, restart this
@@ -974,10 +1122,18 @@ def check_wgl_witness(
             _chunk_fn_cache[key] = _BUILD_FAILED
             return _retry_on_scan("pallas kernel build failed")
         _chunk_fn_cache[key] = fns
-    fn, fn_idx = fns
+    fn, fn_idx, make_dev = fns
+    fn_dev = None
+    if transfer == "device":
+        dev_key = (key, dev_slice)
+        fn_dev = _chunk_dev_cache.get(dev_key)
+        if fn_dev is None:
+            fn_dev = make_dev(dev_slice)
+            _chunk_dev_cache[dev_key] = fn_dev
 
     row_tables = None
-    if transfer == "indices":
+    prev_act_dev = None
+    if transfer in ("indices", "device"):
         # One upload per check; subsequent chunk calls pass these
         # already-resident arrays, which jit does NOT re-transfer.
         dev = jax.devices()[0]
@@ -985,6 +1141,18 @@ def check_wgl_witness(
             jax.device_put(np.ascontiguousarray(a, dtype=np.int32), dev)
             for a in (packed.f, packed.a0, packed.a1, ret32, inv32,
                       np.minimum(bar_rank, NO_BAR))
+        )
+    if transfer == "device":
+        # Device planning extras: the info cumsum (retention rule),
+        # the barrier array (padded so any k0 slice is in bounds),
+        # and the carried previous-window rows.
+        icumA = jax.device_put(dev_plan[5], dev)
+        bars_pad = np.zeros(_bucket(len(bars) + K, lo=K),
+                            dtype=np.int32)
+        bars_pad[: len(bars)] = bars
+        barsA = jax.device_put(bars_pad, dev)
+        prev_act_dev = jnp.asarray(
+            np.full(W, packed.n, dtype=np.int32)
         )
 
     member = jnp.zeros((W, B), dtype=bool)
@@ -1025,64 +1193,97 @@ def check_wgl_witness(
             c0_start = min(c0_start, len(blocks))
             if c0_start > 0:
                 prev_active = blocks[c0_start - 1][2]
+                if transfer == "device":
+                    pa = np.full(W, packed.n, dtype=np.int32)
+                    pa[: len(prev_active)] = prev_active
+                    prev_act_dev = jnp.asarray(pa)
 
     for c0 in range(c0_start, len(blocks), NB):
         chunk_blocks = blocks[c0 : c0 + NB]
         nblk = len(chunk_blocks)
-        perm_np = np.tile(identity_perm, (NB, 1))
-        present_np = np.ones((NB, W), dtype=bool)
-        k0s_np = np.zeros(NB, dtype=np.int32)
-        if transfer == "indices":
-            # Per-chunk payload: row-INDEX arrays only; the tables are
-            # rebuilt on device from the once-uploaded row_tables.
-            bar_idx_np = np.zeros((NB, K), dtype=np.int32)
-            act_idx_np = np.full((NB, W), packed.n, dtype=np.int32)
-            nbars_np = np.zeros(NB, dtype=np.int32)
-            nws_np = np.zeros(NB, dtype=np.int32)
-        else:
-            bars_np = np.zeros((NB, 6, K), dtype=np.int32)
-            bars_np[:, 1, :] = INF
-            tab_np = np.zeros((NB, 5, W), dtype=np.int32)
+        if transfer == "device":
+            # Five (NB,) scalars per chunk; everything else is planned
+            # on device from the resident tables.  Only the call
+            # differs from the other modes: the try/except and the
+            # post-chunk tail below are shared.
+            k0_all, er_all, lo_all, nb_all, cut_all, _ = dev_plan
 
-        for bi, (k0, block_bars, active) in enumerate(chunk_blocks):
-            nw = len(active)
-            nb = len(block_bars)
-            k0s_np[bi] = k0
+            def padded(a, fill=0):
+                out = np.full(NB, fill, dtype=np.int32)
+                out[:nblk] = a[c0 : c0 + nblk]
+                return out
+
+            dev_args = (
+                jnp.asarray(padded(k0_all)),
+                jnp.asarray(padded(er_all)),
+                jnp.asarray(padded(lo_all)),
+                jnp.asarray(padded(nb_all)),
+                jnp.asarray(padded(cut_all, np.iinfo(np.int32).min)),
+            )
+        else:
+            perm_np = np.tile(identity_perm, (NB, 1))
+            present_np = np.ones((NB, W), dtype=bool)
+            k0s_np = np.zeros(NB, dtype=np.int32)
             if transfer == "indices":
-                bar_idx_np[bi, :nb] = block_bars
-                act_idx_np[bi, :nw] = active
-                nbars_np[bi] = nb
-                nws_np[bi] = nw
+                # Per-chunk payload: row-INDEX arrays only; the tables
+                # are rebuilt on device from the once-uploaded
+                # row_tables.
+                bar_idx_np = np.zeros((NB, K), dtype=np.int32)
+                act_idx_np = np.full((NB, W), packed.n, dtype=np.int32)
+                nbars_np = np.zeros(NB, dtype=np.int32)
+                nws_np = np.zeros(NB, dtype=np.int32)
             else:
-                bars_np[bi, 0, :nb] = np.searchsorted(active, block_bars)
-                bars_np[bi, 1, :nb] = ret32[block_bars]
-                bars_np[bi, 2, :nb] = 1
-                bars_np[bi, 3, :nb] = packed.f[block_bars]
-                bars_np[bi, 4, :nb] = packed.a0[block_bars]
-                bars_np[bi, 5, :nb] = packed.a1[block_bars]
-                row = tab_np[bi]
-                row[0, :] = INF
-                row[0, :nw] = inv32[active]
-                row[1, :nw] = packed.f[active]
-                row[2, :nw] = packed.a0[active]
-                row[3, :nw] = packed.a1[active]
-                row[4, :] = NO_BAR
-                row[4, :nw] = np.minimum(bar_rank[active], NO_BAR)
-            if prev_active is None:
-                # Very first block: nothing to re-gather; member is
-                # all-False already, so a full wipe is a no-op.
-                present_np[bi, :] = False
-                perm_np[bi, :] = 0
-            else:
-                perm, present = window_regather(prev_active, active)
-                perm_np[bi, :nw] = perm
-                perm_np[bi, nw:] = 0
-                present_np[bi, :nw] = present
-                present_np[bi, nw:] = False
-            prev_active = active
+                bars_np = np.zeros((NB, 6, K), dtype=np.int32)
+                bars_np[:, 1, :] = INF
+                tab_np = np.zeros((NB, 5, W), dtype=np.int32)
+
+            for bi, (k0, block_bars, active) in enumerate(chunk_blocks):
+                nw = len(active)
+                nb = len(block_bars)
+                k0s_np[bi] = k0
+                if transfer == "indices":
+                    bar_idx_np[bi, :nb] = block_bars
+                    act_idx_np[bi, :nw] = active
+                    nbars_np[bi] = nb
+                    nws_np[bi] = nw
+                else:
+                    bars_np[bi, 0, :nb] = np.searchsorted(active,
+                                                          block_bars)
+                    bars_np[bi, 1, :nb] = ret32[block_bars]
+                    bars_np[bi, 2, :nb] = 1
+                    bars_np[bi, 3, :nb] = packed.f[block_bars]
+                    bars_np[bi, 4, :nb] = packed.a0[block_bars]
+                    bars_np[bi, 5, :nb] = packed.a1[block_bars]
+                    row = tab_np[bi]
+                    row[0, :] = INF
+                    row[0, :nw] = inv32[active]
+                    row[1, :nw] = packed.f[active]
+                    row[2, :nw] = packed.a0[active]
+                    row[3, :nw] = packed.a1[active]
+                    row[4, :] = NO_BAR
+                    row[4, :nw] = np.minimum(bar_rank[active], NO_BAR)
+                if prev_active is None:
+                    # Very first block: nothing to re-gather; member
+                    # is all-False already, so a full wipe is a no-op.
+                    present_np[bi, :] = False
+                    perm_np[bi, :] = 0
+                else:
+                    perm, present = window_regather(prev_active, active)
+                    perm_np[bi, :nw] = perm
+                    perm_np[bi, nw:] = 0
+                    present_np[bi, :nw] = present
+                    present_np[bi, nw:] = False
+                prev_active = active
 
         try:
-            if transfer == "indices":
+            if transfer == "device":
+                (member, states, alive, failed, died,
+                 prev_act_dev) = fn_dev(
+                    member, states, alive, failed, prev_act_dev,
+                    *dev_args, jnp.int32(packed.n),
+                    *row_tables, icumA, barsA,
+                )
+            elif transfer == "indices":
                 member, states, alive, failed, died = fn_idx(
                     member, states, alive, failed,
                     jnp.asarray(bar_idx_np), jnp.asarray(act_idx_np),
@@ -1111,6 +1312,7 @@ def check_wgl_witness(
             # the deterministic build-failure negative cache above)
             # and restart this search on the XLA-scan sweep.
             _chunk_fn_cache.pop(key, None)
+            _chunk_dev_cache.pop((key, dev_slice), None)
             return _retry_on_scan("pallas sweep failed")
         if failed_now:
             _ckpt_remove(ckpt_path)  # concluded: a resume can't help

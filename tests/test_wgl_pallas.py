@@ -133,9 +133,9 @@ def test_pallas_runtime_failure_falls_back_to_scan(monkeypatch):
         if pallas_mode == "on":
             def boom(*a, **k):
                 raise RuntimeError("Mosaic failed to compile TPU kernel")
-            # Real contract: (fn, fn_idx) — both must blow up at CALL
-            # time (the jitted dispatch path), not at build time.
-            return boom, boom
+            # Real contract: (fn, fn_idx, make_dev) — all must blow up
+            # at CALL time (the jitted dispatch path), not build time.
+            return boom, boom, boom
         return real_make(B, W, SW, K, D, NB, jax_step,
                          pallas_mode=pallas_mode,
                          jax_step_rows=jax_step_rows,

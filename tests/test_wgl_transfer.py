@@ -1,0 +1,118 @@
+"""Transfer-mode parity for the witness engine (VERDICT r4 #1).
+
+"full" / "indices" / "device" must produce identical verdicts AND
+identical death ranks — the "device" planner recomputes the host
+plan's row sets on device, so any divergence is a bug, not noise.
+"""
+
+import numpy as np
+import pytest
+
+from jepsen_tpu.history.packed import pack_history
+from jepsen_tpu.models import cas_register, multi_register
+from jepsen_tpu.ops.wgl_witness import check_wgl_witness
+from jepsen_tpu.utils.histgen import (
+    random_register_history,
+    random_register_packed,
+)
+
+MODES = ("full", "indices", "device")
+
+
+def _v(r):
+    return None if r is None else r.valid
+
+
+@pytest.mark.parametrize(
+    "n,info,procs,seed",
+    [
+        (1024, 0.1, 8, 2),
+        (2048, 0.3, 16, 3),   # info-heavy: the retention rule works
+        (4096, 0.05, 8, 4),
+        (512, 0.0, 4, 5),
+    ],
+)
+def test_three_mode_verdict_parity(n, info, procs, seed):
+    pm = cas_register().packed()
+    h = random_register_history(n, procs=procs, info_rate=info,
+                                seed=seed)
+    p = pack_history(h, pm.encode)
+    vs = [_v(check_wgl_witness(p, pm, transfer=m)) for m in MODES]
+    assert vs[0] == vs[1] == vs[2]
+    assert vs[0] in (True, None)
+
+
+def test_death_rank_parity():
+    pm = cas_register().packed()
+    h = random_register_history(512, procs=4, info_rate=0.0, seed=13,
+                                bad=True)
+    p = pack_history(h, pm.encode)
+    infos = []
+    for m in MODES:
+        info: dict = {}
+        assert check_wgl_witness(p, pm, transfer=m,
+                                 out_info=info) is None
+        infos.append(info)
+    assert infos[0] == infos[1] == infos[2]
+    assert isinstance(infos[0]["died_at_rank"], int)
+
+
+def test_device_mode_multichunk():
+    """More blocks than one chunk call: the prev_act carry crosses
+    chunk-call boundaries on device."""
+    pm = cas_register().packed()
+    p = random_register_packed(40_000, procs=16, info_rate=0.05,
+                               seed=9, model=pm)
+    a = check_wgl_witness(p, pm, transfer="full", bars_per_block=256,
+                          blocks_per_call=4)
+    b = check_wgl_witness(p, pm, transfer="device", bars_per_block=256,
+                          blocks_per_call=4)
+    assert _v(a) == _v(b) is True
+
+
+def test_device_mode_multi_register():
+    pm = multi_register({"x": 0, "y": 1}).packed()
+    from jepsen_tpu.history import History, INVOKE, OK, Op
+
+    rows = []
+    for i in range(200):
+        k = "x" if i % 2 else "y"
+        rows += [
+            Op(type=INVOKE, f="write", value=(k, i % 5), process=i % 4),
+            Op(type=OK, f="write", value=(k, i % 5), process=i % 4),
+            Op(type=INVOKE, f="read", value=(k, None), process=3 - i % 4),
+            Op(type=OK, f="read", value=(k, i % 5), process=3 - i % 4),
+        ]
+    p = pack_history(History(rows), pm.encode)
+    vs = [_v(check_wgl_witness(p, pm, transfer=m)) for m in MODES]
+    assert vs[0] == vs[1] == vs[2] is True
+
+
+def test_auto_resolves_to_full_on_cpu(monkeypatch):
+    """transfer='auto' must not pick the device planner on CPU (it is
+    measured slower there); sanity-check by verdict equivalence and
+    by the mode validation accepting 'auto'."""
+    pm = cas_register().packed()
+    h = random_register_history(512, procs=4, info_rate=0.05, seed=3)
+    p = pack_history(h, pm.encode)
+    assert _v(check_wgl_witness(p, pm, transfer="auto")) is True
+    with pytest.raises(ValueError):
+        check_wgl_witness(p, pm, transfer="bogus")
+
+
+def test_device_mode_rank_override_falls_back():
+    """The stream checker's rank_override forces indices mode under
+    the hood; verdicts stay correct."""
+    from jepsen_tpu.ops.wgl_stream import concat_packs, stream_model
+
+    pm = cas_register().packed()
+    packs = []
+    for i in range(8):
+        h = random_register_history(100, procs=4, info_rate=0.1,
+                                    seed=i)
+        packs.append(pack_history(h, pm.encode))
+    combined, override, _ = concat_packs(packs)
+    spm = stream_model(pm)
+    r = check_wgl_witness(combined, spm, rank_override=override,
+                          transfer="device")
+    assert _v(r) is True

@@ -4,10 +4,13 @@
 transfer="full" ships pre-gathered (NB,6,K)+(NB,5,W) block tables per
 chunk call (~74 KB/block); "indices" uploads the per-row tables once
 and ships only row-index arrays (~22 KB/block), rebuilding tables on
-device.  CPU measures neutral (0.43 s vs 0.43 s on the 100k bench
-config — no real transfer cost to remove); the lever exists for the
-tunneled TPU's ~50 MB/s uplink (tools/tunnel_diag.py), where the full
-mode's ~5 MB/100k-op history costs ~0.1-0.15 s of a ~0.4 s check.
+device; "device" (round 5) plans the blocks on device too — ~640 B
+per chunk and no host-side per-block numpy at all.  CPU measures
+"full" fastest (the device IS the host's cores, so host-built tables
+win); the lever exists for the tunneled TPU's ~50 MB/s uplink
+(tools/tunnel_diag.py), where the full mode's ~5 MB/100k-op history
+costs ~0.1-0.15 s of a ~0.4 s check plus ~0.35 s of serialized host
+numpy that "device" removes entirely.
 
 Usage: python tools/transfer_ab.py [--ops 100000] [--reps 2]
        [--platform default|cpu]
@@ -50,7 +53,7 @@ def main() -> int:
     packed = pack_history(h, pm.encode)
     width = ww.plan_width(packed)
 
-    for mode in ("full", "indices"):
+    for mode in ("full", "indices", "device"):
         ww.check_wgl_witness(packed, pm, transfer=mode,
                              width_hint=width)  # warm
         times = []
