@@ -92,16 +92,38 @@ def analyze(
     for op in fails:
         index_writes(op, failed=True)
 
-    # Per-key successor constraints v << v' (v may be None = initial).
+    # Per-key successor constraints v << v' (v may be None = initial),
+    # plus Elle's INTERNAL consistency checks (round 5, VERDICT r4 #9:
+    # the base inference silently tolerated a txn contradicting
+    # itself):
+    #   * "internal": a read that disagrees with this txn's own
+    #     still-visible WRITE of the key — illegal under any isolation
+    #     above read-uncommitted (your own writes must be visible to
+    #     you);
+    #   * "nonrepeatable-read": two reads of the key in one txn, no
+    #     write between, different values — legal under
+    #     read-committed, forbidden from repeatable-read up.
     succ: dict[Any, dict[Any, set]] = defaultdict(lambda: defaultdict(set))
     for op in oks:
         last_seen: dict = {}  # k -> last value this txn read or wrote
+        wrote: dict = {}      # k -> value this txn last wrote
         for f, k, v in op.value or []:
             if f == "w":
                 if k in last_seen and last_seen[k] != v:
                     succ[k][last_seen[k]].add(v)
                 last_seen[k] = v
+                wrote[k] = v
             elif f == "r":
+                if k in wrote and wrote[k] != v:
+                    anomalies["internal"].append({
+                        "op": op.index, "key": k,
+                        "wrote": wrote[k], "read": v,
+                    })
+                elif k in last_seen and last_seen[k] != v:
+                    anomalies["nonrepeatable-read"].append({
+                        "op": op.index, "key": k,
+                        "first": last_seen[k], "then": v,
+                    })
                 last_seen.setdefault(k, v)
 
     if sequential_keys:
@@ -228,7 +250,9 @@ def analyze(
     forbidden = set(FORBIDDEN.get(consistency_model, FORBIDDEN["serializable"]))
     forbidden |= {"duplicate-writes"}
     if consistency_model != "read-uncommitted":
-        forbidden |= DIRTY | {"unwritten-read"}
+        forbidden |= DIRTY | {"unwritten-read", "internal"}
+    if consistency_model not in ("read-uncommitted", "read-committed"):
+        forbidden |= {"nonrepeatable-read"}
     found = {t for t in anomalies if anomalies[t]}
     bad = found & forbidden
     valid: Any = True

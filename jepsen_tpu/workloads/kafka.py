@@ -462,9 +462,18 @@ def nonmonotonic_send_cases(history: list[Op], orders: dict) -> list:
 
 
 def dependency_cycles(history: list[Op], orders: dict,
-                      writer_of: dict, readers_of: dict) -> list[dict]:
+                      writer_of: dict, readers_of: dict,
+                      rw_edges: bool = False) -> list[dict]:
     """ww/wr graph over version orders (kafka.clj:1791-1878) run through
-    the Elle-equivalent layered cycle search (device-screened)."""
+    the Elle-equivalent layered cycle search (device-screened).
+
+    `rw_edges=True` (round 5, VERDICT r4 #9) also adds
+    anti-dependency edges — reader of version i -> writer of version
+    i+1 — recovering the G-single/G2 cycles the reference's DISABLED
+    rw-graph would have found (kafka.clj keeps the remnants commented
+    out because polls make rw edges noisy under rebalances; here the
+    flag lets a suite opt in when its client keeps assignments
+    stable)."""
     from ..checker.elle.graph import DepGraph
     from ..ops.scc import check_cycles_device
 
@@ -484,17 +493,41 @@ def dependency_cycles(history: list[Op], orders: dict,
     for k, v2rs in readers_of.items():
         for v, readers in v2rs.items():
             w = writer_of.get(k, {}).get(v)
-            if w is None:
+            if w is not None:
+                for r in readers:
+                    if r.index != w.index:
+                        g.add_edge(w.index, r.index, "wr")
+        if rw_edges:
+            vo = orders.get(k)
+            if vo is None:
                 continue
-            for r in readers:
-                if r.index != w.index:
-                    g.add_edge(w.index, r.index, "wr")
+            # Anti-dependency fires only from the LAST version each
+            # reader observed of the key (its final position): a
+            # reader that also polled the successor saw it, so there
+            # is no "unread overwrite" to anti-depend on.
+            last_read: dict[int, tuple[int, Any]] = {}
+            for v, readers in v2rs.items():
+                i = vo.by_value.get(v)
+                if i is None:
+                    continue
+                for r in readers:
+                    cur = last_read.get(r.index)
+                    if cur is None or i > cur[0]:
+                        last_read[r.index] = (i, r)
+            for r_idx, (i, r) in last_read.items():
+                if i + 1 >= len(vo.by_index):
+                    continue
+                w2 = writer_of.get(k, {}).get(vo.by_index[i + 1])
+                if w2 is not None and r_idx != w2.index:
+                    g.add_edge(r_idx, w2.index, "rw")
     return check_cycles_device([g])[0]
 
 
-def analyze(history: History | list[Op]) -> dict:
+def analyze(history: History | list[Op], *,
+            rw_edges: bool = False) -> dict:
     """Full kafka analysis -> {"valid", "anomaly-types", "anomalies",
-    counts} (kafka.clj:1879-1984)."""
+    counts} (kafka.clj:1879-1984).  `rw_edges` opts into
+    anti-dependency cycle edges (see dependency_cycles)."""
     ops = [o for o in history
            if o.f in TXN_FS + ("assign", "subscribe")]
     wbt = writes_by_type(ops)
@@ -533,7 +566,8 @@ def analyze(history: History | list[Op]) -> dict:
     nms = nonmonotonic_send_cases(ops, orders)
     if nms:
         anomalies["nonmonotonic-send"] = nms
-    cycles = dependency_cycles(ops, orders, writer_of, readers_of)
+    cycles = dependency_cycles(ops, orders, writer_of, readers_of,
+                               rw_edges=rw_edges)
     for c in cycles:
         anomalies.setdefault(c["type"], []).append(c)
     unseen = unseen_final(ops)
@@ -554,8 +588,11 @@ def analyze(history: History | list[Op]) -> dict:
 
 
 class KafkaChecker(Checker):
+    def __init__(self, *, rw_edges: bool = False):
+        self.rw_edges = rw_edges
+
     def check(self, test: dict, history: History, opts: dict) -> dict:
-        res = analyze(history.client_ops())
+        res = analyze(history.client_ops(), rw_edges=self.rw_edges)
         # Conviction trail into the store dir: unseen/lag plots always,
         # anomalies.json + version orders + cycle DOTs when invalid
         # (tests/kafka.clj:99-180; VERDICT r3 #6).

@@ -496,3 +496,60 @@ def test_append_unobserved_writer_invalid():
     res = analyze_append(h(t(0, "ok", [["r", "x", [99]]])))
     assert res["valid"] is False
     assert "unobserved-writer" in res["anomaly-types"]
+
+
+# -- wr internal consistency (round 5, VERDICT r4 #9) --------------------
+
+
+def test_wr_internal_own_write_contradiction():
+    """A txn reading something other than its OWN preceding write is
+    illegal under any isolation above read-uncommitted — the round-4
+    inference silently tolerated it."""
+    from jepsen_tpu.checker.elle import wr
+    from jepsen_tpu.history.core import Op, history
+
+    h = history([
+        Op(type="ok", f="txn", process=0,
+           value=[["w", "x", 1], ["r", "x", 2], ["w", "y", 2]]),
+        Op(type="ok", f="txn", process=1, value=[["w", "x", 2]]),
+    ])
+    res = wr.analyze(h)
+    assert "internal" in res["anomaly-types"]
+    assert res["valid"] is False
+    # read-uncommitted tolerates it (dirty everything).
+    res_ru = wr.analyze(h, consistency_model="read-uncommitted")
+    assert res_ru["valid"] is not False
+
+
+def test_wr_nonrepeatable_read_model_dependent():
+    """Two reads of one key in one txn with different values and no
+    write between: forbidden from repeatable-read up, legal under
+    read-committed."""
+    from jepsen_tpu.checker.elle import wr
+    from jepsen_tpu.history.core import Op, history
+
+    h = history([
+        Op(type="ok", f="txn", process=0, value=[["w", "x", 1]]),
+        Op(type="ok", f="txn", process=1, value=[["w", "x", 2]]),
+        Op(type="ok", f="txn", process=2,
+           value=[["r", "x", 1], ["r", "x", 2]]),
+    ])
+    res = wr.analyze(h)  # serializable default
+    assert "nonrepeatable-read" in res["anomaly-types"]
+    assert res["valid"] is False
+    res_rc = wr.analyze(h, consistency_model="read-committed")
+    assert res_rc["valid"] is not False
+
+
+def test_wr_self_consistent_txn_stays_valid():
+    from jepsen_tpu.checker.elle import wr
+    from jepsen_tpu.history.core import Op, history
+
+    h = history([
+        Op(type="ok", f="txn", process=0,
+           value=[["w", "x", 1], ["r", "x", 1], ["r", "x", 1]]),
+        Op(type="ok", f="txn", process=1,
+           value=[["r", "x", 1], ["w", "x", 2], ["r", "x", 2]]),
+    ])
+    res = wr.analyze(h)
+    assert res["valid"] is True, res["anomaly-types"]

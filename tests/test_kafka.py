@@ -261,3 +261,54 @@ def test_duplicate_fault_detected():
     res = run_workload(faults={"duplicate"})
     assert res["valid"] is not True
     assert "duplicate" in res["anomaly-types"]
+
+
+# -- rw anti-dependency edges (round 5, VERDICT r4 #9) -------------------
+
+
+def _g_single_history():
+    """A stale poll closing a cycle only an rw edge can see:
+    Tr reads a@v1 (missing a@v2 by W2) -> rw Tr->W2; W2's send to b is
+    polled by Tr -> wr W2->Tr.  One rw + one wr = G-single."""
+    return lit(
+        ok("txn", [sent("a", 0, "v1"), sent("b", 0, "b1")], process=0),
+        ok("txn", [sent("a", 1, "v2"), sent("b", 1, "b2")], process=1),
+        ok("txn", [polled({"a": [[0, "v1"]], "b": [[0, "b1"],
+                                                   [1, "b2"]]})],
+           process=2),
+    )
+
+
+def test_rw_edges_recover_g_single():
+    h = _g_single_history()
+    base = kafka.analyze(h)
+    # The default (reference-parity: rw-graph disabled) sees no cycle.
+    assert not any(t.startswith("G-single") or t == "G2"
+                   for t in base["anomaly-types"]), base["anomaly-types"]
+    strong = kafka.analyze(h, rw_edges=True)
+    assert any("G-single" in t or t == "G2"
+               for t in strong["anomaly-types"]), strong["anomaly-types"]
+    assert strong["valid"] is False
+
+
+def test_rw_edges_clean_history_stays_valid():
+    # Same shape but the reader sees BOTH versions: no anti-dependency
+    # cycle; the flag must not convict a healthy log.
+    h = lit(
+        ok("txn", [sent("a", 0, "v1"), sent("b", 0, "b1")], process=0),
+        ok("txn", [sent("a", 1, "v2"), sent("b", 1, "b2")], process=1),
+        ok("txn", [polled({"a": [[0, "v1"], [1, "v2"]],
+                           "b": [[0, "b1"], [1, "b2"]]})], process=2),
+    )
+    res = kafka.analyze(h, rw_edges=True)
+    assert res["valid"] is True, res["anomaly-types"]
+
+
+def test_kafka_checker_rw_flag_threads_through(tmp_path):
+    from jepsen_tpu.workloads.kafka import KafkaChecker
+
+    h = _g_single_history()
+    res = KafkaChecker(rw_edges=True).check({}, h, {"dir": str(tmp_path)})
+    assert res["valid"] is False
+    res = KafkaChecker().check({}, h, {"dir": str(tmp_path)})
+    assert res["valid"] is True
