@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import logging
 import os.path
+import shlex
 import time
 from typing import Any, Optional, Sequence
 
@@ -181,9 +182,9 @@ def stop_daemon(
 
 
 def grep_kill(sess: Session, pattern: str, *, signal: str = "KILL") -> None:
-    """pkill -f by pattern (control/util.clj grepkill!)."""
-    with sess.su():
-        sess.exec_star("pkill", f"-{signal}", "-f", pattern)
+    """pkill -f by pattern (control/util.clj grepkill!) — see grepkill;
+    this spelling keeps the signal-name flavor of the original API."""
+    grepkill(sess, pattern, signal=signal)
 
 
 def control_ip(test: Optional[dict] = None) -> str:
@@ -205,3 +206,28 @@ def control_ip(test: Optional[dict] = None) -> str:
         except OSError:
             continue
     return "127.0.0.1"
+
+
+def grepkill(sess: "Session", pattern: str,
+             signal: "int | str" = 9) -> None:
+    """Kills every process whose command line matches `pattern`
+    (control/util.clj grepkill!).  Best-effort: no match is fine.
+
+    Suite DBs call this on setup BEFORE starting their daemon: an
+    interrupted earlier run (SIGKILLed pytest, crashed driver) leaks
+    the daemon, and a later suite binding the same port then talks to
+    the STALE server — foreign data, false convictions (observed
+    round 5: a leaked kvdb on port 7401 convicted a healthy run)."""
+    # pkill -f matches FULL cmdlines — including the ssh/bash chain
+    # carrying this very pattern as an argument, which -9's our own
+    # session (observed: 'ssh failed (status -9)').  The classic
+    # bracket trick makes the regex match the target but not any
+    # process whose cmdline contains the (bracketed) pattern text.
+    if not pattern:
+        return
+    safe = f"[{pattern[0]}]{pattern[1:]}"
+    sess.exec_star(
+        "bash", "-c",
+        f"pkill -{signal} -f -- {shlex.quote(safe)} || true",
+    )
+

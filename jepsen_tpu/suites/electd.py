@@ -71,8 +71,24 @@ def node_index(test: dict, node: str) -> int:
     return (test.get("nodes") or []).index(node)
 
 
+def _derived_base(test: dict, key: str, fallback: int) -> int:
+    """Per-run base port: explicit test[key] wins; else derive
+    from the store dir via the shared hashed_base_port formula
+    (stable per run, distinct across concurrent runs, below the
+    Linux ephemeral range — round 5: two builders sharing a
+    BASE_PORT constant convicted a healthy run)."""
+    explicit = test.get(key)
+    if explicit is not None:
+        return explicit
+    seed = test.get("store-dir")
+    if not seed:
+        return fallback
+    return cutil.hashed_base_port(seed, fallback)
+
+
 def node_port(test: dict, node: str) -> int:
-    return test.get("electd-base-port", BASE_PORT) + 1 + node_index(test, node)
+    return _derived_base(test, "electd-base-port",
+                         BASE_PORT) + 1 + node_index(test, node)
 
 
 def node_dir(test: dict, node: str) -> str:
@@ -121,6 +137,10 @@ class ElectdDB(jdb.DB):
         sess.exec("mkdir", "-p", p["dir"])
         sess.upload(os.path.abspath(ELECTD_SRC), p["src"])
         sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        # An interrupted earlier run leaks its daemon; a stale server
+        # on our port serves foreign data -> false convictions
+        # (grepkill! on setup, control/util.clj pattern).
+        cutil.grepkill(sess, f"electd --port {node_port(test, node)} ")
         self.start(test, sess, node)
         cutil.await_tcp_port(
             sess, node_port(test, node), timeout_s=30, interval_s=0.1

@@ -45,13 +45,29 @@ KVDB_SRC = _demo.source("kvdb")
 BASE_PORT = 7400
 
 
+def _derived_base(test: dict, key: str, fallback: int) -> int:
+    """Per-run base port: explicit test[key] wins; else derive
+    from the store dir via the shared hashed_base_port formula
+    (stable per run, distinct across concurrent runs, below the
+    Linux ephemeral range — round 5: two builders sharing a
+    BASE_PORT constant convicted a healthy run)."""
+    explicit = test.get(key)
+    if explicit is not None:
+        return explicit
+    seed = test.get("store-dir")
+    if not seed:
+        return fallback
+    return cutil.hashed_base_port(seed, fallback)
+
+
 def node_port(test: dict, node: str) -> int:
     """Local topology: each node gets its own port in a per-run range
     derived from the store dir, so concurrent runs on one machine don't
     collide; real clusters use one port everywhere (test["kvdb-port"])."""
     nodes = test.get("nodes") or []
     if test.get("kvdb-local", True):
-        return test.get("kvdb-base-port", BASE_PORT) + 1 + nodes.index(node)
+        return _derived_base(test, "kvdb-base-port",
+                             BASE_PORT) + 1 + nodes.index(node)
     return test.get("kvdb-port", BASE_PORT)
 
 
@@ -81,6 +97,10 @@ class KvdbDB(jdb.DB):
         # Compile on the node, like the reference compiles its C
         # helpers there.
         sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        # An interrupted earlier run leaks its daemon; a stale server
+        # on our port serves foreign data -> false convictions
+        # (grepkill! on setup, control/util.clj pattern).
+        cutil.grepkill(sess, f"kvdb --port {node_port(test, node)} ")
         self.start(test, sess, node)
         cutil.await_tcp_port(
             sess, node_port(test, node), timeout_s=30, interval_s=0.1

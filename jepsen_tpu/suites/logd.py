@@ -39,8 +39,23 @@ LOGD_SRC = _demo.source("logd")
 BASE_PORT = 7520
 
 
+def _derived_base(test: dict, key: str, fallback: int) -> int:
+    """Per-run base port: explicit test[key] wins; else derive
+    from the store dir via the shared hashed_base_port formula
+    (stable per run, distinct across concurrent runs, below the
+    Linux ephemeral range — round 5: two builders sharing a
+    BASE_PORT constant convicted a healthy run)."""
+    explicit = test.get(key)
+    if explicit is not None:
+        return explicit
+    seed = test.get("store-dir")
+    if not seed:
+        return fallback
+    return cutil.hashed_base_port(seed, fallback)
+
+
 def node_port(test: dict) -> int:
-    return test.get("logd-port", BASE_PORT)
+    return _derived_base(test, "logd-port", BASE_PORT)
 
 
 def node_dir(test: dict, node: str) -> str:
@@ -68,6 +83,10 @@ class LogdDB(jdb.DB):
         sess.exec("mkdir", "-p", p["dir"])
         sess.upload(os.path.abspath(LOGD_SRC), p["src"])
         sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        # An interrupted earlier run leaks its daemon; a stale server
+        # on our port serves foreign data -> false convictions
+        # (grepkill! on setup, control/util.clj pattern).
+        cutil.grepkill(sess, f"logd --port {node_port(test)} ")
         self.start(test, sess, node)
         cutil.await_tcp_port(
             sess, node_port(test), timeout_s=30, interval_s=0.1

@@ -58,10 +58,26 @@ TXND_SRC = _demo.source("txnd")
 BASE_PORT = 7550
 
 
+def _derived_base(test: dict, key: str, fallback: int) -> int:
+    """Per-run base port: explicit test[key] wins; else derive
+    from the store dir via the shared hashed_base_port formula
+    (stable per run, distinct across concurrent runs, below the
+    Linux ephemeral range — round 5: two builders sharing a
+    BASE_PORT constant convicted a healthy run)."""
+    explicit = test.get(key)
+    if explicit is not None:
+        return explicit
+    seed = test.get("store-dir")
+    if not seed:
+        return fallback
+    return cutil.hashed_base_port(seed, fallback)
+
+
 def node_port(test: dict, node: str) -> int:
     nodes = test.get("nodes") or []
     if test.get("txnd-local", True):
-        return test.get("txnd-base-port", BASE_PORT) + 1 + nodes.index(node)
+        return _derived_base(test, "txnd-base-port",
+                             BASE_PORT) + 1 + nodes.index(node)
     return test.get("txnd-port", BASE_PORT)
 
 
@@ -88,6 +104,10 @@ class TxndDB(jdb.DB):
         sess.exec("mkdir", "-p", p["dir"])
         sess.upload(os.path.abspath(TXND_SRC), p["src"])
         sess.exec("g++", "-O2", "-pthread", "-o", p["bin"], p["src"])
+        # An interrupted earlier run leaks its daemon; a stale server
+        # on our port serves foreign data -> false convictions
+        # (grepkill! on setup, control/util.clj pattern).
+        cutil.grepkill(sess, f"txnd --port {node_port(test, node)} ")
         self.start(test, sess, node)
         cutil.await_tcp_port(
             sess, node_port(test, node), timeout_s=30, interval_s=0.1

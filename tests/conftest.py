@@ -22,3 +22,15 @@ if os.environ.get("JEPSEN_TPU_TEST_PLATFORM", "cpu") != "tpu":
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+
+
+def free_port() -> int:
+    """A fresh localhost port for host-net suite tests: hardcoded
+    ports collide with daemons leaked by interrupted earlier runs or
+    with a concurrent builder's suites on this machine (the round-5
+    7401 false-conviction incident)."""
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]

@@ -44,6 +44,9 @@ from jepsen_tpu.control import (
 pytestmark = pytest.mark.integration
 
 
+
+from conftest import free_port as _free_port  # noqa: E402
+
 def _env_nodes() -> list[str]:
     raw = os.environ.get("JEPSEN_TPU_SSH_NODES", "")
     return [n.strip() for n in raw.split(",") if n.strip()]
@@ -237,7 +240,7 @@ def test_kvdb_suite_over_ssh(cluster, tmp_path):
     # Real-cluster topology: one fixed port; clients dial the node's
     # host part directly (the netns node name's host part is its IP).
     test["kvdb-local"] = False
-    test["kvdb-port"] = 7000
+    test["kvdb-port"] = _free_port()
     done = core.run(test)
     assert done["results"]["valid"] in (True, "unknown")
     assert any(o.process == "nemesis" for o in done["history"])

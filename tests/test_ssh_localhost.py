@@ -33,6 +33,9 @@ from jepsen_tpu.control.minissh import MiniSshServer, generate_keypair
 N_NODES = 3
 
 
+
+from conftest import free_port as _free_port  # noqa: E402
+
 @pytest.fixture(scope="module")
 def cluster(tmp_path_factory):
     """N_NODES loopback minissh servers with hostnames n1..nN, plus
@@ -176,7 +179,7 @@ def test_kvdb_suite_over_ssh(cluster, tmp_path):
     }
     test["store-dir"] = str(tmp_path / "store")
     test["kvdb-local"] = False
-    test["kvdb-port"] = 7401
+    test["kvdb-port"] = _free_port()
     done = core.run(test)
     assert done["results"]["valid"] in (True, "unknown")
     assert any(o.process == "nemesis" for o in done["history"])
