@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""Differential soak of the round-5 engines.
+
+Random per-key workloads (mixed sizes, info rates, fault injection)
+checked THREE independent ways that must agree:
+
+  1. per-key exact CPU reference (checker/wgl_cpu.py memoized DFS);
+  2. the key-concatenated stream witness (ops/wgl_stream.py) — its
+     True verdicts must never contradict the reference (soundness);
+     None only means escalate;
+  3. the single-history witness engine under every transfer mode
+     ("full" / "indices" / "device") on each key — verdicts AND death
+     behavior must agree across modes.
+
+The planted-violation rate (~15% of keys) is itself asserted: a
+reference that stops convicting the planted bad reads fails the soak
+(reference-miss), so a completeness collapse can't silently pass.
+
+Usage: python tools/soak_round5.py [--minutes 30] [--seed0 0]
+Prints one JSON progress line per batch and a final summary line.
+The budget is checked between keys, so a batch overruns by at most
+one key's check (first-compile batches can still take minutes).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=30.0)
+    ap.add_argument("--seed0", type=int, default=0)
+    ap.add_argument("--platform", default="cpu",
+                    choices=("cpu", "default"))
+    args = ap.parse_args()
+
+    import jax
+
+    if args.platform == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+
+    from jepsen_tpu.checker.wgl_cpu import check_wgl_cpu
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register, register
+    from jepsen_tpu.ops.wgl_stream import check_wgl_witness_stream
+    from jepsen_tpu.ops.wgl_witness import check_wgl_witness
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    t_end = time.monotonic() + args.minutes * 60.0
+    rng = random.Random(args.seed0)
+    batches = trials = stream_true = stream_none = bad_planted = 0
+    mismatches = []
+
+    while time.monotonic() < t_end:
+        batches += 1
+        use_cas = rng.random() < 0.7
+        model = cas_register() if use_cas else register()
+        pm = model.packed()
+        n_keys = rng.choice([3, 8, 20])
+        packs, cpu_verdicts = [], []
+        for i in range(n_keys):
+            if time.monotonic() > t_end:
+                n_keys = i
+                break
+            n = rng.choice([60, 120])
+            info = rng.choice([0.0, 0.05, 0.2])
+            procs = rng.choice([4, 8])
+            bad = rng.random() < 0.15
+            bad_planted += bad
+            seed = args.seed0 * 1_000_003 + batches * 1009 + i
+            h = random_register_history(
+                n, procs=procs, info_rate=info, seed=seed,
+                cas=use_cas, bad=bad,
+            )
+            p = pack_history(h, pm.encode)
+            packs.append(p)
+            cpu = check_wgl_cpu(p, pm, max_configs=5_000_000)
+            cpu_verdicts.append(cpu.valid)
+            if bad and cpu.valid is True:
+                # The reference itself stopped convicting planted
+                # violations: the whole differential would go vacuous.
+                mismatches.append({
+                    "kind": "reference-miss", "batch": batches,
+                    "key": i, "seed": seed,
+                })
+        if not packs:
+            break
+
+        # --- stream soundness: True never contradicts the reference.
+        sv = check_wgl_witness_stream(packs, pm)
+        for i, (s, c) in enumerate(zip(sv, cpu_verdicts)):
+            trials += 1
+            if s is True:
+                stream_true += 1
+                if c is False:
+                    mismatches.append({
+                        "kind": "stream-unsound", "batch": batches,
+                        "key": i, "cpu": c,
+                    })
+            else:
+                stream_none += 1
+
+        # --- transfer-mode agreement on a sample of keys.
+        for i in rng.sample(range(n_keys), min(3, n_keys)):
+            vs = {}
+            for mode in ("full", "indices", "device"):
+                r = check_wgl_witness(packs[i], pm, transfer=mode)
+                vs[mode] = None if r is None else r.valid
+            if len(set(vs.values())) != 1:
+                mismatches.append({
+                    "kind": "transfer-divergence", "batch": batches,
+                    "key": i, "verdicts": vs,
+                })
+            # Witness True must also never contradict the reference.
+            if vs["full"] is True and cpu_verdicts[i] is False:
+                mismatches.append({
+                    "kind": "witness-unsound", "batch": batches,
+                    "key": i,
+                })
+
+        if batches % 20 == 0:
+            # LLVM executables accumulate across the shape lottery;
+            # an hour-long soak OOMed the compile cache (observed:
+            # "LLVM compilation error: Cannot allocate memory").
+            jax.clear_caches()
+        print(json.dumps({
+            "batches": batches, "keys": trials,
+            "stream_true": stream_true, "stream_none": stream_none,
+            "bad_planted": bad_planted,
+            "mismatches": len(mismatches),
+        }), flush=True)
+        if mismatches:
+            break
+
+    print(json.dumps({
+        "done": True, "batches": batches, "keys": trials,
+        "stream_true": stream_true, "stream_none": stream_none,
+        "bad_planted": bad_planted, "mismatches": mismatches,
+    }), flush=True)
+    return 1 if mismatches else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
