@@ -21,6 +21,10 @@ def test_bench_emits_one_json_line_cpu():
         JEPSEN_BENCH_OPS="3000",
         JEPSEN_BENCH_PROCS="8",
         JEPSEN_BENCH_TIME_LIMIT="120",
+        # CI-sized scale point: the full default (20M rows) costs
+        # minutes per suite run; 1M still exercises the whole
+        # second-metric path (generate -> check -> merge).
+        JEPSEN_BENCH_SCALE_OPS="1000000",
         JAX_PLATFORMS="cpu",
     )
     proc = subprocess.run(
@@ -38,6 +42,12 @@ def test_bench_emits_one_json_line_cpu():
     assert rec["vs_baseline"] > 0
     assert rec["platform"] == "cpu"
     assert "error" not in rec
+    # Second headline metric (VERDICT r4 #4) rides the SAME line.
+    scale = rec["scale"]
+    assert scale["metric"] == "scale_ops_to_verdict"
+    assert scale["valid"] is True
+    assert scale["ops"] >= 900_000
+    assert scale["max_ops_at_300s"] > scale["ops"]
 
 
 def test_last_good_keeps_best_across_a_slow_rerun(tmp_path, monkeypatch):
