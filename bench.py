@@ -10,9 +10,14 @@ verdict in <60 s on this history (BASELINE.md), i.e. ~1,667 ops
 checked/sec; knossos itself times out.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": "ops/s", "vs_baseline": N,
+   "phases": {"generate": s, "pack": s, "warmup": s, "check": s}}
 vs_baseline > 1.0 means faster than the 60-s north-star floor.
 On any failure the line still prints, with value 0 and an "error" field.
+"phases" is a coarse wall-clock breakdown and is always present on
+success; with JEPSEN_TELEMETRY=1 the run additionally exports the full
+span registry (telemetry.json + Perfetto trace.json) to
+JEPSEN_TELEMETRY_DIR (default store/bench) without touching stdout.
 
 Flags (env):
   JEPSEN_BENCH_OPS        history length        (default 100000)
@@ -146,17 +151,30 @@ def run_bench() -> int:
     try:
         platform = init_backend()
 
+        from jepsen_tpu import telemetry
         from jepsen_tpu.history.packed import pack_history
         from jepsen_tpu.models import cas_register
         from jepsen_tpu.ops.wgl import check_wgl_device
         from jepsen_tpu.utils.histgen import random_register_history
 
+        telemetry.reset()
+        # Coarse phase timers are ALWAYS on (one monotonic call per
+        # phase — nowhere near the <2% contract) so the JSON line's
+        # "phases" field never depends on JEPSEN_TELEMETRY; the spans
+        # additionally feed the full trace when telemetry is enabled.
+        phases: dict = {}
         model = cas_register()
         pm = model.packed()
-        h = random_register_history(
-            n_ops, procs=procs, info_rate=info_rate, seed=45100
-        )
-        packed = pack_history(h, pm.encode)
+        t_ph = time.monotonic()
+        with telemetry.span("bench.generate"):
+            h = random_register_history(
+                n_ops, procs=procs, info_rate=info_rate, seed=45100
+            )
+        phases["generate"] = round(time.monotonic() - t_ph, 3)
+        t_ph = time.monotonic()
+        with telemetry.span("bench.pack"):
+            packed = pack_history(h, pm.encode)
+        phases["pack"] = round(time.monotonic() - t_ph, 3)
 
         # Warm-up on a short prefix so JIT compilation of the kernels is
         # excluded from the measured run (first TPU compile is tens of
@@ -172,11 +190,13 @@ def run_bench() -> int:
             4096, procs=procs, info_rate=info_rate, seed=7
         )
         warm_start = time.monotonic()
-        check_wgl_device(
-            pack_history(warm, pm.encode), pm,
-            time_limit_s=min(120.0, budget / 2),
-            width_hint=width,
-        )
+        with telemetry.span("bench.warmup"):
+            check_wgl_device(
+                pack_history(warm, pm.encode), pm,
+                time_limit_s=min(120.0, budget / 2),
+                width_hint=width,
+            )
+        phases["warmup"] = round(time.monotonic() - warm_start, 3)
         # The measured run gets whatever budget the warm-up left, so
         # total wall time stays bounded by ~budget (the driver kills
         # overruns before the JSON line prints — round-1 rc=124).
@@ -192,7 +212,8 @@ def run_bench() -> int:
         times = []
         for _ in range(3):
             t0 = time.monotonic()
-            res = check_wgl_device(packed, pm, time_limit_s=budget)
+            with telemetry.span("bench.check"):
+                res = check_wgl_device(packed, pm, time_limit_s=budget)
             elapsed = time.monotonic() - t0
             if res.valid is not True:
                 break
@@ -200,6 +221,7 @@ def run_bench() -> int:
             budget -= elapsed
             if budget <= 0:
                 break
+        phases["check"] = round(sum(times), 3)
         if not times:
             emit(
                 0.0,
@@ -215,12 +237,19 @@ def run_bench() -> int:
         elapsed = times[len(times) // 2]
 
         ops_per_s = packed.n / elapsed
+        if telemetry.enabled():
+            # Full span/trace export for telemetry-enabled bench runs;
+            # stdout stays untouched (one-JSON-line contract).
+            telemetry.export(os.environ.get(
+                "JEPSEN_TELEMETRY_DIR", os.path.join("store", "bench")
+            ))
         emit(
             ops_per_s,
             ops_per_s / baseline_floor,
             platform=platform,
             elapsed_s=round(elapsed, 3),
             n_ops=packed.n,
+            phases=phases,
             # Multi-rep evidence (VERDICT r4 #8): the rep count and
             # min/max spread retire the single-rep ±30% caveat — a
             # last-good record with reps>=3 is a median, not a mood.

@@ -19,6 +19,7 @@ from collections import Counter as MultiSet
 from collections import defaultdict
 from typing import Any, Callable, Iterable, Optional
 
+from .. import telemetry
 from ..history.core import INFO, INVOKE, OK, History, Op
 from ..utils import bounded_pmap, fraction
 
@@ -67,10 +68,22 @@ def checker(fn: Callable[[dict, History, dict], dict], name: str = "fn") -> Chec
     return FnChecker(fn, name)
 
 
+def checker_name(c: Any) -> str:
+    """A stable span/report label for a checker instance: an explicit
+    `name` attribute (FnChecker) or the class name."""
+    n = getattr(c, "name", None)
+    return n if isinstance(n, str) and n else type(c).__name__
+
+
 def check_safe(c: Checker, test: dict, history: History, opts: Optional[dict] = None) -> dict:
     """Like Checker.check, but exceptions become {"valid": "unknown"}
-    results instead of propagating (checker.clj:79-90)."""
+    results instead of propagating (checker.clj:79-90).  Each call is a
+    `checker.<Name>` telemetry span, so composed checkers get per-child
+    timing for free."""
     try:
+        if telemetry.enabled():
+            with telemetry.span(f"checker.{checker_name(c)}"):
+                return c.check(test, history, opts or {})
         return c.check(test, history, opts or {})
     except Exception as e:  # noqa: BLE001
         import traceback

@@ -225,7 +225,21 @@ def grepkill(sess: "Session", pattern: str,
     # process whose cmdline contains the (bracketed) pattern text.
     if not pattern:
         return
-    safe = f"[{pattern[0]}]{pattern[1:]}"
+    c = pattern[0]
+    # The trick is only sound when the leading character is an
+    # ordinary literal: wrapping a metacharacter changes the ERE —
+    # '[^...]' becomes a negated class, '[\]' is implementation-
+    # defined, '[.]' narrows any-char to literal-dot (and '[.' opens
+    # a POSIX collating symbol) — and a changed regex can SIGKILL
+    # unrelated processes or miss the target.  Reject rather than
+    # guess: every real caller passes a daemon/command name.
+    if not (c.isalnum() or c in "_/-"):
+        raise ValueError(
+            f"grepkill pattern must start with a literal character "
+            f"(letter, digit, '_', '/', or '-'), got {c!r}: the "
+            f"self-match-avoiding bracket wrap would change the regex"
+        )
+    safe = f"[{c}]{pattern[1:]}"
     sess.exec_star(
         "bash", "-c",
         f"pkill -{signal} -f -- {shlex.quote(safe)} || true",

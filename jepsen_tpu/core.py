@@ -23,7 +23,7 @@ import re
 from typing import Any, Optional
 
 from . import db as jdb
-from . import interpreter, oses, store
+from . import interpreter, oses, store, telemetry
 from .checker.core import check_safe
 from .control import with_sessions
 from .history import History
@@ -109,11 +109,14 @@ def run_case(test: dict, history_writer=None) -> History:
     test = dict(test)
     test["nemesis"] = nem
     try:
-        _with_clients(test, "setup")
-        return interpreter.run(test, writer=history_writer)
+        with telemetry.span("lifecycle.client-setup"):
+            _with_clients(test, "setup")
+        with telemetry.span("lifecycle.interpreter"):
+            return interpreter.run(test, writer=history_writer)
     finally:
         try:
-            _with_clients(test, "teardown")
+            with telemetry.span("lifecycle.client-teardown"):
+                _with_clients(test, "teardown")
         finally:
             nem.teardown(test)
 
@@ -133,7 +136,8 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
             opts["dir"] = store.test_dir(test)
         except ValueError:
             pass
-    return check_safe(checker, test, history, opts)
+    with telemetry.span("lifecycle.analyze"):
+        return check_safe(checker, test, history, opts)
 
 
 def log_results(results: dict) -> None:
@@ -149,52 +153,78 @@ def log_results(results: dict) -> None:
 
 def run(test: dict) -> dict:
     """The full lifecycle (core.clj:322-412).  Returns the test map with
-    "history" and "results" added."""
-    test = prepare_test(test)
-    test = store.make_test_dir(test)
-    handler = store.start_logging(test)
+    "history" and "results" added.
+
+    With JEPSEN_TELEMETRY=1 the run is a telemetry scope: the registry
+    is reset on entry, every lifecycle phase is spanned, and on exit
+    telemetry.json + trace.json land in the run's store dir with the
+    top-5 spans logged (telemetry/__init__.py)."""
+    telemetry.reset()
+    with telemetry.span("lifecycle.prepare"):
+        test = prepare_test(test)
+        test = store.make_test_dir(test)
     try:
-        with store.Store(test) as st:
-            st.save_0(test)
-            hw = st.history_writer()
-            with with_sessions(test):
-                try:
-                    oses.setup(test)
-                    jdb.cycle(test)
-                    history = run_case(test, history_writer=hw.append)
-                    test["history"] = history
-                    st.save_1(test, history)
-                finally:
-                    # Whatever happened — OS/DB setup crash, client bug
-                    # mid-run — seal any partial history so the file
-                    # stays readable for `analyze`.
-                    try:
-                        hw.close()
-                    except Exception as e:  # noqa: BLE001
-                        log.warning("history seal failed: %r", e)
-                    # Snarf logs even when the run throws — failing runs
-                    # are exactly the ones whose logs matter
-                    # (core.clj:142-158 with-log-snarfing).
-                    if test.get("db") is not None:
-                        try:
-                            jdb.snarf_logs(test, store.test_dir(test))
-                        except Exception as e:  # noqa: BLE001
-                            log.warning("log snarfing failed: %r", e)
-                    if not test.get("leave-db-running"):
-                        try:
-                            jdb.teardown(test)
-                        except Exception as e:  # noqa: BLE001
-                            log.warning("db teardown failed: %r", e)
-                    try:
-                        oses.teardown(test)
-                    except Exception as e:  # noqa: BLE001
-                        log.warning("os teardown failed: %r", e)
-            results = analyze(test, test["history"])
-            test["results"] = results
-            st.save_2(results)
-            log_results(results)
+        return _run_prepared(test)
     finally:
-        store.stop_logging(handler)
+        # Export in a finally: a crashed run is exactly the one whose
+        # phase profile matters.
+        if telemetry.enabled():
+            telemetry.export(store.test_dir(test))
+            telemetry.log_top_spans(log)
+
+
+def _run_prepared(test: dict) -> dict:
+    """The lifecycle after prepare — wrapped so `run` can export
+    telemetry for crashed runs too."""
+    with telemetry.span("lifecycle.run", test=test.get("name")):
+        handler = store.start_logging(test)
+        try:
+            with store.Store(test) as st:
+                st.save_0(test)
+                hw = st.history_writer()
+                with with_sessions(test):
+                    try:
+                        with telemetry.span("lifecycle.os-setup"):
+                            oses.setup(test)
+                        with telemetry.span("lifecycle.db-cycle"):
+                            jdb.cycle(test)
+                        history = run_case(test, history_writer=hw.append)
+                        test["history"] = history
+                        with telemetry.span("lifecycle.save"):
+                            st.save_1(test, history)
+                    finally:
+                        # Whatever happened — OS/DB setup crash, client bug
+                        # mid-run — seal any partial history so the file
+                        # stays readable for `analyze`.
+                        try:
+                            hw.close()
+                        except Exception as e:  # noqa: BLE001
+                            log.warning("history seal failed: %r", e)
+                        # Snarf logs even when the run throws — failing runs
+                        # are exactly the ones whose logs matter
+                        # (core.clj:142-158 with-log-snarfing).
+                        if test.get("db") is not None:
+                            try:
+                                with telemetry.span("lifecycle.snarf"):
+                                    jdb.snarf_logs(test, store.test_dir(test))
+                            except Exception as e:  # noqa: BLE001
+                                log.warning("log snarfing failed: %r", e)
+                        if not test.get("leave-db-running"):
+                            try:
+                                jdb.teardown(test)
+                            except Exception as e:  # noqa: BLE001
+                                log.warning("db teardown failed: %r", e)
+                        try:
+                            oses.teardown(test)
+                        except Exception as e:  # noqa: BLE001
+                            log.warning("os teardown failed: %r", e)
+                results = analyze(test, test["history"])
+                test["results"] = results
+                with telemetry.span("lifecycle.save"):
+                    st.save_2(results)
+                log_results(results)
+        finally:
+            store.stop_logging(handler)
     return test
 
 

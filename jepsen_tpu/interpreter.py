@@ -29,6 +29,7 @@ import time as time_mod
 from typing import Any, Callable, Optional
 
 from . import client as jepsen_client
+from . import telemetry
 from .client import Client
 from .generator import (
     PENDING,
@@ -103,6 +104,11 @@ class Worker:
                 elif op.type == "log":
                     log.info("%s", op.value)
                     completion = op
+                elif telemetry.enabled():
+                    # The gate keeps the disabled per-op path free of
+                    # even the attrs-dict build.
+                    with telemetry.span("interpreter.op", f=str(op.f)):
+                        completion = self.transact(op)
                 else:
                     completion = self.transact(op)
             except Exception as e:  # noqa: BLE001 — worker must not die
@@ -317,4 +323,6 @@ def run(
             for w in workers.values():
                 w.join(timeout=10.0)
 
+    telemetry.count("interpreter.ops-journaled", op_index)
+    telemetry.gauge("interpreter.workers", len(workers))
     return History(ops, reindex=False)

@@ -39,6 +39,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from .. import telemetry
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
@@ -454,21 +455,24 @@ def check_wgl_device(
             r = remaining()
             return r is not None and r <= 0
 
-        wres = check_wgl_witness(
-            packed, pm, info_window=NARROW_INFO_WINDOW,
-            time_limit_s=remaining(), width_hint=width_hint,
-            checkpoint_dir=checkpoint_dir,
-        )
-        if wres is None and not timed_out() and plan_drops(
-            packed, info_window=NARROW_INFO_WINDOW
-        ):
+        with telemetry.span("wgl.witness"):
             wres = check_wgl_witness(
-                packed, pm, info_window=WIDE_INFO_WINDOW,
+                packed, pm, info_window=NARROW_INFO_WINDOW,
                 time_limit_s=remaining(), width_hint=width_hint,
                 checkpoint_dir=checkpoint_dir,
             )
+            if wres is None and not timed_out() and plan_drops(
+                packed, info_window=NARROW_INFO_WINDOW
+            ):
+                wres = check_wgl_witness(
+                    packed, pm, info_window=WIDE_INFO_WINDOW,
+                    time_limit_s=remaining(), width_hint=width_hint,
+                    checkpoint_dir=checkpoint_dir,
+                )
         if wres is not None:
+            telemetry.count("wgl.witness.hit")
             return wres
+        telemetry.count("wgl.witness.miss")
         if timed_out():
             return WGLResult(
                 valid="unknown",
@@ -533,6 +537,7 @@ def check_wgl_device(
             # the wrong model's transition kernel.
             key = (B, W, SW, Cmax, pm.jax_step, mesh)
             fn = _block_fn_cache.get(key)
+            fresh_fn = fn is None
             if fn is None:
                 if mesh is not None:
                     fn = _make_block_fn_sharded(
@@ -555,10 +560,29 @@ def check_wgl_device(
                 jnp.asarray(sh1v),
                 jnp.asarray(sh2v),
             ]
-            out = fn(member, states, alive, jnp.int32(iters), *targs)
-            member, states, alive, accepted, incomplete, explored, it_done = out
-            accepted_b = bool(accepted)
-            incomplete_b = bool(incomplete)
+            if telemetry.enabled():
+                # Fresh cache entries pay jit trace+compile inside the
+                # first call — "wgl.bfs.compile" vs "wgl.bfs.block" is
+                # the compile/execute split the phase profile reports.
+                telemetry.count(
+                    "wgl.h2d-bytes",
+                    int(sum(a.nbytes for a in tables.values()
+                            if hasattr(a, "nbytes"))),
+                )
+                telemetry.gauge("wgl.bfs.beam", B)
+                telemetry.gauge("wgl.bfs.window", W)
+                sp = telemetry.span(
+                    "wgl.bfs.compile" if fresh_fn else "wgl.bfs.block"
+                )
+            else:
+                sp = telemetry.span("")  # shared no-op
+            with sp:
+                out = fn(member, states, alive, jnp.int32(iters), *targs)
+                member, states, alive, accepted, incomplete, explored, it_done = out
+                accepted_b = bool(accepted)
+                incomplete_b = bool(incomplete)
+            if telemetry.enabled():
+                telemetry.count("wgl.bfs.rounds", int(it_done))
 
             if accepted_b:
                 explored_total += int(explored)

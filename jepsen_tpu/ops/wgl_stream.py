@@ -51,9 +51,10 @@ from typing import Any, Optional
 
 import numpy as np
 
+from .. import telemetry
 from ..history.packed import NO_RET, ST_OK, PackedOps
 from ..models.base import PackedModel
-from .wgl_witness import check_wgl_witness
+from .wgl_witness import INF, check_wgl_witness
 
 #: Synthetic f-code for the inter-key reset barrier.  Far above any
 #: interner-assigned op code (those are small dense ints), well inside
@@ -122,6 +123,26 @@ def stream_model(pm: PackedModel) -> PackedModel:
     )
     _stream_model_cache[key] = spm
     return spm
+
+
+def stream_timeline_len(packs: list[PackedOps]) -> int:
+    """The combined timeline length `concat_packs` would produce (an
+    exclusive upper bound on every event index): per key, segment
+    width E_i (one past the largest event index used) plus 2 for the
+    RESET barrier's inv/ret slots.  The witness engine's device
+    tables are int32, so a stream past INF must fall back to per-key
+    checking (which stays in int64 end to end)."""
+    total = 0
+    for p in packs:
+        if p.n:
+            okm = p.status == ST_OK
+            e_max = int(p.inv.max())
+            if okm.any():
+                e_max = max(e_max, int(p.ret[okm].max()))
+            total += e_max + 3  # E = e_max + 1, plus the RESET's 2 slots
+        else:
+            total += 2
+    return total
 
 
 def concat_packs(
@@ -230,6 +251,17 @@ def check_wgl_witness_stream(
     verdicts: list[Any] = [None] * K
     if K == 0:
         return verdicts
+    if stream_timeline_len(packs) >= int(INF):
+        # The witness engine clamps event indices to int32; a
+        # concatenated timeline past INF would wrap on cast (the plan
+        # would also raise OverflowError — this precheck just skips
+        # building the doomed combined pack).  All-None verdicts send
+        # every key to per-key checking, which stays in int64.
+        log.info(
+            "stream witness: combined timeline exceeds int32; "
+            "falling back to per-key checking for %d keys", K,
+        )
+        return verdicts
     spm = stream_model(pm)
     t0 = time.monotonic()
     if max_restarts is None:
@@ -239,40 +271,46 @@ def check_wgl_witness_stream(
         max_restarts = max(8, K // 8)
     start = 0
     restarts = 0
-    while start < K:
-        remaining = None
-        if time_limit_s is not None:
-            remaining = time_limit_s - (time.monotonic() - t0)
-            if remaining <= 0:
-                break
-        combined, override, key_of_bar = concat_packs(packs[start:])
-        info: dict = {}
-        r = check_wgl_witness(
-            combined, spm,
-            rank_override=override,
-            out_info=info,
-            time_limit_s=remaining,
-            **witness_kw,
-        )
-        if r is not None and r.valid is True:
-            for k in range(start, K):
-                verdicts[k] = True
-            return verdicts
-        died = info.get("died_at_rank")
-        if died is None:
-            break  # budget blown or unlocalized: the rest stay None
-        bad = int(key_of_bar[died])
-        # Every barrier of keys before the dead one was linearized
-        # before the death point: those keys are proven.
-        for k in range(bad):
-            verdicts[start + k] = True
-        start += bad + 1
-        restarts += 1
-        if restarts >= max_restarts:
-            log.info(
-                "stream witness: %d restarts (max %d); %d keys left "
-                "for the exact engines", restarts, max_restarts,
-                K - start,
+    with telemetry.span("wgl.stream", keys=K):
+        while start < K:
+            remaining = None
+            if time_limit_s is not None:
+                remaining = time_limit_s - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+            combined, override, key_of_bar = concat_packs(packs[start:])
+            info: dict = {}
+            r = check_wgl_witness(
+                combined, spm,
+                rank_override=override,
+                out_info=info,
+                time_limit_s=remaining,
+                **witness_kw,
             )
-            break
+            if r is not None and r.valid is True:
+                for k in range(start, K):
+                    verdicts[k] = True
+                start = K
+                break
+            died = info.get("died_at_rank")
+            if died is None:
+                break  # budget blown or unlocalized: the rest stay None
+            bad = int(key_of_bar[died])
+            # Every barrier of keys before the dead one was linearized
+            # before the death point: those keys are proven.
+            for k in range(bad):
+                verdicts[start + k] = True
+            start += bad + 1
+            restarts += 1
+            if restarts >= max_restarts:
+                log.info(
+                    "stream witness: %d restarts (max %d); %d keys left "
+                    "for the exact engines", restarts, max_restarts,
+                    K - start,
+                )
+                break
+    if telemetry.enabled():
+        telemetry.count("wgl.stream.keys-proven",
+                        sum(1 for v in verdicts if v is True))
+        telemetry.count("wgl.stream.restarts", restarts)
     return verdicts

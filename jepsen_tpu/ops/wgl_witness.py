@@ -65,6 +65,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from .. import telemetry
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
@@ -207,8 +208,25 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
 
     Returns (bars, bar_rank, inv32, ret32, blocks, any_dropped);
     `any_dropped` reports whether any block actually lost info columns
-    to the bound — when False, a wider retry would plan identically."""
+    to the bound — when False, a wider retry would plan identically.
+
+    Raises OverflowError when any real event index is >= int32 INF:
+    the int32 casts below would otherwise WRAP (negative inv) or clamp
+    a real return to the info sentinel — either silently corrupts the
+    barrier order.  Reachable via the stream checker's concatenated
+    timeline (ops/wgl_stream.py accumulates E+2 per key); callers
+    treat it as "witness tier unusable, escalate"."""
     status = packed.status
+    if packed.n:
+        t_max = int(packed.inv.max())
+        okm = status == ST_OK
+        if okm.any():
+            t_max = max(t_max, int(packed.ret[okm].max()))
+        if t_max >= int(INF):
+            raise OverflowError(
+                f"event timeline exceeds int32: max index {t_max} >= "
+                f"{int(INF)}; witness tier cannot represent this history"
+            )
     inv32 = packed.inv.astype(np.int32)
     ret32 = np.minimum(packed.ret, np.int64(INF)).astype(np.int32)
     ok_rows = np.nonzero(status == ST_OK)[0]
@@ -276,8 +294,11 @@ def plan_width(packed: PackedOps, bars_per_block: int = 1024,
     warm-up run pre-compile the same kernel via `width_hint`."""
     if packed.n == 0 or packed.n_ok == 0:
         return 0
-    _, _, _, _, blocks, _ = _plan_blocks(packed, bars_per_block,
-                                         info_window)
+    try:
+        _, _, _, _, blocks, _ = _plan_blocks(packed, bars_per_block,
+                                             info_window)
+    except OverflowError:
+        return 0  # witness tier can't run this history; nothing to warm
     return _bucket(max(max(len(a) for _, _, a in blocks), 1))
 
 
@@ -290,7 +311,10 @@ def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
         return False
     if packed.n - packed.n_ok <= info_window:
         return False  # cheap bound: fewer info ops than the window
-    return _plan_blocks(packed, bars_per_block, info_window)[5]
+    try:
+        return _plan_blocks(packed, bars_per_block, info_window)[5]
+    except OverflowError:
+        return False  # no witness run happens at all, so no drops
 
 
 def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
@@ -980,9 +1004,15 @@ def check_wgl_witness(
 
     if rank_override is not None:
         checkpoint_dir = None  # ckpt key does not cover the override
-    bars, bar_rank, inv32, ret32, blocks, _ = _plan_blocks(
-        packed, bars_per_block, info_window, rank_override
-    )
+    try:
+        with telemetry.span("wgl.witness.plan", n=n):
+            bars, bar_rank, inv32, ret32, blocks, _ = _plan_blocks(
+                packed, bars_per_block, info_window, rank_override
+            )
+    except OverflowError:
+        # Timeline past int32 (e.g. a huge concatenated stream): the
+        # witness tier can't represent it — escalate, don't crash.
+        return None
     n_bars = len(bars)
     if max(len(a) for _, _, a in blocks) > max_window:
         return None
@@ -1000,6 +1030,10 @@ def check_wgl_witness(
     D = depth
     NB = blocks_per_call
     W = _bucket(max(max(len(a) for _, _, a in blocks), width_hint, 1))
+    if telemetry.enabled():
+        telemetry.gauge("wgl.witness.window", W)
+        telemetry.gauge("wgl.witness.beam", B)
+        telemetry.gauge("wgl.witness.blocks", len(blocks))
 
     if pallas not in ("auto", "on", "off", "interpret"):
         raise ValueError(f"unknown pallas mode {pallas!r}")
@@ -1095,6 +1129,9 @@ def check_wgl_witness(
     # can collide after GC address reuse and serve the wrong
     # model's transition kernel.
     key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
+    # jax.jit is lazy: a freshly built chunk fn actually compiles on
+    # its FIRST call — the trace labels that call "compile".
+    fresh_fn = False
     fns = _chunk_fn_cache.get(key)
     if fns is _BUILD_FAILED:
         # Mosaic deterministically rejected this kernel earlier in the
@@ -1107,6 +1144,7 @@ def check_wgl_witness(
         key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
         fns = _chunk_fn_cache.get(key)
     if fns is None:
+        fresh_fn = True
         try:
             fns = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
                                  pallas_mode=pallas,
@@ -1128,6 +1166,7 @@ def check_wgl_witness(
         dev_key = (key, dev_slice)
         fn_dev = _chunk_dev_cache.get(dev_key)
         if fn_dev is None:
+            fresh_fn = True  # new device-planner entry compiles too
             fn_dev = make_dev(dev_slice)
             _chunk_dev_cache[dev_key] = fn_dev
 
@@ -1142,6 +1181,9 @@ def check_wgl_witness(
             for a in (packed.f, packed.a0, packed.a1, ret32, inv32,
                       np.minimum(bar_rank, NO_BAR))
         )
+        if telemetry.enabled():
+            telemetry.count("wgl.h2d-bytes",
+                            sum(int(a.nbytes) for a in row_tables))
     if transfer == "device":
         # Device planning extras: the info cumsum (retention rule),
         # the barrier array (padded so any k0 slice is in bounds),
@@ -1275,34 +1317,55 @@ def check_wgl_witness(
                     present_np[bi, nw:] = False
                 prev_active = active
 
-        try:
+        if telemetry.enabled():
             if transfer == "device":
-                (member, states, alive, failed, died,
-                 prev_act_dev) = fn_dev(
-                    member, states, alive, failed, prev_act_dev,
-                    *dev_args, jnp.int32(packed.n),
-                    *row_tables, icumA, barsA,
-                )
+                h2d = sum(int(a.nbytes) for a in dev_args) + 4
             elif transfer == "indices":
-                member, states, alive, failed, died = fn_idx(
-                    member, states, alive, failed,
-                    jnp.asarray(bar_idx_np), jnp.asarray(act_idx_np),
-                    jnp.asarray(nbars_np), jnp.asarray(nws_np),
-                    jnp.asarray(perm_np), jnp.asarray(present_np),
-                    jnp.asarray(k0s_np), *row_tables,
-                )
+                h2d = sum(int(a.nbytes) for a in (
+                    bar_idx_np, act_idx_np, nbars_np, nws_np,
+                    perm_np, present_np, k0s_np))
             else:
-                member, states, alive, failed, died = fn(
-                    member, states, alive, failed,
-                    jnp.asarray(bars_np), jnp.asarray(tab_np),
-                    jnp.asarray(perm_np), jnp.asarray(present_np),
-                    jnp.asarray(k0s_np),
-                )
-            # One sync per chunk (~32k barriers): early exit + time
-            # budget.  The sync ALSO belongs inside the try — jitted
-            # dispatch is asynchronous, so execution-time failures
-            # only raise when a result is consumed.
-            failed_now = bool(failed)
+                h2d = sum(int(a.nbytes) for a in (
+                    bars_np, tab_np, perm_np, present_np, k0s_np))
+            telemetry.count("wgl.h2d-bytes", h2d)
+            telemetry.count("wgl.witness.chunks", 1)
+            sp = telemetry.span(
+                "wgl.witness.compile" if fresh_fn
+                else "wgl.witness.chunk", transfer=transfer)
+        else:
+            sp = telemetry.span("")  # shared no-op
+        fresh_fn = False
+        try:
+            # The span covers dispatch AND the bool(failed) sync, so
+            # its duration is real device time, not async enqueue.
+            with sp:
+                if transfer == "device":
+                    (member, states, alive, failed, died,
+                     prev_act_dev) = fn_dev(
+                        member, states, alive, failed, prev_act_dev,
+                        *dev_args, jnp.int32(packed.n),
+                        *row_tables, icumA, barsA,
+                    )
+                elif transfer == "indices":
+                    member, states, alive, failed, died = fn_idx(
+                        member, states, alive, failed,
+                        jnp.asarray(bar_idx_np), jnp.asarray(act_idx_np),
+                        jnp.asarray(nbars_np), jnp.asarray(nws_np),
+                        jnp.asarray(perm_np), jnp.asarray(present_np),
+                        jnp.asarray(k0s_np), *row_tables,
+                    )
+                else:
+                    member, states, alive, failed, died = fn(
+                        member, states, alive, failed,
+                        jnp.asarray(bars_np), jnp.asarray(tab_np),
+                        jnp.asarray(perm_np), jnp.asarray(present_np),
+                        jnp.asarray(k0s_np),
+                    )
+                # One sync per chunk (~32k barriers): early exit + time
+                # budget.  The sync ALSO belongs inside the try — jitted
+                # dispatch is asynchronous, so execution-time failures
+                # only raise when a result is consumed.
+                failed_now = bool(failed)
         except Exception:
             if pallas != "on":
                 raise

@@ -74,7 +74,7 @@ def subhistories(h: History) -> dict[Any, History]:
     pop = pending.pop
     for o in h:
         val = o.value
-        if type(val) is KV:
+        if isinstance(val, KV):
             k = val.key
             if o.is_invoke:
                 pending[o.process] = k

@@ -1,0 +1,314 @@
+"""Run-scoped telemetry: spans, counters, gauges, Chrome-trace export.
+
+The checker's north star is serving heavy traffic as fast as the
+hardware allows (ROADMAP.md); the prerequisite is knowing where time
+goes.  This module is the zero-dependency substrate: a process-wide,
+thread-safe registry of
+
+  * **spans** — `with span("wgl.block"):` timed sections, aggregated
+    per name (count / total / max) and appended to a bounded trace-event
+    buffer;
+  * **counters** — monotonically accumulated values
+    (`count("wgl.h2d_bytes", n)`);
+  * **gauges** — last/min/max samples (`gauge("wgl.beam", B)`).
+
+Everything is **off by default**: set ``JEPSEN_TELEMETRY=1`` (or call
+`enable()`) to record.  When disabled, `span()` returns a shared no-op
+context manager and `count`/`gauge` return immediately after one module
+bool check, so hot paths pay ~nothing — bench.py's throughput contract
+(< 2% regression with telemetry unset) is guarded by
+tests/test_telemetry.py.
+
+Two exporters, both written by `export(dir)`:
+
+  * ``telemetry.json`` — the `summary()` dict: per-span statistics,
+    counters, gauges.  `tools/trace_view.py` pretty-prints it.
+  * ``trace.json`` — Chrome trace-event format ("X" complete events,
+    microsecond timestamps), loadable in Perfetto (https://ui.perfetto.dev)
+    or chrome://tracing for a per-thread flame view of a run.
+
+Span names are dotted ``subsystem.phase`` (taxonomy in doc/design.md):
+``lifecycle.*`` (core.py run phases), ``interpreter.*`` (per-op worker
+dispatch), ``checker.<Name>`` (check_safe), ``wgl.*`` (device search:
+compile vs execute, witness tiers, stream), ``bench.*`` (bench.py
+phases).  The registry is process-wide on purpose — a run's worker
+threads, checker pools, and device callbacks all land in one trace.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Optional
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "JEPSEN_TELEMETRY"
+
+#: Trace-event buffer cap: a 1M-op run with per-op spans would otherwise
+#: grow without bound.  Aggregated span stats keep counting past the
+#: cap; only the per-event trace detail is dropped (and reported in
+#: `summary()["trace_events_dropped"]`).
+MAX_TRACE_EVENTS = 200_000
+
+_enabled = os.environ.get(ENV_VAR, "") not in ("", "0", "false", "no")
+_lock = threading.Lock()
+
+#: Wall-clock epoch (ns) matching the perf_counter origin below, so
+#: trace timestamps can be related to log lines.
+_T0_NS = time.perf_counter_ns()
+_T0_WALL = time.time()
+
+# name -> [count, total_ns, max_ns]
+_span_stats: dict[str, list] = {}
+_counters: dict[str, Any] = {}
+# name -> [last, min, max, n_samples]
+_gauges: dict[str, list] = {}
+# (name, t0_ns_rel, dur_ns, tid, thread_name, attrs-or-None)
+_events: list[tuple] = []
+_events_dropped = 0
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(on: bool = True) -> None:
+    """Programmatic override of JEPSEN_TELEMETRY (tests, embedding)."""
+    global _enabled
+    _enabled = bool(on)
+
+
+def reset() -> None:
+    """Clears every registry — the start of a run scope."""
+    global _events_dropped
+    with _lock:
+        _span_stats.clear()
+        _counters.clear()
+        _gauges.clear()
+        _events.clear()
+        _events_dropped = 0
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled path."""
+
+    __slots__ = ()
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        return False
+
+    def set(self, **attrs: Any) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("name", "attrs", "_t0")
+
+    def __init__(self, name: str, attrs: Optional[dict]):
+        self.name = name
+        self.attrs = attrs
+
+    def set(self, **attrs: Any) -> None:
+        """Attaches attributes mid-span (e.g. a result computed inside)."""
+        if self.attrs is None:
+            self.attrs = attrs
+        else:
+            self.attrs.update(attrs)
+
+    def __enter__(self) -> "Span":
+        self._t0 = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc: Any) -> bool:
+        global _events_dropped
+        t0 = self._t0
+        dur = time.perf_counter_ns() - t0
+        t = threading.current_thread()
+        with _lock:
+            st = _span_stats.get(self.name)
+            if st is None:
+                _span_stats[self.name] = [1, dur, dur]
+            else:
+                st[0] += 1
+                st[1] += dur
+                if dur > st[2]:
+                    st[2] = dur
+            if len(_events) < MAX_TRACE_EVENTS:
+                _events.append(
+                    (self.name, t0 - _T0_NS, dur, t.ident, t.name,
+                     self.attrs)
+                )
+            else:
+                _events_dropped += 1
+        return False
+
+
+def span(name: str, **attrs: Any) -> Any:
+    """Context manager timing a named section.  Disabled -> shared no-op.
+
+    Hot loops that would pay for building `attrs` should gate on
+    `enabled()` instead of relying on this check alone."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, attrs or None)
+
+
+def count(name: str, n: Any = 1) -> None:
+    """Adds `n` to a named counter (monotone accumulator)."""
+    if not _enabled:
+        return
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+
+
+def gauge(name: str, value: Any) -> None:
+    """Samples a named gauge, tracking last/min/max."""
+    if not _enabled:
+        return
+    with _lock:
+        g = _gauges.get(name)
+        if g is None:
+            _gauges[name] = [value, value, value, 1]
+        else:
+            g[0] = value
+            if value < g[1]:
+                g[1] = value
+            if value > g[2]:
+                g[2] = value
+            g[3] += 1
+
+
+def summary() -> dict:
+    """The aggregate view exported as telemetry.json."""
+    with _lock:
+        spans = {
+            name: {
+                "count": c,
+                "total_s": round(t / 1e9, 6),
+                "max_s": round(m / 1e9, 6),
+                "mean_s": round(t / c / 1e9, 6),
+            }
+            for name, (c, t, m) in _span_stats.items()
+        }
+        return {
+            "enabled": _enabled,
+            "recorded_at": time.strftime(
+                "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
+            ),
+            "spans": spans,
+            "counters": dict(_counters),
+            "gauges": {
+                name: {"last": g[0], "min": g[1], "max": g[2],
+                       "samples": g[3]}
+                for name, g in _gauges.items()
+            },
+            "trace_events": len(_events),
+            "trace_events_dropped": _events_dropped,
+        }
+
+
+def top_spans(n: int = 5) -> list[tuple[str, dict]]:
+    """The n spans with the largest total time, descending — the
+    run-summary 'where did the time go' line."""
+    s = summary()["spans"]
+    return sorted(
+        s.items(), key=lambda kv: kv[1]["total_s"], reverse=True
+    )[:n]
+
+
+def phases(prefix: str) -> dict[str, float]:
+    """{short-name: total_s} of every span under `prefix.` — bench.py
+    embeds phases("bench") in its JSON line."""
+    pre = prefix + "."
+    return {
+        name[len(pre):]: st["total_s"]
+        for name, st in summary()["spans"].items()
+        if name.startswith(pre)
+    }
+
+
+def chrome_trace() -> dict:
+    """The recorded spans as a Chrome trace-event dict ("X" complete
+    events, µs timestamps) — Perfetto / chrome://tracing loadable."""
+    with _lock:
+        events = list(_events)
+    pid = os.getpid()
+    out = []
+    tnames: dict[int, str] = {}
+    for name, t0_rel, dur, tid, tname, attrs in events:
+        ev: dict[str, Any] = {
+            "name": name,
+            "cat": name.split(".", 1)[0],
+            "ph": "X",
+            "ts": t0_rel / 1000.0,
+            "dur": dur / 1000.0,
+            "pid": pid,
+            "tid": tid,
+        }
+        if attrs:
+            ev["args"] = attrs
+        out.append(ev)
+        tnames[tid] = tname
+    for tid, tname in tnames.items():
+        out.append({
+            "name": "thread_name",
+            "ph": "M",
+            "pid": pid,
+            "tid": tid,
+            "args": {"name": tname},
+        })
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "jepsen_tpu.telemetry",
+            "t0_unix_s": _T0_WALL,
+        },
+    }
+
+
+def export(directory: str) -> Optional[tuple[str, str]]:
+    """Writes telemetry.json + trace.json into `directory`; returns the
+    two paths, or None when disabled or on a write failure (a side
+    output must never change a run's outcome)."""
+    if not _enabled:
+        return None
+    try:
+        os.makedirs(directory, exist_ok=True)
+        sum_path = os.path.join(directory, "telemetry.json")
+        trace_path = os.path.join(directory, "trace.json")
+        with open(sum_path, "w") as f:
+            json.dump(summary(), f, indent=2, sort_keys=True,
+                      default=repr)
+            f.write("\n")
+        with open(trace_path, "w") as f:
+            json.dump(chrome_trace(), f, default=repr)
+            f.write("\n")
+        return sum_path, trace_path
+    except OSError as e:
+        log.warning("telemetry export to %s failed: %r", directory, e)
+        return None
+
+
+def log_top_spans(logger: logging.Logger, n: int = 5) -> None:
+    """INFO-logs the top-n spans by total time (the run summary line)."""
+    if not _enabled:
+        return
+    tops = top_spans(n)
+    if not tops:
+        return
+    parts = [
+        f"{name} {st['total_s']:.3f}s x{st['count']}"
+        for name, st in tops
+    ]
+    logger.info("telemetry top spans: %s", "; ".join(parts))

@@ -87,6 +87,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._file(path[len("/files/"):])
             elif path.startswith("/zip/"):
                 self._zip(path[len("/zip/"):])
+            elif path.startswith("/telemetry/"):
+                self._telemetry(path[len("/telemetry/"):])
             else:
                 self._send(404, _page("404", "<p>not found</p>"))
         except BrokenPipeError:
@@ -102,20 +104,83 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 v = _validity(d)
                 rel = os.path.relpath(d, self.store_dir)
                 q = urllib.parse.quote(rel)
+                tel = (
+                    f"<a href='/telemetry/{q}'>telemetry</a>"
+                    if os.path.isfile(os.path.join(d, "telemetry.json"))
+                    else ""
+                )
                 rows.append(
                     f"<tr><td><a href='/files/{q}/'>"
                     f"{html.escape(name)}</a></td>"
                     f"<td>{html.escape(t)}</td>"
                     f"<td class='valid-{html.escape(v.lower())}'>{html.escape(v)}</td>"
+                    f"<td>{tel}</td>"
                     f"<td><a href='/zip/{q}'>zip</a></td></tr>"
                 )
         body = (
             "<table><tr><th>test</th><th>time</th><th>valid?</th>"
-            "<th></th></tr>"
+            "<th></th><th></th></tr>"
             + "".join(rows)
             + "</table>"
         )
         self._send(200, _page("jepsen-tpu store", body))
+
+    def _telemetry(self, rel: str) -> None:
+        """Renders a run's telemetry.json (written by a
+        JEPSEN_TELEMETRY=1 run — see jepsen_tpu/telemetry) as a
+        spans-by-total-time table with counters and gauges, linking
+        the raw JSON and the Perfetto-loadable trace.json."""
+        root = os.path.realpath(self.store_dir)
+        run_dir = os.path.realpath(os.path.join(root, rel.strip("/")))
+        tpath = os.path.join(run_dir, "telemetry.json")
+        if not (run_dir.startswith(root + os.sep)
+                and os.path.isfile(tpath)):
+            self._send(404, _page("404", "<p>no telemetry for this run"
+                                         "</p>"))
+            return
+        try:
+            with open(tpath) as f:
+                summ = json.load(f)
+        except (OSError, ValueError) as e:
+            self._send(500, _page("error",
+                                  f"<pre>{html.escape(repr(e))}</pre>"))
+            return
+        spans = sorted(
+            (summ.get("spans") or {}).items(),
+            key=lambda kv: kv[1].get("total_s", 0), reverse=True,
+        )
+        rows = "".join(
+            f"<tr><td>{html.escape(name)}</td>"
+            f"<td>{st.get('count')}</td>"
+            f"<td>{st.get('total_s')}</td>"
+            f"<td>{st.get('mean_s')}</td>"
+            f"<td>{st.get('max_s')}</td></tr>"
+            for name, st in spans
+        )
+        extras = []
+        for title, d in (("counters", summ.get("counters") or {}),
+                         ("gauges", summ.get("gauges") or {})):
+            if d:
+                items = "".join(
+                    f"<tr><td>{html.escape(str(k))}</td>"
+                    f"<td>{html.escape(json.dumps(v))}</td></tr>"
+                    for k, v in sorted(d.items())
+                )
+                extras.append(f"<h2>{title}</h2><table>{items}</table>")
+        q = urllib.parse.quote(rel.strip("/"))
+        links = (
+            f"<p><a href='/files/{q}/telemetry.json'>telemetry.json"
+            f"</a> · <a href='/files/{q}/trace.json'>trace.json</a> "
+            f"(load in <a href='https://ui.perfetto.dev'>Perfetto</a>)"
+            f"</p>"
+        )
+        body = (
+            links
+            + "<h2>spans</h2><table><tr><th>span</th><th>count</th>"
+              "<th>total s</th><th>mean s</th><th>max s</th></tr>"
+            + rows + "</table>" + "".join(extras)
+        )
+        self._send(200, _page(f"telemetry: {rel}", body))
 
     def _zip(self, rel: str) -> None:
         """Streams a test dir as a zip (web.clj's zip download).  Built

@@ -42,6 +42,14 @@ def test_bench_emits_one_json_line_cpu():
     assert rec["vs_baseline"] > 0
     assert rec["platform"] == "cpu"
     assert "error" not in rec
+    # Telemetry phases breakdown rides the same line and must not
+    # break its single-line parseability (it just did: json.loads
+    # above) or depend on JEPSEN_TELEMETRY being set.
+    phases = rec["phases"]
+    assert set(phases) >= {"generate", "pack", "warmup", "check"}
+    assert all(isinstance(v, (int, float)) and v >= 0
+               for v in phases.values())
+    assert phases["check"] > 0
     # Second headline metric (VERDICT r4 #4) rides the SAME line.
     scale = rec["scale"]
     assert scale["metric"] == "scale_ops_to_verdict"

@@ -306,3 +306,43 @@ def test_os_noop_setup():
     with with_sessions(test):
         oses.setup(test)
         oses.teardown(test)
+
+
+# -- grepkill (control/util.clj grepkill!) ------------------------------
+
+
+class _RecordingSession:
+    def __init__(self):
+        self.calls = []
+
+    def exec_star(self, *argv):
+        self.calls.append(argv)
+        return {"exit": 0}
+
+
+def test_grepkill_bracket_wraps_literal_leading_char():
+    sess = _RecordingSession()
+    cutil.grepkill(sess, "kvdb", signal=9)
+    cmd = sess.calls[0][-1]
+    # The bracket trick: matches a running kvdb but not the ssh/bash
+    # chain carrying this very pattern as an argument.
+    assert "[k]vdb" in cmd
+    assert "pkill -9 -f" in cmd
+
+
+def test_grepkill_empty_pattern_is_noop():
+    sess = _RecordingSession()
+    cutil.grepkill(sess, "")
+    assert sess.calls == []
+
+
+@pytest.mark.parametrize("pattern", ["^leader", "]x", "\\d+", ".hidden",
+                                     "[abc]d", "*glob"])
+def test_grepkill_rejects_metachar_leading_patterns(pattern):
+    # Wrapping a leading metacharacter in brackets builds a DIFFERENT
+    # ERE ('[^...]' negates; '[.' opens a collating symbol) that can
+    # SIGKILL unrelated processes: reject loudly instead.
+    sess = _RecordingSession()
+    with pytest.raises(ValueError):
+        cutil.grepkill(sess, pattern)
+    assert sess.calls == []

@@ -76,6 +76,27 @@ class TestSubhistories:
         )
         assert subhistories(h) == {}
 
+    def test_kv_subclass_values_are_split(self):
+        # The hot-loop dispatch must use isinstance, not exact type:
+        # an external workload wrapping KV must not have its keys
+        # silently vanish from per-key checking (a soundness hole —
+        # unchecked ops read as linearizable).
+        class TaggedKV(KV):
+            pass
+
+        h = history(
+            [
+                Op(type="invoke", f="write", value=TaggedKV("x", 1),
+                   process=0),
+                Op(type="ok", f="write", value=TaggedKV("x", 1),
+                   process=0),
+            ]
+        )
+        subs = subhistories(h)
+        assert set(subs) == {"x"}
+        assert [o.value for o in subs["x"]] == [1, 1]
+        assert history_keys(h) == ["x"]
+
 
 def _reg_history(seed: int, n_ops: int, procs: int = 4, bad: bool = False):
     """A random cas-register history from a simulated register, with some

@@ -1,0 +1,283 @@
+"""Telemetry subsystem tests: registry semantics, the disabled-mode
+no-op contract, Chrome-trace export validity, per-checker spans, and
+the whole-lifecycle integration (a dummy-ssh run must surface spans
+from lifecycle, interpreter, checker, AND wgl in one telemetry.json).
+"""
+
+import json
+import os
+import threading
+
+import pytest
+
+from jepsen_tpu import telemetry
+
+
+@pytest.fixture(autouse=True)
+def _telemetry_scope():
+    """Each test starts enabled with a clean registry and leaves the
+    module in its environment-derived default state."""
+    prior = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.enable(prior)
+
+
+# ---------------------------------------------------------------- registry
+
+
+def test_span_aggregates_count_total_max():
+    for _ in range(3):
+        with telemetry.span("x.y"):
+            pass
+    st = telemetry.summary()["spans"]["x.y"]
+    assert st["count"] == 3
+    assert st["total_s"] >= 0
+    assert st["max_s"] <= st["total_s"]
+    # summary() rounds each figure to 1 µs independently.
+    assert st["mean_s"] == pytest.approx(st["total_s"] / 3, abs=2e-6)
+
+
+def test_span_nesting_records_both_levels():
+    with telemetry.span("outer"):
+        with telemetry.span("inner"):
+            pass
+    spans = telemetry.summary()["spans"]
+    assert spans["outer"]["count"] == 1
+    assert spans["inner"]["count"] == 1
+    # The outer span's duration covers the inner one.
+    assert spans["outer"]["total_s"] >= spans["inner"]["total_s"]
+
+
+def test_span_records_on_exception():
+    with pytest.raises(RuntimeError):
+        with telemetry.span("boom"):
+            raise RuntimeError("x")
+    assert telemetry.summary()["spans"]["boom"]["count"] == 1
+
+
+def test_spans_from_many_threads_all_land():
+    N, REPS = 8, 50
+    # All workers alive at once: OS thread ids are reused after join,
+    # so per-thread trace attribution is only distinguishable while
+    # the threads coexist.
+    barrier = threading.Barrier(N)
+
+    def work():
+        barrier.wait()
+        for _ in range(REPS):
+            with telemetry.span("t.work"):
+                pass
+            telemetry.count("t.n")
+
+    threads = [threading.Thread(target=work) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    s = telemetry.summary()
+    assert s["spans"]["t.work"]["count"] == N * REPS
+    assert s["counters"]["t.n"] == N * REPS
+    # The trace keeps per-thread attribution.
+    trace = telemetry.chrome_trace()
+    tids = {e["tid"] for e in trace["traceEvents"] if e["ph"] == "X"}
+    assert len(tids) == N
+
+
+def test_counters_and_gauges():
+    telemetry.count("c", 3)
+    telemetry.count("c", 4)
+    telemetry.gauge("g", 10)
+    telemetry.gauge("g", 2)
+    telemetry.gauge("g", 7)
+    s = telemetry.summary()
+    assert s["counters"]["c"] == 7
+    assert s["gauges"]["g"] == {"last": 7, "min": 2, "max": 10,
+                                "samples": 3}
+
+
+def test_top_spans_and_phases():
+    with telemetry.span("p.slow"):
+        for _ in range(10000):
+            pass
+    with telemetry.span("p.fast"):
+        pass
+    tops = telemetry.top_spans(1)
+    assert tops[0][0] == "p.slow"
+    ph = telemetry.phases("p")
+    assert set(ph) == {"slow", "fast"}
+    assert ph["slow"] >= ph["fast"]
+
+
+def test_event_buffer_cap_drops_events_not_stats(monkeypatch):
+    monkeypatch.setattr(telemetry, "MAX_TRACE_EVENTS", 5)
+    for _ in range(8):
+        with telemetry.span("capped"):
+            pass
+    s = telemetry.summary()
+    assert s["spans"]["capped"]["count"] == 8  # aggregates keep counting
+    assert s["trace_events"] == 5
+    assert s["trace_events_dropped"] == 3
+
+
+# ------------------------------------------------------------ disabled mode
+
+
+def test_disabled_span_is_shared_noop_and_records_nothing():
+    telemetry.enable(False)
+    s1 = telemetry.span("a")
+    s2 = telemetry.span("b", attr=1)
+    assert s1 is s2  # one shared no-op object: zero allocation per call
+    with s1:
+        pass
+    telemetry.count("c")
+    telemetry.gauge("g", 1)
+    telemetry.enable(True)
+    s = telemetry.summary()
+    assert s["spans"] == {} and s["counters"] == {} and s["gauges"] == {}
+
+
+def test_enabled_flag_reflects_enable_calls():
+    assert telemetry.enabled() is True
+    telemetry.enable(False)
+    assert telemetry.enabled() is False
+
+
+# ----------------------------------------------------------------- exporters
+
+
+def test_export_writes_valid_summary_and_chrome_trace(tmp_path):
+    with telemetry.span("e.one", k="v"):
+        pass
+    telemetry.count("e.n", 2)
+    paths = telemetry.export(str(tmp_path))
+    assert paths is not None
+    sum_path, trace_path = paths
+    summ = json.loads(open(sum_path).read())
+    assert summ["spans"]["e.one"]["count"] == 1
+    assert summ["counters"]["e.n"] == 2
+
+    trace = json.loads(open(trace_path).read())
+    assert trace["displayTimeUnit"] == "ms"
+    evs = trace["traceEvents"]
+    xs = [e for e in evs if e["ph"] == "X"]
+    assert len(xs) == 1
+    e = xs[0]
+    # Chrome trace-event contract: complete events carry name/ts/dur
+    # (µs floats) and pid/tid; attrs land in args.
+    assert e["name"] == "e.one" and e["cat"] == "e"
+    assert isinstance(e["ts"], float) and isinstance(e["dur"], float)
+    assert e["args"] == {"k": "v"}
+    metas = [e for e in evs if e["ph"] == "M"]
+    assert metas and all(m["name"] == "thread_name" for m in metas)
+
+
+def test_export_disabled_returns_none(tmp_path):
+    telemetry.enable(False)
+    assert telemetry.export(str(tmp_path)) is None
+    assert not os.path.exists(tmp_path / "telemetry.json")
+
+
+def test_export_survives_unwritable_dir():
+    with telemetry.span("x"):
+        pass
+    assert telemetry.export("/proc/nonexistent/nope") is None
+
+
+# ------------------------------------------------------------ checker spans
+
+
+def test_check_safe_produces_per_checker_spans():
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu.checker.core import check_safe
+    from jepsen_tpu.history import History, Op
+
+    h = History([
+        Op(index=0, type="invoke", process=0, f="read", value=None),
+        Op(index=1, type="ok", process=0, f="read", value=None),
+    ], reindex=False)
+    composed = chk.compose({"stats": chk.Stats(),
+                            "noop": chk.NoOp()})
+    res = check_safe(composed, {}, h, {})
+    assert res["valid"] is True
+    spans = telemetry.summary()["spans"]
+    assert "checker.Compose" in spans
+    assert "checker.Stats" in spans  # sub-checkers span via check_safe
+
+
+# ---------------------------------------------------------------- lifecycle
+
+
+def test_dummy_run_exports_spans_from_four_subsystems(tmp_path):
+    """Acceptance: one JEPSEN_TELEMETRY=1 dummy-ssh run writes
+    telemetry.json + trace.json containing lifecycle, interpreter,
+    checker, AND wgl spans (the device-algorithm checker drives the
+    witness tier even on CPU)."""
+    from test_core import register_test
+
+    from jepsen_tpu import checker as chk, core, store
+    from jepsen_tpu.checker.linearizable import linearizable
+
+    t = register_test(tmp_path, checker=chk.compose({
+        "stats": chk.Stats(),
+        "linear": linearizable(algorithm="wgl-tpu"),
+    }))
+    res = core.run(t)
+    assert res["results"]["valid"] is True
+
+    d = store.test_dir(res)
+    summ = json.loads(open(os.path.join(d, "telemetry.json")).read())
+    subsystems = {name.split(".", 1)[0] for name in summ["spans"]}
+    assert {"lifecycle", "interpreter", "checker", "wgl"} <= subsystems
+    assert summ["counters"]["interpreter.ops-journaled"] > 0
+
+    trace = json.loads(open(os.path.join(d, "trace.json")).read())
+    assert any(e["ph"] == "X" for e in trace["traceEvents"])
+
+
+def test_run_without_telemetry_writes_no_files(tmp_path):
+    from test_core import register_test
+
+    from jepsen_tpu import core, store
+
+    telemetry.enable(False)
+    t = register_test(tmp_path)
+    res = core.run(t)
+    d = store.test_dir(res)
+    assert not os.path.exists(os.path.join(d, "telemetry.json"))
+    assert not os.path.exists(os.path.join(d, "trace.json"))
+
+
+# --------------------------------------------------------------- trace_view
+
+
+def test_trace_view_prints_top_spans(tmp_path, capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import trace_view
+
+    with telemetry.span("v.big"):
+        for _ in range(10000):
+            pass
+    with telemetry.span("v.small"):
+        pass
+    telemetry.count("v.n", 9)
+    telemetry.export(str(tmp_path))
+    rc = trace_view.main([str(tmp_path / "telemetry.json")])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "v.big" in out and "v.small" in out and "v.n = 9" in out
+    # Sorted by total time: the big span prints first.
+    assert out.index("v.big") < out.index("v.small")
+
+
+def test_trace_view_missing_file_errors(capsys):
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import trace_view
+
+    assert trace_view.main(["/nonexistent/telemetry.json"]) == 1

@@ -8,13 +8,14 @@ reports None must be settled by the exact engines, never trusted.
 import numpy as np
 import pytest
 
-from jepsen_tpu.history.packed import ST_OK, pack_history
+from jepsen_tpu.history.packed import ST_OK, PackedOps, pack_history
 from jepsen_tpu.models import cas_register, fifo_queue, register
 from jepsen_tpu.ops.wgl_stream import (
     F_RESET,
     check_wgl_witness_stream,
     concat_packs,
     stream_model,
+    stream_timeline_len,
 )
 from jepsen_tpu.utils.histgen import random_register_history
 
@@ -182,3 +183,62 @@ def test_independent_checker_uses_stream():
     assert r_ok["valid"] is True
     assert r_ok["algorithm"] == "wgl-tpu-stream"
     assert res["results"]["k13"]["valid"] is False
+
+
+def _pack_at_offset(offset, n_pairs=2):
+    """A tiny valid pack whose event indices start at `offset` —
+    crafts the timeline directly (pack_history always starts at 0)."""
+    from jepsen_tpu.history import invoke, ok
+
+    pm = cas_register().packed()
+    fc, a0c, a1c = pm.encode(invoke("write", 1), ok("write", 1))
+    n = n_pairs
+    inv = offset + 2 * np.arange(n, dtype=np.int64)
+    ret = inv + 1
+    return PackedOps(
+        inv=inv, ret=ret,
+        process=np.zeros(n, dtype=np.int32),
+        status=np.full(n, ST_OK, dtype=np.int32),
+        f=np.full(n, fc, dtype=np.int32),
+        a0=np.full(n, a0c, dtype=np.int32),
+        a1=np.full(n, a1c, dtype=np.int32),
+        src_index=np.arange(n, dtype=np.int64),
+        preds=np.zeros(n, dtype=np.int64),
+        horizon=np.full(n, n - 1, dtype=np.int64),
+    ), pm
+
+
+def test_stream_timeline_len_matches_concat():
+    packs, _ = _packs(4, n_ops=50)
+    total = stream_timeline_len(packs)
+    combined, _, _ = concat_packs(packs)
+    assert int(combined.inv.max()) < total
+    assert int(combined.ret[combined.status == ST_OK].max()) < total
+
+
+def test_stream_past_int32_falls_back_to_per_key():
+    # ADVICE r5 #4: concatenated timelines grow with TOTAL ops across
+    # keys; past int32 the witness engine's .astype(np.int32) would
+    # silently wrap and corrupt barrier order.  The stream tier must
+    # bail to per-key checking (all-None verdicts), not crash or
+    # mis-verdict.
+    big, pm = _pack_at_offset(2**31 - 1)
+    small, _ = _pack_at_offset(0)
+    verdicts = check_wgl_witness_stream([small, big], pm)
+    assert verdicts == [None, None]
+
+
+def test_plan_blocks_raises_past_int32():
+    from jepsen_tpu.ops.wgl_witness import _plan_blocks
+
+    big, _ = _pack_at_offset(2**31 - 1)
+    with pytest.raises(OverflowError):
+        _plan_blocks(big, 1024)
+
+
+def test_witness_returns_none_past_int32():
+    # The single-history entry point escalates instead of crashing.
+    from jepsen_tpu.ops.wgl_witness import check_wgl_witness
+
+    big, pm = _pack_at_offset(2**31 - 1)
+    assert check_wgl_witness(big, pm) is None

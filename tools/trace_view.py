@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Print the top spans of a run's telemetry.json.
+
+A JEPSEN_TELEMETRY=1 run (jepsen_tpu/telemetry) writes two files to
+its store dir: trace.json (load in https://ui.perfetto.dev for the
+flame view) and telemetry.json (aggregate span/counter/gauge summary).
+This tool is the terminal view of the latter — "where did the time
+go" without leaving the shell:
+
+    python tools/trace_view.py store/<test>/<t>/telemetry.json
+    python tools/trace_view.py -n 20 store/latest/telemetry.json
+
+Spans print sorted by total time, with counters and gauges after.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def format_summary(summ: dict, n: int) -> str:
+    lines = []
+    spans = sorted(
+        (summ.get("spans") or {}).items(),
+        key=lambda kv: kv[1].get("total_s", 0),
+        reverse=True,
+    )
+    if spans:
+        name_w = max(len(name) for name, _ in spans[:n])
+        lines.append(
+            f"{'span':<{name_w}}  {'count':>9}  {'total s':>10}  "
+            f"{'mean s':>10}  {'max s':>10}"
+        )
+        for name, st in spans[:n]:
+            lines.append(
+                f"{name:<{name_w}}  {st.get('count', 0):>9}  "
+                f"{st.get('total_s', 0):>10.3f}  "
+                f"{st.get('mean_s', 0):>10.6f}  "
+                f"{st.get('max_s', 0):>10.6f}"
+            )
+        if len(spans) > n:
+            lines.append(f"... {len(spans) - n} more spans")
+    else:
+        lines.append("no spans recorded")
+    counters = summ.get("counters") or {}
+    if counters:
+        lines.append("")
+        lines.append("counters:")
+        for k, v in sorted(counters.items()):
+            lines.append(f"  {k} = {v}")
+    gauges = summ.get("gauges") or {}
+    if gauges:
+        lines.append("")
+        lines.append("gauges:")
+        for k, g in sorted(gauges.items()):
+            lines.append(
+                f"  {k} = {g.get('last')} "
+                f"(min {g.get('min')}, max {g.get('max')}, "
+                f"{g.get('samples')} samples)"
+            )
+    dropped = summ.get("trace_events_dropped", 0)
+    if dropped:
+        lines.append("")
+        lines.append(
+            f"note: {dropped} trace events dropped past the buffer cap "
+            f"(aggregates above still count them)"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="print the top spans of a telemetry.json"
+    )
+    ap.add_argument("path", help="path to a telemetry.json")
+    ap.add_argument("-n", type=int, default=10,
+                    help="spans to show (default 10)")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            summ = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    print(format_summary(summ, args.n))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
