@@ -243,6 +243,13 @@ def run_bench() -> int:
             telemetry.export(os.environ.get(
                 "JEPSEN_TELEMETRY_DIR", os.path.join("store", "bench")
             ))
+        # Degradation/retry/timeout counters ride next to the phase
+        # wall-clocks: a run that only stays fast by falling down the
+        # WGL ladder is a regression, and it must show in the same JSON
+        # line the perf trajectory reads.  Requires JEPSEN_TELEMETRY=1
+        # (counters are off otherwise); omitted when empty so the
+        # steady-state line doesn't grow a noise field.
+        resilience = telemetry.resilience_counters()
         emit(
             ops_per_s,
             ops_per_s / baseline_floor,
@@ -250,6 +257,7 @@ def run_bench() -> int:
             elapsed_s=round(elapsed, 3),
             n_ops=packed.n,
             phases=phases,
+            **({"resilience": resilience} if resilience else {}),
             # Multi-rep evidence (VERDICT r4 #8): the rep count and
             # min/max spread retire the single-rep ±30% caveat — a
             # last-good record with reps>=3 is a median, not a mood.
@@ -322,6 +330,13 @@ def run_scale() -> int:
             "budget_s": budget,
             "platform": platform,
         }
+        from jepsen_tpu import telemetry
+
+        resilience = telemetry.resilience_counters()
+        if resilience:
+            # Same contract as run_bench: a scale point reached only by
+            # degrading down the WGL ladder is flagged in its own line.
+            rec["resilience"] = resilience
         if res.valid is True:
             rate = packed.n / dt
             rec["ops_per_s"] = round(rate)

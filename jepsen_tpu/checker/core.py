@@ -75,16 +75,54 @@ def checker_name(c: Any) -> str:
     return n if isinstance(n, str) and n else type(c).__name__
 
 
-def check_safe(c: Checker, test: dict, history: History, opts: Optional[dict] = None) -> dict:
+#: Sentinel distinguishing "budget expired" from any checker result.
+_BUDGET_BLOWN = object()
+
+
+def check_safe(
+    c: Checker,
+    test: dict,
+    history: History,
+    opts: Optional[dict] = None,
+    *,
+    budget_s: Optional[float] = None,
+) -> dict:
     """Like Checker.check, but exceptions become {"valid": "unknown"}
-    results instead of propagating (checker.clj:79-90).  Each call is a
+    results instead of propagating (checker.clj:79-90), and an optional
+    wall-clock budget turns a *hanging* checker into the same verdict: the
+    check runs in a watchdog thread (utils.timeout) and is abandoned when
+    `budget_s` — or, by default, `test["checker_budget"]` (seconds) —
+    expires.  Checkers that supervise their own children (Compose) are
+    exempt: their children each get the budget instead, so a single hung
+    child can't swallow its siblings' partial results.  Each call is a
     `checker.<Name>` telemetry span, so composed checkers get per-child
     timing for free."""
-    try:
+    if budget_s is None:
+        budget_s = (test or {}).get("checker_budget")
+    if budget_s is not None and getattr(c, "supervises_children", False):
+        budget_s = None
+
+    def go() -> dict:
         if telemetry.enabled():
             with telemetry.span(f"checker.{checker_name(c)}"):
                 return c.check(test, history, opts or {})
         return c.check(test, history, opts or {})
+
+    try:
+        if budget_s is None:
+            return go()
+        from ..utils import timeout as _timeout
+
+        res = _timeout(budget_s * 1000.0, go, default=_BUDGET_BLOWN)
+        if res is _BUDGET_BLOWN:
+            telemetry.count("checker.budget-exceeded")
+            return {
+                "valid": UNKNOWN,
+                "error": f"checker {checker_name(c)} exceeded its "
+                         f"{budget_s} s wall-clock budget "
+                         f"(checker_budget); thread abandoned",
+            }
+        return res
     except Exception as e:  # noqa: BLE001
         import traceback
 
@@ -97,7 +135,18 @@ def check_safe(c: Checker, test: dict, history: History, opts: Optional[dict] = 
 
 class Compose(Checker):
     """Runs named sub-checkers in parallel and merges their validity
-    (checker.clj:92-104)."""
+    (checker.clj:92-104).  Every child goes through check_safe, so a
+    crashing child — and, when the test sets a `checker_budget`, a
+    hanging one — degrades to its own {"valid": "unknown"} entry while
+    the other children's results are still reported and merged.  Without
+    a budget a hung child hangs the compose (slow and hung are
+    indistinguishable without a clock)."""
+
+    #: check_safe must not wrap the compose itself in the budget: the
+    #: children each get it, and an outer budget of the same size would
+    #: expire exactly when a hung child does — discarding the siblings'
+    #: partial results.
+    supervises_children = True
 
     def __init__(self, checkers: dict[str, Checker]):
         self.checkers = dict(checkers)

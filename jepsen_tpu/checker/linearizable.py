@@ -51,6 +51,18 @@ class Linearizable(Checker):
         self.max_configs = max_configs
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
+        from ..ops import degrade
+
+        # Capture every degradation-ladder step taken on this thread
+        # while checking, so the report shows not just which tier
+        # produced the verdict ("algorithm") but the path taken to it.
+        with degrade.capture() as steps:
+            out = self._check(test, history, opts)
+        if steps:
+            out["degradations"] = steps
+        return out
+
+    def _check(self, test: dict, history: History, opts: dict) -> dict:
         model = self.model or test.get("model")
         if model is None:
             raise ValueError("linearizable checker needs a model")
@@ -114,16 +126,17 @@ class Linearizable(Checker):
             )
 
         # Device-first paths.
+        from ..ops import degrade
         from ..ops.wgl import check_wgl_device
 
-        try:
-            res = check_wgl_device(
+        def _device(beam: int, max_beam: int, block: int, budget):
+            return check_wgl_device(
                 packed,
                 pm,
-                beam=self.beam,
-                max_beam=self.max_beam,
-                block=self.block,
-                time_limit_s=budget_left,
+                beam=beam,
+                max_beam=max_beam,
+                block=block,
+                time_limit_s=budget,
                 # "search-mesh" shards this ONE search's BFS frontier
                 # across devices (the within-search axis).  It is a
                 # distinct key from "mesh", which already means the
@@ -137,15 +150,51 @@ class Linearizable(Checker):
                 # instead of restarting.
                 checkpoint_dir=(opts or {}).get("dir"),
             )
-        except RuntimeError as e:
-            # No usable accelerator (backend init failure): the CPU
-            # search still settles the verdict rather than letting
-            # check-safe degrade it to unknown.
-            if "backend" not in str(e).lower():
+
+        def _budget_now():
+            if self.time_limit_s is None:
+                return None
+            return max(1.0, self.time_limit_s - (_time.monotonic() - t_start))
+
+        try:
+            res = _device(self.beam, self.max_beam, self.block, budget_left)
+        except Exception as e:
+            if degrade.is_resource_error(e):
+                # Safety net above the tiers' own ladders (a resource
+                # error can surface outside their guarded call sites,
+                # e.g. in a host-side table build): retry the whole
+                # device search once at half size, then settle the
+                # verdict on the exact CPU engine.
+                degrade.record("dispatch", "retry-halved", e)
+                try:
+                    res = _device(
+                        max(self.beam // 2, 64),
+                        max(self.max_beam // 2, 64),
+                        max(self.block // 2, 32),
+                        _budget_now(),
+                    )
+                except Exception as e2:  # noqa: BLE001
+                    if not degrade.is_resource_error(e2):
+                        raise
+                    degrade.record("dispatch", "fall-through", e2)
+                    res, engine = self._cpu_exact(
+                        packed, pm, time_limit_s=_budget_now()
+                        if self.time_limit_s is not None
+                        else DEFAULT_SETTLE_BUDGET_S,
+                    )
+                    return self._render(
+                        res, packed, f"{engine}-degraded", model, pm,
+                        opts=opts,
+                    )
+            elif isinstance(e, RuntimeError) and "backend" in str(e).lower():
+                # No usable accelerator (backend init failure): the CPU
+                # search still settles the verdict rather than letting
+                # check-safe degrade it to unknown.
+                res, engine = self._cpu_exact(packed, pm)
+                return self._render(res, packed, f"{engine}-nobackend",
+                                    model, pm, opts=opts)
+            else:
                 raise
-            res, engine = self._cpu_exact(packed, pm)
-            return self._render(res, packed, f"{engine}-nobackend", model,
-                                pm, opts=opts)
         used = "wgl-tpu"
         if res.valid is False and not res.final_configs:
             # The device BFS settles the verdict but carries no

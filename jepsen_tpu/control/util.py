@@ -13,7 +13,7 @@ import logging
 import os.path
 import shlex
 import time
-from typing import Any, Optional, Sequence
+from typing import Any, Callable, Optional, Sequence
 
 from ..utils import await_fn
 from . import Session
@@ -78,6 +78,50 @@ def await_tcp_port(
         timeout_ms=timeout_s * 1000,
         retry_interval_ms=interval_s * 1000,
         log_message=f"waiting for {host}:{port} on {sess.node}",
+    )
+
+
+def retrying_daemon_start(
+    sess: Session,
+    start: "Callable[[], Any]",
+    port: int,
+    *,
+    host: str = "localhost",
+    tries: int = 3,
+    await_timeout_s: float = 10.0,
+    interval_s: float = 0.1,
+    backoff_ms: float = 200.0,
+) -> None:
+    """Starts a daemon and waits for its TCP port, retrying the whole
+    start+probe cycle with exponential backoff (utils.with_retry) when
+    the bind is slow or the daemon died during startup.  A freshly
+    rebooted node, a port still in TIME_WAIT from the previous cycle, or
+    a daemon that needs a moment to recover its log must not fail the
+    run on the first probe — db.cycle would otherwise tear the whole DB
+    down and rebuild it for what one more start attempt fixes.  `start`
+    must be idempotent (start_daemon is: a live pidfile makes it a
+    no-op)."""
+    from ..utils import JepsenTimeout, with_retry
+
+    def attempt() -> None:
+        start()
+        await_tcp_port(
+            sess, port, host=host,
+            timeout_s=await_timeout_s, interval_s=interval_s,
+        )
+
+    def note(msg: str) -> None:
+        from .. import telemetry
+
+        telemetry.count("daemon.start-retries")
+        log.warning("daemon start on %s port %s: %s", sess.node, port, msg)
+
+    with_retry(
+        attempt,
+        retries=max(tries - 1, 0),
+        backoff_ms=backoff_ms,
+        retry_on=(JepsenTimeout, NonzeroExit, RuntimeError),
+        log=note,
     )
 
 
@@ -240,8 +284,13 @@ def grepkill(sess: "Session", pattern: str,
             f"self-match-avoiding bracket wrap would change the regex"
         )
     safe = f"[{c}]{pattern[1:]}"
-    sess.exec_star(
-        "bash", "-c",
-        f"pkill -{signal} -f -- {shlex.quote(safe)} || true",
-    )
+    # Elevate: leaked daemons from an interrupted run may be root-owned
+    # (suites started under sudo), and an unprivileged pkill would skip
+    # them while `|| true` swallowed the permission failure — preserving
+    # exactly the stale-server hazard this call exists to remove.
+    with sess.su():
+        sess.exec_star(
+            "bash", "-c",
+            f"pkill -{signal} -f -- {shlex.quote(safe)} || true",
+        )
 

@@ -137,7 +137,14 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
         except ValueError:
             pass
     with telemetry.span("lifecycle.analyze"):
-        return check_safe(checker, test, history, opts)
+        results = check_safe(checker, test, history, opts)
+    # Surface robustness events (op timeouts, blown checker budgets,
+    # degradation-ladder steps) next to the verdicts they shaped, so a
+    # report reader can tell a clean "valid" from a degraded one.
+    res_counters = telemetry.resilience_counters()
+    if res_counters and isinstance(results, dict):
+        results.setdefault("resilience", res_counters)
+    return results
 
 
 def log_results(results: dict) -> None:

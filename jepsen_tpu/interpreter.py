@@ -41,7 +41,7 @@ from .generator import (
 )
 from .history import FAIL, INFO, INVOKE, NEMESIS, History, Op
 from .nemesis import Nemesis
-from .utils import relative_time_nanos, with_relative_time
+from .utils import Deadline, relative_time_nanos, with_relative_time
 
 log = logging.getLogger(__name__)
 
@@ -73,6 +73,17 @@ class Worker:
         # in flight.
         self.in_queue: "queue.SimpleQueue[Any]" = queue.SimpleQueue()
         self.completions = completions
+        # Watchdog protocol (run()'s per-op deadlines).  `supervised` is
+        # set by run() only when the test carries op/drain timeouts, so
+        # the unsupervised per-op path costs one attribute check.  The
+        # lock makes "push the completion" and "mark abandoned" mutually
+        # exclusive: either the push lands (and `pushes` records it) or
+        # the abandoned worker stays silent forever — the scheduler never
+        # sees a completion for an op it already timed out.
+        self.supervised = False
+        self.abandoned = False
+        self.pushes = 0
+        self.lock = threading.Lock()
         self.thread = threading.Thread(
             target=self._run, name=f"jepsen-worker-{id}", daemon=True
         )
@@ -116,7 +127,18 @@ class Worker:
                 completion = op.complete(
                     INFO, error=f"{type(e).__name__}: {e}"
                 )
-            self.completions.put(completion)
+            if not self.supervised:
+                self.completions.put(completion)
+            else:
+                with self.lock:
+                    if self.abandoned:
+                        # The scheduler already completed this op as a
+                        # timeout and replaced us; a late completion now
+                        # would double-count.  Exit silently.
+                        self._cleanup()
+                        return
+                    self.pushes += 1
+                    self.completions.put(completion)
 
     def transact(self, op: Op) -> Op:
         raise NotImplementedError
@@ -225,12 +247,23 @@ def run(
     ctx = Context.for_test(test)
     gen = validate(friendly_exceptions(test["generator"]))
 
+    # Supervision knobs (ISSUE: fault-tolerant run supervision).
+    # op_timeout: seconds a single client/nemesis op may run before the
+    # scheduler completes it as indeterminate :info, abandons the stuck
+    # worker thread, and rotates in a fresh worker under the same id.
+    # drain_timeout: global deadline on the end-of-run drain, so a hung
+    # straggler can't keep the run from producing a savable history.
+    op_timeout: Optional[float] = test.get("op_timeout")
+    drain_timeout: Optional[float] = test.get("drain_timeout", op_timeout)
+    supervised = op_timeout is not None or drain_timeout is not None
+
     completions: "queue.SimpleQueue[Op]" = queue.SimpleQueue()
     workers: dict[Any, Worker] = {
         thread: spawn_worker(test, completions, thread)
         for thread in ctx.all_threads()
     }
     for w in workers.values():
+        w.supervised = supervised
         w.start()
 
     ops: list[Op] = []
@@ -243,6 +276,22 @@ def run(
     op_index = 0
     outstanding = 0
     poll_timeout = 0.0  # seconds; 0 = don't block
+
+    #: thread -> (invocation, monotonic deadline, worker pushes at submit).
+    #: Populated only when supervised; the unsupervised hot path touches
+    #: it behind a single None/bool check.
+    in_flight: dict[Any, tuple[Op, float, int]] = {}
+    drain_deadline: Optional[Deadline] = None
+
+    def abandon(thread: Any, pushes0: int) -> bool:
+        """Marks a worker abandoned unless its completion already landed
+        in the queue; returns True when we own the op's completion."""
+        w = workers[thread]
+        with w.lock:
+            if w.pushes > pushes0:
+                return False  # real completion racing in; let it flow
+            w.abandoned = True
+        return True
 
     with with_relative_time():
         try:
@@ -259,6 +308,8 @@ def run(
                 if completion is not None:
                     now = relative_time_nanos()
                     thread = ctx.process_to_thread(completion.process)
+                    if supervised:
+                        in_flight.pop(thread, None)
                     journal = _journal(completion)
                     if journal:
                         completion = completion.replace(
@@ -277,6 +328,42 @@ def run(
                     poll_timeout = 0.0
                     continue
 
+                if in_flight:
+                    # Watchdog: any in-flight op past its deadline is
+                    # completed here as indeterminate :info, its stuck
+                    # worker abandoned, and a fresh worker rotated in
+                    # under the same id (the process rotation below
+                    # makes the replacement open a fresh client).
+                    now_mono = time_mod.monotonic()
+                    for thread, (op, dl, pushes0) in list(in_flight.items()):
+                        if now_mono < dl:
+                            continue
+                        del in_flight[thread]
+                        if not abandon(thread, pushes0):
+                            continue
+                        log.warning(
+                            "op timeout: worker %s stuck in %r for > %g s; "
+                            "abandoning thread and rotating process",
+                            thread, op.f, op_timeout,
+                        )
+                        telemetry.count("interpreter.op-timeouts")
+                        now = relative_time_nanos()
+                        timed_out = op.complete(
+                            INFO,
+                            error=f"op timed out after {op_timeout} s",
+                        ).replace(index=op_index, time=now)
+                        op_index += 1
+                        ctx = ctx.free_thread(now, thread)
+                        gen = gen_update(gen, test, ctx, timed_out)
+                        if thread != NEMESIS:
+                            ctx = ctx.with_next_process(thread)
+                        record(timed_out)
+                        outstanding -= 1
+                        nw = spawn_worker(test, completions, thread)
+                        nw.supervised = True
+                        nw.start()
+                        workers[thread] = nw
+
                 now = relative_time_nanos()
                 ctx = ctx.with_time(now)
                 res = gen_op(gen, test, ctx)
@@ -285,6 +372,38 @@ def run(
                     if outstanding > 0:
                         # Generator exhausted but ops are in flight: block
                         # for their completions (interpreter.clj:266-273).
+                        if supervised:
+                            if drain_deadline is None:
+                                drain_deadline = Deadline(drain_timeout)
+                            elif drain_deadline.expired() and in_flight:
+                                # Drain deadline blown: mark every
+                                # straggler indeterminate so the run still
+                                # ends with a complete, savable history.
+                                now = relative_time_nanos()
+                                for thread, (op, _dl, pushes0) in list(
+                                    in_flight.items()
+                                ):
+                                    del in_flight[thread]
+                                    if not abandon(thread, pushes0):
+                                        continue
+                                    log.warning(
+                                        "drain timeout: worker %s never "
+                                        "completed %r; marking "
+                                        "indeterminate", thread, op.f,
+                                    )
+                                    telemetry.count(
+                                        "interpreter.drain-timeouts"
+                                    )
+                                    straggler = op.complete(
+                                        INFO,
+                                        error="indeterminate: drain "
+                                        f"deadline ({drain_timeout} s) "
+                                        "expired",
+                                    ).replace(index=op_index, time=now)
+                                    op_index += 1
+                                    ctx = ctx.free_thread(now, thread)
+                                    record(straggler)
+                                    outstanding -= 1
                         poll_timeout = MAX_PENDING_INTERVAL
                         continue
                     break
@@ -314,6 +433,17 @@ def run(
                 gen = gen_update(gen2, test, ctx, op)
                 thread = ctx.process_to_thread(op.process)
                 ctx = ctx.busy_thread(now, thread)
+                if supervised and _journal(op):
+                    # sleep/log ops run in-worker, are bounded by
+                    # construction, and never journal — exempt.
+                    w = workers[thread]
+                    in_flight[thread] = (
+                        op,
+                        time_mod.monotonic() + op_timeout
+                        if op_timeout is not None
+                        else float("inf"),
+                        w.pushes,
+                    )
                 workers[thread].submit(op)
                 outstanding += 1
                 poll_timeout = 0.0
@@ -321,7 +451,10 @@ def run(
             for w in workers.values():
                 w.exit()
             for w in workers.values():
-                w.join(timeout=10.0)
+                # An abandoned worker is wedged inside its op and will
+                # only see the exit pill if that op ever returns; don't
+                # burn 10 s per straggler on a daemon thread.
+                w.join(timeout=0.1 if w.abandoned else 10.0)
 
     telemetry.count("interpreter.ops-journaled", op_index)
     telemetry.gauge("interpreter.workers", len(workers))

@@ -43,6 +43,7 @@ from .. import telemetry
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
+from . import degrade
 
 INF = np.int32(2**31 - 1)
 
@@ -490,6 +491,7 @@ def check_wgl_device(
     alive = None   # device (B,) bool
     explored_total = 0
     soft_incomplete = False  # gave up on exactness somewhere
+    device_retried = False   # one halved-beam retry on resource errors
 
     while n0 < N:
         win = _window_tables(packed, n0, block, max_window)
@@ -576,11 +578,46 @@ def check_wgl_device(
                 )
             else:
                 sp = telemetry.span("")  # shared no-op
-            with sp:
-                out = fn(member, states, alive, jnp.int32(iters), *targs)
-                member, states, alive, accepted, incomplete, explored, it_done = out
-                accepted_b = bool(accepted)
-                incomplete_b = bool(incomplete)
+            try:
+                degrade.maybe_fault("device")
+                # The bool() syncs stay inside the try: jitted dispatch
+                # is async, so execution failures raise at consumption.
+                with sp:
+                    out = fn(member, states, alive, jnp.int32(iters), *targs)
+                    member, states, alive, accepted, incomplete, explored, it_done = out
+                    accepted_b = bool(accepted)
+                    incomplete_b = bool(incomplete)
+            except Exception as e:  # noqa: BLE001
+                if not degrade.is_resource_error(e):
+                    raise
+                # Degradation ladder: the device (not the search) gave
+                # out.  Evict the compiled block fn, retry ONCE with a
+                # halved beam from the block snapshot, then settle for
+                # "unknown" — the dispatcher's CPU settle takes over.
+                _block_fn_cache.pop(key, None)
+                if device_retried or B <= 64:
+                    degrade.record("device", "fall-through", e)
+                    return WGLResult(
+                        valid="unknown",
+                        configs_explored=explored_total,
+                        reason="device-resource-error",
+                        elapsed_s=time.monotonic() - t0,
+                    )
+                device_retried = True
+                degrade.record("device", "retry-halved", e)
+                B //= 2
+                m0, s0, a0_ = snap
+                # Frontier rows are packed alive-first; truncating live
+                # rows beyond the new beam forfeits exactness, which
+                # soft_incomplete degrades to "unknown" (never a false
+                # conviction).
+                if bool(a0_[B:].any()):
+                    soft_incomplete = True
+                member = m0[:B]
+                states = s0[:B]
+                alive = a0_[:B]
+                snap = (member, states, alive)
+                continue
             if telemetry.enabled():
                 telemetry.count("wgl.bfs.rounds", int(it_done))
 

@@ -33,6 +33,7 @@ import numpy as np
 
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
+from . import degrade
 
 INF = np.int32(2**31 - 1)
 
@@ -286,6 +287,7 @@ def check_wgl_batched(
     explored = np.zeros(K, dtype=np.int64)
     todo = list(range(K))
     B = _bucket(beam, lo=32)
+    batch_retried = False  # one halved-beam retry on resource errors
 
     while todo:
         if mesh is not None:
@@ -294,20 +296,45 @@ def check_wgl_batched(
             pad_t = len(todo)
         sel = np.asarray(todo + [todo[0]] * (pad_t - len(todo)))
         fn = _get_kernel(B, bp.N, SW, cand_factor * B, pm.jax_step, mesh)
-        acc, alive_end, inc, expl = fn(
-            jnp.asarray(bp.ret[sel]),
-            jnp.asarray(bp.inv[sel]),
-            jnp.asarray(bp.f[sel]),
-            jnp.asarray(bp.a0[sel]),
-            jnp.asarray(bp.a1[sel]),
-            jnp.asarray(bp.okv[sel]),
-            jnp.asarray(init_state),
-            jnp.asarray(bp.n_ops[sel]),
-        )
-        acc = np.asarray(acc)
-        alive_end = np.asarray(alive_end)
-        inc = np.asarray(inc)
-        expl = np.asarray(expl)
+        try:
+            degrade.maybe_fault("batched")
+            acc, alive_end, inc, expl = fn(
+                jnp.asarray(bp.ret[sel]),
+                jnp.asarray(bp.inv[sel]),
+                jnp.asarray(bp.f[sel]),
+                jnp.asarray(bp.a0[sel]),
+                jnp.asarray(bp.a1[sel]),
+                jnp.asarray(bp.okv[sel]),
+                jnp.asarray(init_state),
+                jnp.asarray(bp.n_ops[sel]),
+            )
+            # The host transfers stay inside the try: jitted dispatch is
+            # asynchronous, so execution failures raise at consumption.
+            acc = np.asarray(acc)
+            alive_end = np.asarray(alive_end)
+            inc = np.asarray(inc)
+            expl = np.asarray(expl)
+        except Exception as e:  # noqa: BLE001
+            if not degrade.is_resource_error(e):
+                raise
+            # Degradation ladder: evict the compiled kernel, retry ONCE
+            # with a halved beam (and cap the overflow ladder there so
+            # it can't climb back into the OOM region); a second
+            # failure hands every unsettled key to the CPU settle.
+            _kernel_cache.pop(
+                (B, bp.N, SW, cand_factor * B, pm.jax_step, mesh), None
+            )
+            if batch_retried or B <= 32:
+                degrade.record("batched", "fall-through", e)
+                for k in todo:
+                    verdict[k] = "unknown"
+                todo = []
+                continue
+            batch_retried = True
+            degrade.record("batched", "retry-halved", e)
+            B //= 2
+            max_beam = min(max_beam, B)
+            continue
 
         retry = []
         for i, k in enumerate(todo):

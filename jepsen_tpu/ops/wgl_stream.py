@@ -54,6 +54,7 @@ import numpy as np
 from .. import telemetry
 from ..history.packed import NO_RET, ST_OK, PackedOps
 from ..models.base import PackedModel
+from . import degrade
 from .wgl_witness import INF, check_wgl_witness
 
 #: Synthetic f-code for the inter-key reset barrier.  Far above any
@@ -280,13 +281,30 @@ def check_wgl_witness_stream(
                     break
             combined, override, key_of_bar = concat_packs(packs[start:])
             info: dict = {}
-            r = check_wgl_witness(
-                combined, spm,
-                rank_override=override,
-                out_info=info,
-                time_limit_s=remaining,
-                **witness_kw,
-            )
+            try:
+                degrade.maybe_fault("stream")
+                r = check_wgl_witness(
+                    combined, spm,
+                    rank_override=override,
+                    out_info=info,
+                    time_limit_s=remaining,
+                    **witness_kw,
+                )
+            except Exception as e:  # noqa: BLE001
+                if not degrade.is_resource_error(e):
+                    raise
+                # Degradation ladder: the witness call already retries
+                # halved internally, so a resource error surfacing here
+                # means the concatenated stream itself is too big —
+                # leave the remaining keys None and fall through to the
+                # per-key tiers (batched BFS / CPU settle).
+                degrade.record("stream", "fall-through", e)
+                log.warning(
+                    "stream witness exhausted device resources; "
+                    "falling through to per-key tiers for %d keys",
+                    K - start, exc_info=True,
+                )
+                break
             if r is not None and r.valid is True:
                 for k in range(start, K):
                     verdicts[k] = True

@@ -69,6 +69,7 @@ from .. import telemetry
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
+from . import degrade
 from .wgl import _bucket, window_regather
 
 INF = np.int32(2**31 - 1)
@@ -932,6 +933,7 @@ def check_wgl_witness(
     transfer: str = "auto",
     rank_override: Optional[np.ndarray] = None,
     out_info: Optional[dict] = None,
+    _degraded: bool = False,
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
 
@@ -1123,6 +1125,47 @@ def check_wgl_witness(
             pallas="off", compact=compact,
             checkpoint_dir=checkpoint_dir, transfer=transfer,
             rank_override=rank_override, out_info=out_info,
+            _degraded=_degraded,
+        )
+
+    def _retry_smaller(e: BaseException):
+        """Degradation-ladder fallback for device resource exhaustion
+        (XLA RESOURCE_EXHAUSTED / compile failure / injected fault):
+        retry ONCE with a halved block plan — the chunk call's working
+        set scales with bars_per_block × blocks_per_call — then
+        escalate (return None) so the caller falls through to the next
+        tier.  Mirrors _retry_on_scan's budget deduction; keep every
+        caller-visible kwarg reproduced here too."""
+        import logging
+
+        if _degraded or bars_per_block <= 64:
+            degrade.record("witness", "fall-through", e)
+            logging.getLogger(__name__).warning(
+                "witness tier out of device resources even after "
+                "halving; escalating to the next tier", exc_info=True,
+            )
+            return None
+        degrade.record("witness", "retry-halved", e)
+        logging.getLogger(__name__).warning(
+            "witness chunk call exhausted device resources; retrying "
+            "once at bars_per_block=%d", bars_per_block // 2,
+            exc_info=True,
+        )
+        if time_limit_s is not None:
+            remaining = time_limit_s - (time.monotonic() - t0)
+            if remaining <= 0:
+                return None
+        else:
+            remaining = None
+        return check_wgl_witness(
+            packed, pm, beam=beam, bars_per_block=bars_per_block // 2,
+            blocks_per_call=max(blocks_per_call // 2, 1), depth=depth,
+            info_window=info_window, max_window=max_window,
+            width_hint=width_hint, time_limit_s=remaining,
+            pallas=pallas, compact=compact,
+            checkpoint_dir=checkpoint_dir, transfer=transfer,
+            rank_override=rank_override, out_info=out_info,
+            _degraded=True,
         )
 
     # The step fn itself keys the cache (strong ref): an id() key
@@ -1336,6 +1379,7 @@ def check_wgl_witness(
             sp = telemetry.span("")  # shared no-op
         fresh_fn = False
         try:
+            degrade.maybe_fault("witness")
             # The span covers dispatch AND the bool(failed) sync, so
             # its duration is real device time, not async enqueue.
             with sp:
@@ -1366,17 +1410,24 @@ def check_wgl_witness(
                 # dispatch is asynchronous, so execution-time failures
                 # only raise when a result is consumed.
                 failed_now = bool(failed)
-        except Exception:
-            if pallas != "on":
-                raise
-            # A Mosaic compile or transient runtime failure on the
-            # tunneled chip must not cost the verdict: evict the
-            # kernel (transient — the next check may succeed, unlike
-            # the deterministic build-failure negative cache above)
-            # and restart this search on the XLA-scan sweep.
-            _chunk_fn_cache.pop(key, None)
-            _chunk_dev_cache.pop((key, dev_slice), None)
-            return _retry_on_scan("pallas sweep failed")
+        except Exception as e:
+            if pallas == "on":
+                # A Mosaic compile or transient runtime failure on the
+                # tunneled chip must not cost the verdict: evict the
+                # kernel (transient — the next check may succeed, unlike
+                # the deterministic build-failure negative cache above)
+                # and restart this search on the XLA-scan sweep.
+                _chunk_fn_cache.pop(key, None)
+                _chunk_dev_cache.pop((key, dev_slice), None)
+                return _retry_on_scan("pallas sweep failed")
+            if degrade.is_resource_error(e):
+                # The device (not the search) gave out: degradation
+                # ladder — evict the possibly-huge compiled entry, retry
+                # once halved, then escalate to the next tier.
+                _chunk_fn_cache.pop(key, None)
+                _chunk_dev_cache.pop((key, dev_slice), None)
+                return _retry_smaller(e)
+            raise
         if failed_now:
             _ckpt_remove(ckpt_path)  # concluded: a resume can't help
             if out_info is not None:

@@ -114,29 +114,38 @@ class IndependentChecker(Checker):
         if not keys:
             return {"valid": True, "results": {}, "key-count": 0}
 
+        from ..ops import degrade
+
         results: dict[Any, dict]
-        if isinstance(self.base, Linearizable):
-            results = self._check_linearizable(test, subs, opts)
-        else:
-            rs = bounded_pmap(
-                lambda k: check_safe(
-                    self.base, test, subs[k], {**opts, "history_key": k}
-                ),
-                keys,
-                bound=self.bound,
-            )
-            results = dict(zip(keys, rs))
+        # The capture collects degradation-ladder steps taken by the
+        # shared tiers (stream witness / batched BFS) that run on this
+        # thread, outside any single key's Linearizable.check.
+        with degrade.capture() as steps:
+            if isinstance(self.base, Linearizable):
+                results = self._check_linearizable(test, subs, opts)
+            else:
+                rs = bounded_pmap(
+                    lambda k: check_safe(
+                        self.base, test, subs[k], {**opts, "history_key": k}
+                    ),
+                    keys,
+                    bound=self.bound,
+                )
+                results = dict(zip(keys, rs))
 
         valid = merge_valid(r.get("valid") for r in results.values())
         failures = [k for k, r in results.items() if r.get("valid") is False]
         self._write_key_artifacts(opts, subs, results)
-        return {
+        out = {
             "valid": valid,
             "key-count": len(keys),
             "failures": failures[:32],
             "failure-count": len(failures),
             "results": results,
         }
+        if steps:
+            out["degradations"] = steps
+        return out
 
     #: Per-key artifact budget: failed keys always write; passing keys
     #: only up to this many (the reference writes every key's dir,

@@ -101,9 +101,12 @@ class KvdbDB(jdb.DB):
         # on our port serves foreign data -> false convictions
         # (grepkill! on setup, control/util.clj pattern).
         cutil.grepkill(sess, f"kvdb --port {node_port(test, node)} ")
-        self.start(test, sess, node)
-        cutil.await_tcp_port(
-            sess, node_port(test, node), timeout_s=30, interval_s=0.1
+        # Retry the start+probe cycle: a slow bind or a daemon that
+        # died on startup gets two more attempts before db.cycle pays
+        # for a full teardown+setup.
+        cutil.retrying_daemon_start(
+            sess, lambda: self.start(test, sess, node),
+            node_port(test, node), await_timeout_s=10, interval_s=0.1,
         )
 
     def start(self, test: dict, sess: Session, node: str) -> None:

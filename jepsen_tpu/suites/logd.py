@@ -87,9 +87,10 @@ class LogdDB(jdb.DB):
         # on our port serves foreign data -> false convictions
         # (grepkill! on setup, control/util.clj pattern).
         cutil.grepkill(sess, f"logd --port {node_port(test)} ")
-        self.start(test, sess, node)
-        cutil.await_tcp_port(
-            sess, node_port(test), timeout_s=30, interval_s=0.1
+        # Retry the start+probe cycle (see kvdb.py setup).
+        cutil.retrying_daemon_start(
+            sess, lambda: self.start(test, sess, node),
+            node_port(test), await_timeout_s=10, interval_s=0.1,
         )
 
     def start(self, test: dict, sess: Session, node: str) -> None:

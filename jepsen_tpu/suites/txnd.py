@@ -108,9 +108,10 @@ class TxndDB(jdb.DB):
         # on our port serves foreign data -> false convictions
         # (grepkill! on setup, control/util.clj pattern).
         cutil.grepkill(sess, f"txnd --port {node_port(test, node)} ")
-        self.start(test, sess, node)
-        cutil.await_tcp_port(
-            sess, node_port(test, node), timeout_s=30, interval_s=0.1
+        # Retry the start+probe cycle (see kvdb.py setup).
+        cutil.retrying_daemon_start(
+            sess, lambda: self.start(test, sess, node),
+            node_port(test, node), await_timeout_s=10, interval_s=0.1,
         )
 
     def start(self, test: dict, sess: Session, node: str) -> None:

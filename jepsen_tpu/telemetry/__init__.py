@@ -237,6 +237,32 @@ def phases(prefix: str) -> dict[str, float]:
     }
 
 
+#: Counter families recording robustness events: watchdog op timeouts,
+#: drain stragglers, blown checker budgets, device degradation-ladder
+#: steps, and daemon start retries.  One list so bench.py, the web
+#: /telemetry/ page, and core.py surface the same set.
+RESILIENCE_COUNTER_PREFIXES = (
+    "interpreter.op-timeouts",
+    "interpreter.drain-timeouts",
+    "checker.budget-exceeded",
+    "wgl.degrade.",
+    "daemon.start-retries",
+)
+
+
+def resilience_counters() -> dict[str, Any]:
+    """The subset of counters that record degradation/retry/timeout
+    events — the resilience trajectory a perf regression in robustness
+    shows up in (empty when telemetry is disabled or nothing fired)."""
+    with _lock:
+        items = dict(_counters)
+    return {
+        k: v
+        for k, v in sorted(items.items())
+        if any(k.startswith(p) for p in RESILIENCE_COUNTER_PREFIXES)
+    }
+
+
 def chrome_trace() -> dict:
     """The recorded spans as a Chrome trace-event dict ("X" complete
     events, µs timestamps) — Perfetto / chrome://tracing loadable."""

@@ -165,18 +165,84 @@ class RetryExhausted(Exception):
     pass
 
 
+class Deadline:
+    """A wall-clock budget that can be handed down a call tree, mirroring
+    the way the reference threads `timeout` budgets through `jepsen.util`.
+    `Deadline(None)` is unbounded: remaining() is inf and it never expires,
+    so callers can thread one object without branching on "is there a
+    budget at all?".
+
+        d = Deadline(30.0)
+        while not d.expired():
+            step(timeout_s=d.remaining())
+        d.check("drain")          # raises JepsenTimeout when expired
+        child = d.capped(5.0)     # sub-budget: min(parent left, 5 s)
+    """
+
+    __slots__ = ("seconds", "_t0")
+
+    def __init__(self, seconds: float | None):
+        self.seconds = seconds
+        self._t0 = _time.monotonic()
+
+    @classmethod
+    def never(cls) -> "Deadline":
+        return cls(None)
+
+    def elapsed(self) -> float:
+        return _time.monotonic() - self._t0
+
+    def remaining(self) -> float:
+        """Seconds left (may be negative once expired; inf if unbounded)."""
+        if self.seconds is None:
+            return float("inf")
+        return self.seconds - self.elapsed()
+
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "deadline") -> None:
+        """Raises JepsenTimeout when the budget is spent."""
+        if self.expired():
+            raise JepsenTimeout(
+                f"{what} exceeded {self.seconds:.3f} s budget"
+            )
+
+    def capped(self, seconds: float | None) -> "Deadline":
+        """A fresh sub-budget: at most `seconds`, never more than what's
+        left here.  Lets a stage grant children a slice of its own time."""
+        left = self.remaining()
+        if seconds is None:
+            return Deadline(None if left == float("inf") else max(left, 0.0))
+        if left == float("inf"):
+            return Deadline(seconds)
+        return Deadline(max(min(seconds, left), 0.0))
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging aid
+        if self.seconds is None:
+            return "Deadline(unbounded)"
+        return f"Deadline({self.remaining():.3f}s of {self.seconds:.3f}s left)"
+
+
 def with_retry(
     f: Callable[[], T],
     *,
     retries: int = 5,
     backoff_ms: float = 100.0,
+    max_backoff_ms: float = 30_000.0,
     jitter: float = 0.5,
     retry_on: tuple[type[BaseException], ...] = (Exception,),
+    deadline: Deadline | None = None,
     log: Callable[[str], None] | None = None,
 ) -> T:
-    """Calls f, retrying up to `retries` times with randomized backoff,
-    like `with-retry` (util.clj:487-527) and the SSH retry policy
-    (control/retry.clj:15-21: 5 retries, ~100 ms)."""
+    """Calls f, retrying up to `retries` times with exponential backoff +
+    jitter, like `with-retry` (util.clj:487-527) and the SSH retry policy
+    (control/retry.clj:15-21: 5 retries, ~100 ms base).  Only exceptions
+    matching `retry_on` are retried; anything else propagates at once.
+    Sleep for attempt k is `backoff_ms * 2^(k-1)`, capped at
+    `max_backoff_ms`, stretched by up to `jitter` fraction.  An optional
+    `deadline` bounds the whole loop: when the budget would be exceeded
+    the last exception propagates instead of sleeping."""
     attempt = 0
     while True:
         try:
@@ -185,9 +251,13 @@ def with_retry(
             attempt += 1
             if attempt > retries:
                 raise
+            pause = min(backoff_ms * (2 ** (attempt - 1)), max_backoff_ms)
+            pause *= 1 + jitter * random.random()
+            if deadline is not None and deadline.remaining() < pause / 1000.0:
+                raise
             if log:
                 log(f"retry {attempt}/{retries} after {type(e).__name__}: {e}")
-            _time.sleep(backoff_ms * (1 + jitter * random.random()) / 1000.0)
+            _time.sleep(pause / 1000.0)
 
 
 def await_fn(

@@ -158,7 +158,20 @@ class Handler(http.server.BaseHTTPRequestHandler):
             for name, st in spans
         )
         extras = []
-        for title, d in (("counters", summ.get("counters") or {}),
+        # Resilience counters (op timeouts, blown checker budgets,
+        # degradation-ladder steps) get their own table above the
+        # generic counters: a regression in robustness should be as
+        # visible on this page as one in throughput.
+        from . import telemetry
+
+        counters = summ.get("counters") or {}
+        resil = {
+            k: v for k, v in counters.items()
+            if any(k.startswith(p)
+                   for p in telemetry.RESILIENCE_COUNTER_PREFIXES)
+        }
+        for title, d in (("resilience", resil),
+                         ("counters", counters),
                          ("gauges", summ.get("gauges") or {})):
             if d:
                 items = "".join(

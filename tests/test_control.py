@@ -2,6 +2,7 @@
 fan-out, net command construction, db lifecycle
 (control_test.clj; SURVEY.md §4 dummy-remote strategy)."""
 
+import contextlib
 import os
 
 import pytest
@@ -312,11 +313,27 @@ def test_os_noop_setup():
 
 
 class _RecordingSession:
-    def __init__(self):
+    def __init__(self, no_sudo=False):
         self.calls = []
+        self.elevations = []  # self.sudo at each exec_star
+        self.sudo = None
+        self.no_sudo = no_sudo
+
+    @contextlib.contextmanager
+    def su(self, user="root"):
+        if self.no_sudo and user == "root":
+            yield self
+            return
+        old = self.sudo
+        self.sudo = user
+        try:
+            yield self
+        finally:
+            self.sudo = old
 
     def exec_star(self, *argv):
         self.calls.append(argv)
+        self.elevations.append(self.sudo)
         return {"exit": 0}
 
 
@@ -334,6 +351,42 @@ def test_grepkill_empty_pattern_is_noop():
     sess = _RecordingSession()
     cutil.grepkill(sess, "")
     assert sess.calls == []
+
+
+def test_grepkill_runs_elevated():
+    # Leaked daemons from an interrupted run may be root-owned (suites
+    # start them under sudo); an unprivileged pkill skips them and
+    # `|| true` swallows the permission failure.  grepkill must run
+    # under sess.su() — and restore the session's sudo state after.
+    sess = _RecordingSession()
+    cutil.grepkill(sess, "kvdb")
+    assert sess.elevations == ["root"]
+    assert sess.sudo is None  # su scope exited
+
+
+def test_grepkill_elevated_command_shape():
+    # Through a REAL Session the wrap chain must produce a sudo-wrapped
+    # command carrying the bracket-wrapped pattern to the transport.
+    seen = []
+
+    class _Remote:
+        def execute(self, action):
+            seen.append(action)
+            return {"exit": 0, "out": "", "err": ""}
+
+    sess = Session("n1", _Remote())
+    cutil.grepkill(sess, "kvdb", signal=9)
+    cmd = seen[0]["cmd"]
+    assert cmd.startswith("sudo -S -u root ")
+    assert "[k]vdb" in cmd
+    assert "pkill -9 -f" in cmd
+
+
+def test_grepkill_no_sudo_session_skips_elevation():
+    # no-sudo transports (already root) must not get a sudo wrapper.
+    sess = _RecordingSession(no_sudo=True)
+    cutil.grepkill(sess, "kvdb")
+    assert sess.elevations == [None]
 
 
 @pytest.mark.parametrize("pattern", ["^leader", "]x", "\\d+", ".hidden",

@@ -1,0 +1,501 @@
+"""Robustness-layer tests: Deadline/with_retry primitives, the
+interpreter's op watchdog + drain deadline, checker wall-clock budgets,
+Compose isolation of hung children, the WGL degradation ladder (driven
+by the JEPSEN_WGL_FAULT hook), and retrying daemon starts."""
+
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+from jepsen_tpu import client as jc
+from jepsen_tpu import generator as gen
+from jepsen_tpu import interpreter
+from jepsen_tpu import nemesis as nem
+from jepsen_tpu import telemetry
+from jepsen_tpu.checker import core as chk
+from jepsen_tpu.control import util as cutil
+from jepsen_tpu.history import INFO, OK, History
+from jepsen_tpu.ops import degrade
+from jepsen_tpu.utils import Deadline, JepsenTimeout, with_retry
+
+
+@pytest.fixture
+def telem():
+    """Counters on for the duration of one test, restored after."""
+    old = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.enable(old)
+
+
+# -- Deadline / with_retry primitives -----------------------------------
+
+
+def test_deadline_basics():
+    d = Deadline(0.05)
+    assert not d.expired()
+    assert 0.0 < d.remaining() <= 0.05
+    time.sleep(0.06)
+    assert d.expired()
+    with pytest.raises(JepsenTimeout):
+        d.check("drain")
+
+
+def test_deadline_unbounded_and_capped():
+    u = Deadline.never()
+    assert u.remaining() == float("inf")
+    assert not u.expired()
+    u.check()  # never raises
+    # capped: at most the cap, never more than what's left.
+    assert u.capped(3.0).seconds == 3.0
+    c = Deadline(10.0).capped(2.0)
+    assert c.seconds is not None and c.seconds <= 2.0
+    c2 = Deadline(0.001).capped(50.0)
+    assert c2.seconds <= 0.001
+
+
+def test_with_retry_backs_off_and_succeeds():
+    calls = []
+
+    def f():
+        calls.append(1)
+        if len(calls) < 3:
+            raise ValueError("flaky")
+        return "ok"
+
+    assert with_retry(f, retries=5, backoff_ms=1.0, jitter=0.0) == "ok"
+    assert len(calls) == 3
+
+
+def test_with_retry_exhausts_with_original_exception():
+    def bad():
+        raise ValueError("nope")
+
+    with pytest.raises(ValueError, match="nope"):
+        with_retry(bad, retries=2, backoff_ms=1.0)
+
+
+def test_with_retry_filters_exception_types():
+    calls = []
+
+    def wrong_type():
+        calls.append(1)
+        raise KeyError("x")
+
+    with pytest.raises(KeyError):
+        with_retry(wrong_type, retries=3, backoff_ms=1.0,
+                   retry_on=(ValueError,))
+    assert len(calls) == 1  # not retried: KeyError isn't retryable here
+
+
+def test_with_retry_respects_deadline():
+    calls = []
+
+    def f():
+        calls.append(1)
+        raise ValueError("x")
+
+    # The next pause (200 ms) would blow the 50 ms budget: raise instead
+    # of sleeping.
+    with pytest.raises(ValueError):
+        with_retry(f, retries=50, backoff_ms=200.0, jitter=0.0,
+                   deadline=Deadline(0.05))
+    assert len(calls) == 1
+
+
+# -- interpreter supervision --------------------------------------------
+
+
+class HangingClient(jc.Client):
+    """Hangs (until released) on every op whose value is "hang"."""
+
+    def __init__(self, release=None):
+        self.release = release if release is not None else threading.Event()
+
+    def open(self, test, node):
+        return HangingClient(self.release)
+
+    def invoke(self, test, op):
+        if op.value == "hang":
+            self.release.wait(30.0)
+        return op.complete(OK, value=1)
+
+
+def test_op_timeout_watchdog_rotates_worker(telem):
+    """A hung op is completed as indeterminate :info after op_timeout,
+    the stuck worker is abandoned, and a fresh worker under a rotated
+    process id runs the rest of the schedule."""
+    release = threading.Event()
+    g = gen.clients([
+        gen.once({"f": "w", "value": "hang"}),
+        gen.limit(3, gen.repeat({"f": "w", "value": 1})),
+    ])
+    test = {
+        "concurrency": 1,
+        "nodes": ["n1"],
+        "client": HangingClient(release),
+        "nemesis": nem.noop,
+        "generator": g,
+        "op_timeout": 0.3,
+    }
+    try:
+        h = interpreter.run(test)
+    finally:
+        release.set()  # let the abandoned daemon thread exit
+    infos = [o for o in h if o.is_info]
+    assert len(infos) == 1
+    assert "timed out" in (infos[0].error or "")
+    # Process rotation: the replacement worker carries process 1.
+    procs = sorted({o.process for o in h if o.is_invoke})
+    assert procs == [0, 1]
+    # The remaining 3 ops completed OK on the fresh worker.
+    assert sum(1 for o in h if o.type == OK) == 3
+    # Well-formed: every invocation has a completion.
+    for o in h:
+        if o.is_invoke:
+            assert h.completion(o) is not None
+    assert telemetry.resilience_counters()["interpreter.op-timeouts"] == 1
+
+
+def test_drain_deadline_marks_stragglers(telem):
+    """With no per-op timeout, a straggler hung past the end of the
+    generator is marked indeterminate once drain_timeout expires — the
+    run always ends with a complete, savable history."""
+    release = threading.Event()
+    g = gen.clients([
+        gen.once({"f": "w", "value": "hang"}),
+        gen.once({"f": "w", "value": 1}),
+    ])
+    test = {
+        "concurrency": 2,
+        "nodes": ["n1", "n2"],
+        "client": HangingClient(release),
+        "nemesis": nem.noop,
+        "generator": g,
+        "drain_timeout": 0.4,
+    }
+    try:
+        h = interpreter.run(test)
+    finally:
+        release.set()
+    infos = [o for o in h if o.is_info]
+    assert len(infos) == 1
+    assert "drain deadline" in (infos[0].error or "")
+    assert sum(1 for o in h if o.type == OK) == 1
+    for o in h:
+        if o.is_invoke:
+            assert h.completion(o) is not None
+    assert telemetry.resilience_counters()["interpreter.drain-timeouts"] == 1
+
+
+class CrashTwice(jc.Client):
+    def __init__(self, counter=None):
+        self.counter = counter if counter is not None else [0]
+
+    def open(self, test, node):
+        return CrashTwice(self.counter)
+
+    def invoke(self, test, op):
+        self.counter[0] += 1
+        if self.counter[0] % 2 == 0:
+            raise RuntimeError("boom")
+        return op.complete(OK, value=1)
+
+
+def test_crash_under_supervision_still_rotates():
+    """The supervised completion path (worker lock + push counter) must
+    not change crash semantics: exceptions still become :info ops and
+    rotate the process id."""
+    g = gen.clients(gen.limit(6, gen.repeat({"f": "w", "value": 0})))
+    test = {
+        "concurrency": 1,
+        "nodes": ["n1"],
+        "client": CrashTwice(),
+        "nemesis": nem.noop,
+        "generator": g,
+        "op_timeout": 30.0,  # supervision on; nothing should time out
+    }
+    h = interpreter.run(test)
+    assert len(h) == 12
+    infos = [o for o in h if o.is_info]
+    assert len(infos) == 3
+    for o in infos:
+        assert "boom" in (o.error or "")
+    # Crashes land on invocations 2, 4, 6; the last crash ends the run,
+    # so processes 0..2 invoke (3 exists but never gets an op).
+    procs = {o.process for o in h if o.is_invoke}
+    assert procs == {0, 1, 2}
+
+
+# -- checker budgets ----------------------------------------------------
+
+
+def test_check_safe_crash_includes_traceback():
+    def boom(test, history, opts):
+        raise ZeroDivisionError("bad math")
+
+    out = chk.check_safe(chk.checker(boom, name="boomer"), {}, History([]))
+    assert out["valid"] == "unknown"
+    assert "ZeroDivisionError" in out["error"]
+    assert "ZeroDivisionError" in out["traceback"]
+
+
+def test_checker_budget_blows_to_unknown(telem):
+    ev = threading.Event()
+
+    def sleeper(test, history, opts):
+        ev.wait(10.0)
+        return {"valid": True}
+
+    out = chk.check_safe(
+        chk.checker(sleeper, name="sleeper"),
+        {"checker_budget": 0.2}, History([]),
+    )
+    ev.set()
+    assert out["valid"] == "unknown"
+    assert "budget" in out["error"]
+    assert telemetry.resilience_counters()["checker.budget-exceeded"] == 1
+
+
+def test_checker_budget_unblown_returns_result():
+    out = chk.check_safe(
+        chk.checker(lambda t, h, o: {"valid": True, "n": 3}),
+        {"checker_budget": 30.0}, History([]),
+    )
+    assert out == {"valid": True, "n": 3}
+
+
+def test_compose_isolates_hung_child():
+    """A hung child degrades to its own unknown entry; siblings'
+    results are still reported and merged."""
+    ev = threading.Event()
+
+    def hang(test, history, opts):
+        ev.wait(10.0)
+        return {"valid": True}
+
+    c = chk.compose({
+        "hang": chk.checker(hang, name="hang"),
+        "quick": chk.checker(lambda t, h, o: {"valid": True, "n": 7},
+                             name="quick"),
+    })
+    out = chk.check_safe(c, {"checker_budget": 0.3}, History([]))
+    ev.set()
+    assert out["valid"] == "unknown"
+    assert out["hang"]["valid"] == "unknown"
+    assert out["quick"]["valid"] is True and out["quick"]["n"] == 7
+
+
+def test_compose_isolates_crashing_child():
+    def boom(test, history, opts):
+        raise RuntimeError("child crashed")
+
+    c = chk.compose({
+        "boom": chk.checker(boom, name="boom"),
+        "quick": chk.checker(lambda t, h, o: {"valid": True}, name="quick"),
+    })
+    out = chk.check_safe(c, {}, History([]))
+    assert out["valid"] == "unknown"
+    assert out["boom"]["valid"] == "unknown"
+    assert "child crashed" in out["boom"]["error"]
+    assert out["quick"]["valid"] is True
+
+
+# -- degradation ladder -------------------------------------------------
+
+
+def test_is_resource_error_classification():
+    assert degrade.is_resource_error(MemoryError())
+    assert degrade.is_resource_error(degrade.InjectedFault("x"))
+    assert degrade.is_resource_error(
+        RuntimeError("RESOURCE_EXHAUSTED: out of memory allocating")
+    )
+    assert not degrade.is_resource_error(ValueError("bad shape"))
+    assert not degrade.is_resource_error(KeyboardInterrupt())
+
+
+def test_maybe_fault_env(monkeypatch):
+    monkeypatch.setenv(degrade.FAULT_ENV, "witness,device")
+    with pytest.raises(degrade.InjectedFault):
+        degrade.maybe_fault("witness")
+    degrade.maybe_fault("batched")  # not named: no-op
+    monkeypatch.setenv(degrade.FAULT_ENV, "all")
+    with pytest.raises(degrade.InjectedFault):
+        degrade.maybe_fault("batched")
+    monkeypatch.delenv(degrade.FAULT_ENV)
+    degrade.maybe_fault("witness")  # hook disarmed
+
+
+def test_capture_nests_and_counts(telem):
+    with degrade.capture() as outer:
+        degrade.record("witness", "retry-halved", RuntimeError("oom"))
+        with degrade.capture() as inner:
+            degrade.record("device", "fall-through")
+    assert [e["tier"] for e in inner] == ["device"]
+    # Inner events replay into the outer capture on exit.
+    assert [e["tier"] for e in outer] == ["witness", "device"]
+    assert outer[0]["action"] == "retry-halved"
+    assert "oom" in outer[0]["error"]
+    rc = telemetry.resilience_counters()
+    assert rc["wgl.degrade.witness.retry-halved"] == 1
+    assert rc["wgl.degrade.device.fall-through"] == 1
+
+
+def _small_packed(n=200):
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.utils.histgen import random_register_packed
+
+    pm = cas_register().packed()
+    return random_register_packed(
+        n, procs=2, info_rate=0.0, seed=11, model=pm
+    ), pm
+
+
+def test_witness_fault_retries_then_falls_through(monkeypatch, telem):
+    from jepsen_tpu.ops.wgl_witness import check_wgl_witness
+
+    packed, pm = _small_packed()
+    monkeypatch.setenv(degrade.FAULT_ENV, "witness")
+    with degrade.capture() as steps:
+        res = check_wgl_witness(packed, pm)
+    # Fall-through means "escalate", never a verdict.
+    assert res is None
+    actions = [s["action"] for s in steps if s["tier"] == "witness"]
+    assert actions[0] == "retry-halved"
+    assert actions[-1] == "fall-through"
+
+
+def test_device_fault_degrades_to_unknown(monkeypatch, telem):
+    from jepsen_tpu.ops.wgl import check_wgl_device
+
+    packed, pm = _small_packed()
+    monkeypatch.setenv(degrade.FAULT_ENV, "device")
+    with degrade.capture() as steps:
+        res = check_wgl_device(packed, pm, witness=False)
+    # Resource exhaustion degrades invalid/undecided to unknown — never
+    # a false conviction — with the reason recorded for the dispatcher.
+    assert res.valid == "unknown"
+    assert res.reason == "device-resource-error"
+    assert any(
+        s["tier"] == "device" and s["action"] == "fall-through"
+        for s in steps
+    )
+
+
+@pytest.mark.slow
+def test_linearizable_settles_despite_all_faults(monkeypatch):
+    """End-to-end: with every WGL tier forced to fail, the checker still
+    reaches an exact verdict on the CPU engine and reports the
+    degradation path it took."""
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    h = random_register_history(300, procs=3, info_rate=0.02, seed=5)
+    monkeypatch.setenv(degrade.FAULT_ENV, "all")
+    c = linearizable(model=cas_register(), algorithm="wgl-tpu",
+                     time_limit_s=60.0)
+    out = c.check({}, h, {})
+    assert out["valid"] is True
+    assert out["algorithm"] == "wgl-tpu+cpu-fallback"
+    assert out.get("degradations"), "ladder steps must reach the report"
+    tiers = {s["tier"] for s in out["degradations"]}
+    assert "device" in tiers
+
+
+# -- retrying daemon start ----------------------------------------------
+
+
+class _FlakyPortSession:
+    """Port probe succeeds only once `start` has been called `need`
+    times — models a daemon that dies on its first launch."""
+
+    node = "n1"
+
+    def __init__(self, need=2):
+        self.need = need
+        self.starts = 0
+
+    def exec_star(self, *argv, **kw):
+        return {"exit": 0 if self.starts >= self.need else 1}
+
+
+def test_retrying_daemon_start_retries_until_port(telem):
+    sess = _FlakyPortSession(need=2)
+
+    def start():
+        sess.starts += 1
+
+    cutil.retrying_daemon_start(
+        sess, start, 1234,
+        await_timeout_s=0.2, interval_s=0.05, backoff_ms=1.0,
+    )
+    assert sess.starts == 2
+    assert telemetry.resilience_counters()["daemon.start-retries"] == 1
+
+
+def test_retrying_daemon_start_exhausts():
+    sess = _FlakyPortSession(need=99)
+    with pytest.raises(JepsenTimeout):
+        cutil.retrying_daemon_start(
+            sess, lambda: None, 1234, tries=2,
+            await_timeout_s=0.1, interval_s=0.05, backoff_ms=1.0,
+        )
+
+
+# -- fault matrix (tools/fault_matrix.py) -------------------------------
+
+
+def test_fault_matrix_hanging_client_cell(tmp_path):
+    """One full-lifecycle matrix cell in tier-1: the hanging-client run
+    terminates, saves its history, and records the watchdog's work.
+    (CI also runs the whole matrix via tools/fault_matrix.py.)"""
+    from fault_matrix import scenario_hanging_client
+
+    detail = scenario_hanging_client(str(tmp_path / "store"))
+    assert detail["op_timeouts"] >= 1
+    assert detail["ops"] > 0
+
+
+@pytest.mark.slow
+def test_fault_matrix_all_cells(tmp_path):
+    from fault_matrix import run_matrix
+
+    out = run_matrix()
+    assert set(out) == {"hanging-client", "hanging-checker",
+                        "crashing-checker", "wgl-fault"}
+    assert "device" in out["wgl-fault"]["degraded_tiers"]
+
+
+# -- surfacing ----------------------------------------------------------
+
+
+def test_resilience_counters_filter(telem):
+    telemetry.count("wgl.degrade.device.retry-halved")
+    telemetry.count("interpreter.op-timeouts", 2)
+    telemetry.count("wgl.h2d_bytes", 999)  # perf counter: not resilience
+    assert telemetry.resilience_counters() == {
+        "interpreter.op-timeouts": 2,
+        "wgl.degrade.device.retry-halved": 1,
+    }
+
+
+def test_analyze_attaches_resilience(telem, tmp_path):
+    from jepsen_tpu import core
+
+    telemetry.count("interpreter.op-timeouts")
+    test = {
+        "name": "resil",
+        "checker": chk.checker(lambda t, h, o: {"valid": True}),
+    }
+    out = core.analyze(test, History([]), dir=str(tmp_path))
+    assert out["valid"] is True
+    assert out["resilience"]["interpreter.op-timeouts"] == 1

@@ -1,0 +1,323 @@
+#!/usr/bin/env python
+"""Fault-matrix harness: the robustness layer end-to-end.
+
+Runs a dummy-remote register suite through the FULL lifecycle
+(core.run -> store -> analyze) under each injected failure —
+
+  hanging-client    an op that never returns; the op_timeout watchdog
+                    must complete it as :info and rotate the worker
+  hanging-checker   a compose child that sleeps forever; the
+                    checker_budget must degrade it to unknown while
+                    its siblings still report
+  crashing-checker  a compose child that raises; isolated the same way
+  wgl-fault         JEPSEN_WGL_FAULT=all forces every WGL tier to fail
+                    with synthetic RESOURCE_EXHAUSTED; the ladder must
+                    settle the verdict on the exact CPU engine and
+                    report the degradation path
+
+— asserting in every cell that the run TERMINATES within its deadline,
+the history is saved and re-loadable, and per-checker verdicts are
+present (with the degraded tier in metadata where the ladder ran).
+
+Usage: JAX_PLATFORMS=cpu python tools/fault_matrix.py
+
+`run_matrix()` / the individual `scenario_*` functions are importable,
+so a pytest test can exercise the same cells CI runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu import client as jc  # noqa: E402
+
+#: Per-scenario wall-clock ceiling: generous next to the knobs below
+#: (op_timeout <= 1 s, checker_budget <= 2 s), tight next to a hang.
+SCENARIO_DEADLINE_S = 120.0
+
+
+def _register_test(store_dir: str, **overrides) -> dict:
+    """A dummy-remote cas-register test map (tests/test_core.py's
+    factory, restated here so the tool is self-contained)."""
+    import random
+
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import net as jnet
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.models import cas_register
+
+    t = {
+        "name": "fault-matrix",
+        "nodes": ["n1", "n2", "n3"],
+        "concurrency": "2n",
+        "store-dir": store_dir,
+        "ssh": {"dummy?": True},
+        "net": jnet.noop,
+        "client": _AtomRegister(),
+        "model": cas_register(),
+        "generator": gen.time_limit(
+            0.4,
+            gen.clients(gen.stagger(0.005, gen.mix([
+                gen.FnGen(lambda: {"f": "read"}),
+                gen.FnGen(lambda: {"f": "write",
+                                   "value": random.randrange(5)}),
+            ]))),
+        ),
+        "checker": chk.compose({
+            "stats": chk.Stats(),
+            "linear": linearizable(algorithm="cpu"),
+        }),
+    }
+    t.update(overrides)
+    return t
+
+
+class _AtomRegister(jc.Client):
+    """In-memory linearizable register (shared-state client)."""
+
+    def __init__(self, state=None, lock=None):
+        self.state = state if state is not None else {"v": None}
+        self.lock = lock or threading.Lock()
+
+    def open(self, test, node):
+        return _AtomRegister(self.state, self.lock)
+
+    def invoke(self, test, op):
+        from jepsen_tpu.history import FAIL, OK
+
+        with self.lock:
+            if op.f == "write":
+                self.state["v"] = op.value
+                return op.complete(OK)
+            if op.f == "read":
+                return op.complete(OK, value=self.state["v"])
+            old, new = op.value
+            if self.state["v"] == old:
+                self.state["v"] = new
+                return op.complete(OK)
+            return op.complete(FAIL)
+
+
+class _HangingRegister(_AtomRegister):
+    """Hangs forever on ~every 10th write; released on teardown so the
+    abandoned daemon threads exit once the scenario is over."""
+
+    def __init__(self, state=None, lock=None, release=None, counter=None):
+        super().__init__(state, lock)
+        self.release = release if release is not None else threading.Event()
+        self.counter = counter if counter is not None else [0]
+
+    def open(self, test, node):
+        return _HangingRegister(
+            self.state, self.lock, self.release, self.counter
+        )
+
+    def invoke(self, test, op):
+        if op.f == "write":
+            with self.lock:
+                self.counter[0] += 1
+                hang = self.counter[0] % 10 == 0
+            if hang:
+                self.release.wait(SCENARIO_DEADLINE_S)
+        return super().invoke(test, op)
+
+
+def _run_with_deadline(test: dict) -> dict:
+    """core.run under the scenario deadline: a matrix cell that hangs
+    is itself a robustness failure and must be reported, not waited on."""
+    from jepsen_tpu import core
+    from jepsen_tpu.utils import JepsenTimeout, timeout
+
+    res = timeout(SCENARIO_DEADLINE_S * 1000.0, lambda: core.run(test))
+    if res is JepsenTimeout:
+        raise AssertionError(
+            f"run did not terminate within {SCENARIO_DEADLINE_S} s"
+        )
+    return res
+
+
+def _assert_history_saved(test: dict) -> None:
+    """The store dir must hold a re-loadable history + results."""
+    from jepsen_tpu import store
+
+    d = store.test_dir(test)
+    tf = store.load(d)
+    try:
+        n = sum(1 for _ in tf.iter_ops())
+        assert n == len(test["history"]), (
+            f"saved history has {n} ops, run produced "
+            f"{len(test['history'])}"
+        )
+        assert tf.results is not None and "valid" in tf.results
+    finally:
+        tf.close()
+
+
+def scenario_hanging_client(store_dir: str) -> dict:
+    client = _HangingRegister()
+    test = _register_test(
+        store_dir,
+        client=client,
+        op_timeout=0.5,
+        drain_timeout=2.0,
+    )
+    try:
+        test = _run_with_deadline(test)
+    finally:
+        client.release.set()
+    h = test["history"]
+    timed_out = [
+        o for o in h if o.is_info and "timed out" in (o.error or "")
+    ]
+    assert timed_out, "watchdog never fired on the hanging client"
+    for o in h:
+        if o.is_invoke:
+            assert h.completion(o) is not None, "unpaired invocation"
+    _assert_history_saved(test)
+    res = test["results"]
+    assert "stats" in res and "linear" in res
+    return {
+        "ops": len(h),
+        "op_timeouts": len(timed_out),
+        "valid": res["valid"],
+    }
+
+
+def scenario_hanging_checker(store_dir: str) -> dict:
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu.checker.linearizable import linearizable
+
+    ev = threading.Event()
+
+    def hang(test, history, opts):
+        ev.wait(SCENARIO_DEADLINE_S)
+        return {"valid": True}
+
+    test = _register_test(
+        store_dir,
+        checker=chk.compose({
+            "stats": chk.Stats(),
+            "linear": linearizable(algorithm="cpu"),
+            "hung": chk.checker(hang, name="hung"),
+        }),
+        checker_budget=2.0,
+    )
+    try:
+        test = _run_with_deadline(test)
+    finally:
+        ev.set()
+    res = test["results"]
+    assert res["hung"]["valid"] == "unknown"
+    assert "budget" in res["hung"]["error"]
+    # Siblings' partial results survive the hung child.
+    assert res["stats"]["valid"] is True
+    assert res["linear"]["valid"] is True
+    assert res["valid"] == "unknown"
+    _assert_history_saved(test)
+    return {"valid": res["valid"], "hung": res["hung"]["error"]}
+
+
+def scenario_crashing_checker(store_dir: str) -> dict:
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu.checker.linearizable import linearizable
+
+    def boom(test, history, opts):
+        raise RuntimeError("checker crashed")
+
+    test = _register_test(
+        store_dir,
+        checker=chk.compose({
+            "stats": chk.Stats(),
+            "linear": linearizable(algorithm="cpu"),
+            "crash": chk.checker(boom, name="crash"),
+        }),
+    )
+    test = _run_with_deadline(test)
+    res = test["results"]
+    assert res["crash"]["valid"] == "unknown"
+    assert "checker crashed" in res["crash"]["error"]
+    assert "traceback" in res["crash"]
+    assert res["stats"]["valid"] is True
+    assert res["linear"]["valid"] is True
+    _assert_history_saved(test)
+    return {"valid": res["valid"], "crash": res["crash"]["error"]}
+
+
+def scenario_wgl_fault(store_dir: str) -> dict:
+    from jepsen_tpu import checker as chk
+    from jepsen_tpu.checker.linearizable import linearizable
+    from jepsen_tpu.ops import degrade
+
+    test = _register_test(
+        store_dir,
+        checker=chk.compose({
+            "stats": chk.Stats(),
+            "linear": linearizable(algorithm="wgl-tpu", time_limit_s=60.0),
+        }),
+    )
+    old = os.environ.get(degrade.FAULT_ENV)
+    os.environ[degrade.FAULT_ENV] = "all"
+    try:
+        test = _run_with_deadline(test)
+    finally:
+        if old is None:
+            os.environ.pop(degrade.FAULT_ENV, None)
+        else:
+            os.environ[degrade.FAULT_ENV] = old
+    res = test["results"]
+    lin = res["linear"]
+    # Every device tier failed; the exact CPU engine settled the verdict
+    # and the ladder's path made it into the metadata.
+    assert lin["valid"] is True, lin
+    assert lin.get("degradations"), "degraded tiers missing from metadata"
+    tiers = {s["tier"] for s in lin["degradations"]}
+    assert "device" in tiers, tiers
+    assert res["stats"]["valid"] is True
+    _assert_history_saved(test)
+    return {
+        "valid": res["valid"],
+        "algorithm": lin["algorithm"],
+        "degraded_tiers": sorted(tiers),
+    }
+
+
+SCENARIOS = {
+    "hanging-client": scenario_hanging_client,
+    "hanging-checker": scenario_hanging_checker,
+    "crashing-checker": scenario_crashing_checker,
+    "wgl-fault": scenario_wgl_fault,
+}
+
+
+def run_matrix(names=None) -> dict:
+    """Runs each scenario in its own temp store dir; returns
+    {name: detail}.  Raises AssertionError on the first failing cell."""
+    out = {}
+    for name, fn in SCENARIOS.items():
+        if names and name not in names:
+            continue
+        with tempfile.TemporaryDirectory(prefix=f"fm-{name}-") as d:
+            out[name] = fn(os.path.join(d, "store"))
+    return out
+
+
+def main(argv) -> int:
+    import logging
+
+    logging.basicConfig(level=logging.WARNING)
+    results = run_matrix(set(argv[1:]) or None)
+    print(json.dumps({"fault_matrix": "ok", "scenarios": results},
+                     default=repr))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
