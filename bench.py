@@ -31,6 +31,23 @@ Flags (env):
   JEPSEN_BENCH_NO_PROBE   "1" skips the pre-flight chip-health probe
   JEPSEN_BENCH_SCALE_OPS  second-metric scale-point size (default
                           20000000; "0" disables the scale point)
+  JEPSEN_BENCH_MIXED_KEYS third-metric mixed-shape key count (default
+                          200; "0" disables the mixed point)
+
+Capture trustworthiness: every measurement line carries "loadavg"
+(os.getloadavg at capture), "spread_ratio" (max/min over the measured
+reps), and "capture_quality" ("ok", or "noisy"/"contended"/both when
+the spread stayed >1.5x or the 1-minute load exceeded the core count).
+When a capture looks noisy or contended, run_bench re-measures inside
+the wall budget it already holds before settling on a median — the
+trajectory reads the annotation instead of flagging phantom
+regressions.
+
+Third metric (this PR): "independent_mixed_throughput" — the
+invalid-heavy jepsen.independent shape (200 keys x 100 ops, ~15% of
+keys carrying a planted violation) through the cohort settling ladder
+(parallel/independent.py), median of 3 memo-cold reps, embedded under
+"mixed" in the same single JSON line.
 
 Second headline metric (VERDICT r4 #4): BASELINE.md's other north
 star is "max history length to verdict @ 300 s".  After the
@@ -141,6 +158,44 @@ def init_backend() -> str:
     return "cpu"
 
 
+def _loadavg() -> list:
+    """[1, 5, 15]-minute load averages, or [] where unsupported —
+    a missing loadavg must never cost a measurement."""
+    try:
+        return [round(x, 2) for x in os.getloadavg()]
+    except (OSError, AttributeError):
+        return []
+
+
+def _contended() -> bool:
+    """True when the 1-minute loadavg exceeds the core count: more
+    runnable threads than cores means every timeslice is shared and
+    wall-clock measurements are dilated."""
+    la = _loadavg()
+    return bool(la) and la[0] > (os.cpu_count() or 1)
+
+
+def _capture_conditions(times: list) -> dict:
+    """Trustworthiness annotation for a multi-rep capture: the machine
+    load at capture time, the rep spread ratio, and a one-word quality
+    verdict.  "ok" = tight spread on an uncontended machine — the
+    number is the kernel's; "noisy" (spread > 1.5x survived the retry
+    budget) or "contended" (loadavg above the core count) mark numbers
+    that measured the machine's mood, so the perf trajectory can
+    discount them instead of flagging a phantom regression."""
+    out: dict = {"loadavg": _loadavg()}
+    quality = []
+    if len(times) >= 2 and min(times) > 0:
+        ratio = max(times) / min(times)
+        out["spread_ratio"] = round(ratio, 3)
+        if ratio > 1.5:
+            quality.append("noisy")
+    if _contended():
+        quality.append("contended")
+    out["capture_quality"] = "+".join(quality) if quality else "ok"
+    return out
+
+
 def run_bench() -> int:
     n_ops = int(knob("JEPSEN_BENCH_OPS"))
     info_rate = float(knob("JEPSEN_BENCH_INFO"))
@@ -221,6 +276,25 @@ def run_bench() -> int:
             budget -= elapsed
             if budget <= 0:
                 break
+        # Load-aware retry: a wide rep spread (>1.5x) or a contended
+        # machine (more runnable threads than cores) means the capture
+        # measured the NEIGHBORS, not the kernel.  Extra reps run only
+        # inside the wall budget already granted — the median tightens
+        # when the noise was transient, and the capture-quality field
+        # below tells the perf trajectory when it wasn't.
+        extra = 0
+        while (len(times) >= 2 and extra < 3
+               and budget > max(times)
+               and (max(times) / min(times) > 1.5 or _contended())):
+            t0 = time.monotonic()
+            with telemetry.span("bench.check"):
+                res = check_wgl_device(packed, pm, time_limit_s=budget)
+            elapsed = time.monotonic() - t0
+            if res.valid is not True:
+                break
+            times.append(elapsed)
+            budget -= elapsed
+            extra += 1
         phases["check"] = round(sum(times), 3)
         if not times:
             emit(
@@ -263,6 +337,7 @@ def run_bench() -> int:
             # last-good record with reps>=3 is a median, not a mood.
             reps=len(times),
             spread_s=[round(times[0], 3), round(times[-1], 3)],
+            **_capture_conditions(times),
         )
         return 0
     except Exception as e:  # noqa: BLE001 — the JSON line must print
@@ -318,17 +393,39 @@ def run_scale() -> int:
             seed=7, model=pm,
         )
         check_wgl_device(warm, pm, time_limit_s=120.0, width_hint=width)
-        t0 = time.monotonic()
-        res = check_wgl_device(packed, pm, time_limit_s=budget,
-                               width_hint=width)
-        dt = time.monotonic() - t0
+        # Battery captures (tools/chip_watch.py) ask for >=3 reps so
+        # the artifact records median+spread; the embedded scale point
+        # keeps the single-rep default (its wall slice is whatever the
+        # primary metric left over).
+        reps = max(1, int(os.environ.get("JEPSEN_BENCH_SCALE_REPS",
+                                         "1")))
+        budget0 = budget
+        times = []
+        for _ in range(reps):
+            t0 = time.monotonic()
+            res = check_wgl_device(packed, pm, time_limit_s=budget,
+                                   width_hint=width)
+            dt = time.monotonic() - t0
+            if res.valid is not True:
+                break
+            times.append(dt)
+            budget -= dt
+            if budget <= 0:
+                break
+        if times:
+            times.sort()
+            dt = times[len(times) // 2]
         rec = {
             "metric": "scale_ops_to_verdict",
             "ops": int(packed.n),
             "valid": res.valid,
             "elapsed_s": round(dt, 2),
-            "budget_s": budget,
+            "budget_s": budget0,
             "platform": platform,
+            **({"reps": len(times),
+                "spread_s": [round(times[0], 3), round(times[-1], 3)]}
+               if len(times) > 1 else {}),
+            **_capture_conditions(times if times else [dt]),
         }
         from jepsen_tpu import telemetry
 
@@ -355,6 +452,95 @@ def run_scale() -> int:
         print(json.dumps({
             "metric": "scale_ops_to_verdict", "ops": 0,
             "valid": None, "error": f"{type(e).__name__}: {e}",
+        }))
+        return 1
+
+
+def run_mixed() -> int:
+    """Invalid-heavy independent-checking child
+    (JEPSEN_BENCH_MIXED_CHILD=1): 200 keys x 100 ops with ~15% of keys
+    carrying a planted violation, through IndependentChecker's
+    settling ladder (stream witness -> memo -> refutation screens ->
+    batched BFS -> parallel CPU settle).  The settle memo is cleared
+    before every rep so the metric prices the cold ladder, not a memo
+    replay.  One JSON line, embedded under "mixed" in the main line by
+    the parent."""
+    budget = float(os.environ.get("JEPSEN_BENCH_MIXED_BUDGET", "120"))
+    n_keys = int(os.environ.get("JEPSEN_BENCH_MIXED_KEYS", "200"))
+    key_ops = int(os.environ.get("JEPSEN_BENCH_MIXED_KEY_OPS", "100"))
+    n_bad = max(1, round(n_keys * 0.15))
+    try:
+        platform = init_backend()
+
+        from jepsen_tpu.checker.linearizable import Linearizable
+        from jepsen_tpu.history.core import history as make_history
+        from jepsen_tpu.models import cas_register
+        from jepsen_tpu.parallel.independent import (
+            IndependentChecker, clear_settle_memo, kv,
+        )
+        from jepsen_tpu.parallel.mesh import default_mesh
+        from jepsen_tpu.utils.histgen import random_register_history
+
+        ops = []
+        for i in range(n_keys):
+            h = random_register_history(
+                key_ops, procs=4, info_rate=0.05, seed=i,
+                bad=(i < n_bad),
+            )
+            ops += [o.replace(value=kv(f"k{i}", o.value)) for o in h]
+        hist = make_history(ops)
+        chk = IndependentChecker(
+            Linearizable(cas_register(), time_limit_s=budget)
+        )
+        test = {"mesh": default_mesh()}
+
+        times = []
+        t_wall = time.monotonic()
+        for rep in range(4):  # rep 0 = compile warm-up, never counted
+            clear_settle_memo()
+            t0 = time.monotonic()
+            res = chk.check(test, hist, {})
+            dt = time.monotonic() - t0
+            ok = (res["valid"] is False
+                  and res["failure-count"] == n_bad)
+            if not ok:
+                print(json.dumps({
+                    "metric": "independent_mixed_throughput",
+                    "error": (
+                        f"expected invalid with {n_bad} failures, got "
+                        f"valid={res['valid']} "
+                        f"failures={res.get('failure-count')}"
+                    ),
+                    "platform": platform,
+                }))
+                return 1
+            if rep > 0:
+                times.append(dt)
+            if time.monotonic() - t_wall > budget:
+                break
+        times.sort()
+        rate = (len(hist) / 2) / times[len(times) // 2]
+        rec = {
+            "metric": "independent_mixed_throughput",
+            "ops_per_s": round(rate, 1),
+            "keys": n_keys,
+            "key_ops": key_ops,
+            "bad_keys": n_bad,
+            "elapsed_s": round(times[len(times) // 2], 3),
+            "reps": len(times),
+            "spread_s": [round(times[0], 3), round(times[-1], 3)],
+            "platform": platform,
+            **_capture_conditions(times),
+        }
+        print(json.dumps(rec))
+        return 0
+    except Exception as e:  # noqa: BLE001 — the JSON line must print
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "independent_mixed_throughput",
+            "error": f"{type(e).__name__}: {e}",
         }))
         return 1
 
@@ -468,6 +654,8 @@ def main() -> int:
 
     if os.environ.get("JEPSEN_BENCH_SCALE_CHILD"):
         return run_scale()
+    if os.environ.get("JEPSEN_BENCH_MIXED_CHILD"):
+        return run_mixed()
     if os.environ.get("JEPSEN_BENCH_NO_WATCHDOG"):
         return run_bench()
     t_start = time.monotonic()
@@ -505,10 +693,14 @@ def main() -> int:
         if proc.returncode == 0:
             record_last_good(out)
             try:
+                out = _with_mixed_point(out, env, t_start, wall_cap)
+            except Exception as e:  # noqa: BLE001
+                print(f"# mixed point failed: {e!r}", file=sys.stderr)
+            try:
                 out = _with_scale_point(out, env, t_start, wall_cap)
             except Exception as e:  # noqa: BLE001
                 # The first metric must never be hostage to the
-                # second: any scale-point failure (fork OSError after
+                # others: any side-metric failure (fork OSError after
                 # a 20M-row run, MemoryError, ...) leaves the already
                 # measured primary line untouched.
                 print(f"# scale point failed: {e!r}", file=sys.stderr)
@@ -564,6 +756,59 @@ def _last_json_line(text: str):
             except ValueError:
                 continue
     return found_i, found
+
+
+def _with_mixed_point(out: str, env: dict, t_start: float,
+                      wall_cap: float) -> str:
+    """Runs the invalid-heavy mixed child inside what's left of the
+    wall cap and embeds its record under "mixed" in the main JSON
+    line.  Any failure leaves the main line untouched."""
+    import subprocess
+
+    if os.environ.get("JEPSEN_BENCH_MIXED_KEYS", "") == "0":
+        return out
+    lines = out.splitlines()
+    main_i, main_rec = _last_json_line(out)
+    if main_rec is None or main_rec.get("value", 0) <= 0:
+        return out
+    wall_left = wall_cap - (time.monotonic() - t_start)
+    if wall_left < 80.0:
+        main_rec["mixed"] = {"skipped": "wall budget exhausted"}
+    else:
+        env2 = dict(
+            env,
+            JEPSEN_BENCH_MIXED_CHILD="1",
+            JEPSEN_BENCH_MIXED_BUDGET=str(
+                min(120.0, max(30.0, wall_left - 40.0))
+            ),
+        )
+        if main_rec.get("platform") != "tpu":
+            # The mixed shape's parallelism lives in the mesh; the CPU
+            # fallback gets the same 8-virtual-device split the test
+            # suite measures (tests/test_whole_stack_perf.py), so the
+            # recorded number is comparable to the committed floor.
+            env2["XLA_FLAGS"] = (
+                env2.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                timeout=wall_left - 10.0, env=env2, capture_output=True,
+            )
+            sys.stderr.write(proc.stderr.decode(errors="replace"))
+            _, rec = _last_json_line(
+                proc.stdout.decode(errors="replace")
+            )
+            if rec is None:
+                rec = {"skipped": f"mixed child rc={proc.returncode}, "
+                                  "no JSON"}
+            main_rec["mixed"] = rec
+        except subprocess.TimeoutExpired:
+            main_rec["mixed"] = {"skipped": "mixed child hit the wall "
+                                            "deadline"}
+    lines[main_i] = json.dumps(main_rec)
+    return "\n".join(lines) + "\n"
 
 
 def _with_scale_point(out: str, env: dict, t_start: float,

@@ -7,6 +7,10 @@ reference by :algorithm:
   "wgl"         exact CPU search over packed ops
   "competition" device first, exact CPU to settle unknowns (mirrors
                 knossos.competition racing its solvers)
+  "settle"      cohort-settle entry (parallel/independent.py): the
+                sound refutation screens first, then the auto-routed
+                exact CPU engine — no device pass (the batched tiers
+                already had their shot)
 
 Models with no packed form fall back to the host-model search.
 """
@@ -97,6 +101,31 @@ class Linearizable(Checker):
             # and debugging depend on it); the screens only join the
             # strategy-picking paths below.
             res, engine = self._cpu_exact(packed, pm, algorithm)
+            return self._render(res, packed, engine, model, pm, opts=opts)
+
+        if algorithm == "settle":
+            # Cohort-settle entry (parallel/independent.py): the device
+            # tiers already had their shot, so this is screen-then-CPU —
+            # the sound O(n log n) refutation screens decide the invalid
+            # families that dominate practice (planted violations,
+            # unsupported/stale reads) in milliseconds, and only the
+            # rare survivor pays the exact engine.
+            import time as _time
+
+            from .refute import check_refute
+
+            t0 = _time.monotonic()
+            ref = check_refute(packed, pm, time_limit_s=self.time_limit_s)
+            if ref is not None:
+                return self._render(ref, packed, "refute-screen", model,
+                                    pm, opts=opts)
+            remaining = None
+            if self.time_limit_s is not None:
+                remaining = max(
+                    1.0, self.time_limit_s - (_time.monotonic() - t0)
+                )
+            res, engine = self._cpu_exact(packed, pm,
+                                          time_limit_s=remaining)
             return self._render(res, packed, engine, model, pm, opts=opts)
 
         # Sound non-linearizability screens (checker/refute.py) run

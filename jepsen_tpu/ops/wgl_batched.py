@@ -31,6 +31,7 @@ from typing import Any, Optional
 
 import numpy as np
 
+from .. import telemetry
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
 from . import degrade
@@ -367,6 +368,17 @@ def check_wgl_batched(
             else:
                 B *= 2
 
+    if telemetry.enabled():
+        # Tier populations for the cohort-settle ladder: an exact False
+        # here is a device REFUTATION the settle tier can accept
+        # without an exhaustive CPU search (soundness contract above).
+        telemetry.count("wgl.batched.keys", K)
+        telemetry.count("wgl.batched.proven",
+                        sum(1 for v in verdict if v is True))
+        telemetry.count("wgl.batched.refuted",
+                        sum(1 for v in verdict if v is False))
+        telemetry.count("wgl.batched.unknown",
+                        sum(1 for v in verdict if v == "unknown"))
     return BatchedWGLResult(
         valid=verdict,
         explored=explored,

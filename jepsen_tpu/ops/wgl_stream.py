@@ -34,7 +34,10 @@ one shot — the common case for real workloads.  On failure, the
 engine's death rank localizes the first undecidable key: keys wholly
 before it are proven (their barriers were all linearized), the dead
 key is reported unknown (the caller settles it exactly), and the
-stream restarts after it.
+stream resumes after it — in SEGMENTS of ~K/8 keys once any key has
+died, so each restart re-concatenates O(segment) rows instead of the
+whole remainder (invalid-heavy histories pay O(bad * K/segments) host
+work, not O(bad * K); see check_wgl_witness_stream).
 
 Throughput: 200 keys x 100 ops decided in one ~10-block device pass
 instead of 200 frontier searches — measured ~20x the batched-BFS rate
@@ -242,12 +245,24 @@ def check_wgl_witness_stream(
     *,
     time_limit_s: Optional[float] = None,
     max_restarts: Optional[int] = None,
+    segment_keys: Optional[int] = None,
     **witness_kw: Any,
 ) -> list[Any]:
     """Per-key verdicts via the concatenated stream: True (proven
     linearizable) or None (witness could not decide — settle exactly).
     Never returns False: like the witness tier itself, failure only
-    means escalate."""
+    means escalate.
+
+    Restart cost is bounded by SEGMENTING: the first pass concatenates
+    every key (the all-valid common case stays one device pass), but
+    once a key dies, the stream resumes in segments of `segment_keys`
+    keys (default ~K/8).  A dead key then kills only its segment's
+    remainder — each restart re-concatenates and re-plans O(segment)
+    rows instead of O(all remaining), so an invalid-heavy history pays
+    O(bad * K/segments) host work rather than O(bad * K).  Fixed-size
+    segments also share kernel shapes, so the per-restart pass reuses
+    the compiled sweep instead of recompiling per remainder length.
+    """
     K = len(packs)
     verdicts: list[Any] = [None] * K
     if K == 0:
@@ -266,12 +281,19 @@ def check_wgl_witness_stream(
     spm = stream_model(pm)
     t0 = time.monotonic()
     if max_restarts is None:
-        # A handful of bad keys is the expected worst case; a history
-        # where MOST keys defeat the witness should fall through to
-        # the exact engines rather than pay K restarts.
-        max_restarts = max(8, K // 8)
+        # Restarts are segment-sized (cheap), so the cap can afford
+        # one per bad key up to half the keys; a history where MOST
+        # keys defeat the witness should still fall through to the
+        # exact engines rather than pay K passes.
+        max_restarts = max(8, K // 2)
+    seg = max(1, segment_keys) if segment_keys is not None \
+        else max(8, -(-K // 8))
     start = 0
     restarts = 0
+    passes = 0
+    # First pass spans every key; after any death the stream continues
+    # segment-sized.
+    span = K
     with telemetry.span("wgl.stream", keys=K):
         while start < K:
             remaining = None
@@ -279,8 +301,12 @@ def check_wgl_witness_stream(
                 remaining = time_limit_s - (time.monotonic() - t0)
                 if remaining <= 0:
                     break
-            combined, override, key_of_bar = concat_packs(packs[start:])
+            end = min(K, start + span)
+            combined, override, key_of_bar = concat_packs(
+                packs[start:end]
+            )
             info: dict = {}
+            passes += 1
             try:
                 degrade.maybe_fault("stream")
                 r = check_wgl_witness(
@@ -297,7 +323,7 @@ def check_wgl_witness_stream(
                 # halved internally, so a resource error surfacing here
                 # means the concatenated stream itself is too big —
                 # leave the remaining keys None and fall through to the
-                # per-key tiers (batched BFS / CPU settle).
+                # per-key tiers (batched BFS / cohort settle).
                 degrade.record("stream", "fall-through", e)
                 log.warning(
                     "stream witness exhausted device resources; "
@@ -306,10 +332,10 @@ def check_wgl_witness_stream(
                 )
                 break
             if r is not None and r.valid is True:
-                for k in range(start, K):
+                for k in range(start, end):
                     verdicts[k] = True
-                start = K
-                break
+                start = end
+                continue
             died = info.get("died_at_rank")
             if died is None:
                 break  # budget blown or unlocalized: the rest stay None
@@ -319,6 +345,7 @@ def check_wgl_witness_stream(
             for k in range(bad):
                 verdicts[start + k] = True
             start += bad + 1
+            span = seg
             restarts += 1
             if restarts >= max_restarts:
                 log.info(
@@ -331,4 +358,5 @@ def check_wgl_witness_stream(
         telemetry.count("wgl.stream.keys-proven",
                         sum(1 for v in verdicts if v is True))
         telemetry.count("wgl.stream.restarts", restarts)
+        telemetry.count("wgl.stream.passes", passes)
     return verdicts

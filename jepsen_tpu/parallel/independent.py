@@ -17,8 +17,12 @@ to the generator machinery it builds on.
 
 from __future__ import annotations
 
+import hashlib
+import threading
+from collections import OrderedDict
 from typing import Any, Callable, Iterable, NamedTuple, Optional
 
+from .. import telemetry
 from ..checker.core import Checker, check_safe, merge_valid
 from ..checker.linearizable import Linearizable
 from ..history.core import History, Op
@@ -48,6 +52,80 @@ def is_kv(v: Any) -> bool:
 def tuple_gen(key: Any, value: Any) -> KV:
     """Alias mirroring `independent/tuple`."""
     return KV(key, value)
+
+
+# ---------------------------------------------------------------------------
+# Settle-verdict memoization
+# ---------------------------------------------------------------------------
+
+#: digest -> sanitized settle verdict.  Bounded LRU: planted-violation
+#: and replayed-nemesis workloads repeat the SAME bad subhistory across
+#: keys and across checks; each distinct one is decided once.
+_SETTLE_MEMO_MAX = 2048
+_settle_memo: "OrderedDict[str, dict]" = OrderedDict()
+_settle_memo_lock = threading.Lock()
+
+#: Result fields that cite positions in ONE key's slice of the full
+#: history (src_index-based certificates, rendered artifacts).  A memo
+#: entry is shared by textually identical subhistories at DIFFERENT
+#: positions, so these never ride along.
+_POSITIONAL_FIELDS = ("final-configs", "crashed-op", "counterexample-file")
+
+
+def _settle_digest(p, pm) -> str:
+    """Packed-history digest keying the settle memo.  Sound for verdict
+    sharing because the packed check is purely code-level: the verdict
+    is a function of the (inv, ret, status, f, a0, a1) columns, the
+    model's step semantics (named), and its initial state — regardless
+    of which concrete values the interner codes denote.  src_index is
+    deliberately excluded: identical subhistories at different offsets
+    in the full history must collide."""
+    import numpy as np
+
+    h = hashlib.sha256()
+    h.update(
+        f"{pm.name}|{tuple(int(v) for v in pm.init_state)}|"
+        f"{pm.state_width}".encode()
+    )
+    for col in (p.inv, p.ret, p.status, p.f, p.a0, p.a1):
+        h.update(np.ascontiguousarray(col).tobytes())
+    return h.hexdigest()
+
+
+def _sanitize_settle(res: dict) -> dict:
+    """A memo-shareable copy of a settle result: verdict and metadata,
+    minus the positional certificate fields."""
+    return {k: v for k, v in res.items() if k not in _POSITIONAL_FIELDS}
+
+
+def _memo_get(digest: str) -> Optional[dict]:
+    with _settle_memo_lock:
+        r = _settle_memo.get(digest)
+        if r is not None:
+            _settle_memo.move_to_end(digest)
+            return dict(r)
+    return None
+
+
+def clear_settle_memo() -> None:
+    """Empties the cross-call settle memo.  Benchmarks and perf tests
+    call this between reps so every rep measures the COLD settling
+    ladder (screens + search), not a memo replay."""
+    with _settle_memo_lock:
+        _settle_memo.clear()
+
+
+def _memo_put(digest: str, res: dict) -> None:
+    # Only decisive verdicts are worth remembering: an "unknown" is a
+    # budget artifact of THIS call, and a later call with more budget
+    # must not inherit it.
+    if res.get("valid") not in (True, False):
+        return
+    with _settle_memo_lock:
+        _settle_memo[digest] = _sanitize_settle(res)
+        _settle_memo.move_to_end(digest)
+        while len(_settle_memo) > _SETTLE_MEMO_MAX:
+            _settle_memo.popitem(last=False)
 
 
 def history_keys(h: History) -> list:
@@ -235,7 +313,8 @@ class IndependentChecker(Checker):
             pm = model.packed()
         except (NotImplementedError, AttributeError):
             pm = None
-        if pm is None or lin.algorithm in ("wgl", "linear", "cpu", "event"):
+        if pm is None or lin.algorithm in ("wgl", "linear", "cpu",
+                                           "event", "settle"):
             rs = bounded_pmap(
                 lambda k: check_safe(
                     lin, test, subs[k], {**opts, "history_key": k}
@@ -246,7 +325,6 @@ class IndependentChecker(Checker):
             return dict(zip(keys, rs))
 
         from ..history.packed import pack_history
-        from ..ops.wgl_batched import check_wgl_batched
         from .mesh import checker_mesh
 
         all_packs = {}
@@ -345,60 +423,215 @@ class IndependentChecker(Checker):
                     "configs-explored": int(all_packs[k].n_ok),
                 }
         keys = [k for k, v in zip(keys, stream_v) if v is not True]
+        if telemetry.enabled():
+            telemetry.count("wgl.settle.stream-proven",
+                            len(results_stream))
         if not keys:
             return {**results_unpack, **results_long, **results_stream}
 
-        packs = [all_packs[k] for k in keys]
-        mesh = checker_mesh(test)
-        # Start the beam SMALL: the overflow-retry ladder re-batches
-        # only the keys that overflowed, so typical short per-key
-        # histories settle in the cheap narrow passes and only the
-        # rare wide key climbs.  Measured (200 keys x 100 ops, 8-dev
-        # CPU mesh, warm): start 32 = 1.8 s vs start 256 = 16.3 s —
-        # the per-step frontier work scales with the start width for
+        results: dict[Any, dict] = {
+            **results_unpack, **results_long, **results_stream,
+        }
+        results.update(self._settle_cohort(
+            keys, all_packs, subs, model, pm, lin, test, opts,
+            budget_left, checker_mesh(test),
+        ))
+        return results
+
+    #: Detail budget for keys the batched kernel already proved invalid
+    #: EXACTLY: the CPU pass is reporting-only there (the verdict
+    #: stands), so it gets a small slice, not the whole tier budget.
+    REFUTED_DETAIL_BUDGET_S = 10.0
+
+    def _settle_cohort(
+        self, cohort_keys, all_packs, subs, model, pm, lin, test, opts,
+        budget_left, mesh,
+    ) -> dict[Any, dict]:
+        """Decides the cohort the stream witness left unproven, under
+        the shared tier budget.  The pipeline, cheapest tier first:
+
+          1. **memo** — identical subhistories (packed digest,
+             src_index excluded) replay a prior decisive verdict; one
+             representative per digest runs the rest of the pipeline
+             and fans its sanitized verdict out.
+          2. **refutation screens** (checker/refute.py) — host numpy,
+             O(n log n), exact when they fire.  They classify the
+             planted-violation/bad-read families in milliseconds, so
+             those keys never enter the batched BFS (proving `invalid`
+             there means EXHAUSTING the per-key search — the expensive
+             direction).
+          3. **batched BFS** (ops/wgl_batched.py) — screen survivors
+             only, vmapped over the mesh; True is proven, False is an
+             exact device refutation.
+          4. **parallel CPU settle** — the remainder (screen-refuted
+             keys for certificate detail, device-refuted keys for a
+             small-budget detail pass, unknowns for the exact engine)
+             under bounded_pmap, every slice carved from the same
+             tier budget."""
+        import logging
+
+        from ..checker.refute import check_refute
+        from ..ops.wgl_batched import check_wgl_batched
+
+        log = logging.getLogger(__name__)
+        groups: "OrderedDict[str, list]" = OrderedDict()
+        for k in cohort_keys:
+            d = _settle_digest(all_packs[k], pm)
+            groups.setdefault(d, []).append(k)
+
+        group_result: dict[str, dict] = {}
+        reps: list[str] = []
+        for d in groups:
+            hit = _memo_get(d)
+            if hit is not None:
+                group_result[d] = hit
+            else:
+                reps.append(d)
+        n_memo = sum(len(groups[d]) for d in group_result)
+
+        # Screen classifier: which representatives are provably invalid
+        # without any search.  Sound-when-fires; None = no opinion.
+        def screen_one(d: str):
+            b = budget_left()
+            try:
+                return check_refute(
+                    all_packs[groups[d][0]], pm,
+                    time_limit_s=30.0 if b is None else min(b, 30.0),
+                )
+            except Exception:  # noqa: BLE001 — a screen bug must not
+                log.warning("refutation screen failed for key %r",
+                            groups[d][0], exc_info=True)
+                return None  # change a verdict; the search tiers decide
+
+        screened = dict(zip(reps, bounded_pmap(screen_one, reps,
+                                               bound=self.bound)))
+        refuted_reps = [d for d in reps if screened[d] is not None]
+        survivors = [d for d in reps if screened[d] is None]
+
+        # Batched frontier BFS over the screen survivors.  Start the
+        # beam SMALL: the overflow-retry ladder re-batches only the
+        # keys that overflowed, so typical short per-key histories
+        # settle in the cheap narrow passes and only the rare wide key
+        # climbs.  Measured (200 keys x 100 ops, 8-dev CPU mesh,
+        # warm): start 32 = 1.8 s vs start 256 = 16.3 s — the
+        # per-step frontier work scales with the start width for
         # EVERY key, paid even by keys the narrowest pass would
         # settle.  32 is the kernel's smallest beam bucket
         # (check_wgl_batched's _bucket lo=32; anything lower rounds
         # up to it).  Worst case (all keys climb to max) the
         # geometric ladder costs ~2x the final pass — bounded, and
         # far rarer than the all-keys-small common case.
-        batch = check_wgl_batched(
-            packs,
-            pm,
-            beam=min(lin.beam, 32),
-            max_beam=max(lin.max_beam, lin.beam),
-            mesh=mesh,
-            time_limit_s=budget_left(),
-        )
+        device_verdict: dict[str, Any] = {d: None for d in reps}
+        device_explored: dict[str, int] = {d: 0 for d in reps}
+        n_batched_proven = 0
+        if survivors:
+            batch = check_wgl_batched(
+                [all_packs[groups[d][0]] for d in survivors],
+                pm,
+                beam=min(lin.beam, 32),
+                max_beam=max(lin.max_beam, lin.beam),
+                mesh=mesh,
+                time_limit_s=budget_left(),
+            )
+            for i, d in enumerate(survivors):
+                device_verdict[d] = batch.valid[i]
+                device_explored[d] = int(batch.explored[i])
+                if batch.valid[i] is True:
+                    group_result[d] = {
+                        "valid": True,
+                        "algorithm": "wgl-tpu-batched",
+                        "configs-explored": int(batch.explored[i]),
+                    }
+                    _memo_put(d, group_result[d])
+                    n_batched_proven += 1
 
-        results: dict[Any, dict] = {
-            **results_unpack, **results_long, **results_stream,
-        }
-        for i, k in enumerate(keys):
-            v = batch.valid[i]
-            if v is True:
-                results[k] = {
-                    "valid": True,
-                    "algorithm": "wgl-tpu-batched",
-                    "configs-explored": int(batch.explored[i]),
-                }
+        # Parallel CPU settle of everything still without a result:
+        # screen-refuted reps (the "settle" algorithm re-fires the
+        # cheap screen and renders the certificate), device-refuted
+        # reps (small detail slice; the exact device verdict stands if
+        # the slice expires), and device unknowns (exact engine).
+        todo = [d for d in reps if d not in group_result]
+
+        def settle_one(d: str) -> dict:
+            k = groups[d][0]
+            dv = device_verdict[d]
+            budget = budget_left()
+            if dv is False:
+                budget = (self.REFUTED_DETAIL_BUDGET_S if budget is None
+                          else min(budget, self.REFUTED_DETAIL_BUDGET_S))
+            single = Linearizable(
+                model,
+                "settle",
+                time_limit_s=budget,
+                max_configs=lin.max_configs,
+            )
+            r = check_safe(single, test, subs[k],
+                           {**opts, "history_key": k})
+            if dv is not None:
+                r["device-verdict"] = dv
+            if dv is False:
+                if r.get("valid") == "unknown":
+                    # The detail slice expired; the device refutation
+                    # is exact (search exhausted without overflow) and
+                    # settles the verdict on its own.
+                    r = {
+                        "valid": False,
+                        "algorithm": "wgl-tpu-batched",
+                        "configs-explored": device_explored[d],
+                        "device-verdict": False,
+                    }
+                elif r.get("valid") is True:
+                    # Exact engines disagreeing is a checker bug, not a
+                    # history property; surface it loudly and keep the
+                    # CPU verdict (parity with per-key exact checking).
+                    log.error(
+                        "device/CPU verdict mismatch on key %r: batched"
+                        " kernel proved invalid, exact engine proved "
+                        "valid — keeping the CPU verdict", k,
+                    )
+            return r
+
+        n_screen = n_device_refuted = n_cpu = 0
+        screen_fired = set(refuted_reps)
+        for d, r in zip(todo, bounded_pmap(settle_one, todo,
+                                           bound=self.bound)):
+            group_result[d] = r
+            _memo_put(d, r)
+            if device_verdict[d] is False:
+                n_device_refuted += 1
+            elif d in screen_fired:
+                n_screen += 1
             else:
-                # invalid or unknown: settle on CPU for the exact verdict
-                # and the counterexample detail (per-key histories are
-                # short; checker.clj renders these via knossos.linear.report).
-                # "cpu" auto-routes info-heavy keys to the event-walk
-                # engine, which settles cases the memoized DFS cannot.
-                single = Linearizable(
-                    model,
-                    "cpu",
-                    time_limit_s=budget_left(),
-                    max_configs=lin.max_configs,
-                )
-                r = check_safe(single, test, subs[k], {**opts, "history_key": k})
-                r["algorithm"] = "wgl-tpu-batched+cpu"
-                r["device-verdict"] = v
-                results[k] = r
-        return results
+                n_cpu += 1
+
+        # Fan every group's verdict out: the representative carries the
+        # full result (positional certificate fields cite ITS slice of
+        # the history); other members share the sanitized verdict.
+        settled: dict[Any, dict] = {}
+        live = set(reps)
+        for d, members in groups.items():
+            r = group_result.get(d)
+            if r is None:  # defensive: unreachable
+                continue
+            if d in live:
+                settled[members[0]] = r
+                extra = members[1:]
+                n_memo += len(extra)
+            else:
+                extra = members  # cross-call memo hit: all share
+            for k2 in extra:
+                shared = _sanitize_settle(r)
+                shared["memo-hit"] = True
+                settled[k2] = shared
+        if telemetry.enabled():
+            telemetry.count("wgl.settle.screen-refuted", n_screen)
+            telemetry.count("wgl.settle.batched-proven",
+                            n_batched_proven)
+            telemetry.count("wgl.settle.batched-refuted",
+                            n_device_refuted)
+            telemetry.count("wgl.settle.cpu-settled", n_cpu)
+            telemetry.count("wgl.settle.memo-hit", n_memo)
+        return settled
 
 
 def independent_checker(base: Checker, **kw: Any) -> IndependentChecker:

@@ -263,6 +263,28 @@ def resilience_counters() -> dict[str, Any]:
     }
 
 
+#: Tier-population counters of the independent checker's settling
+#: ladder (parallel/independent.py): how many keys each tier decided
+#: (wgl.settle.{stream-proven, batched-proven, batched-refuted,
+#: cpu-settled, memo-hit}).  The shape of a run's work: an all-valid
+#: workload is all stream-proven; an invalid-heavy one shows its bad
+#: keys split across device refutations, CPU settles, and memo hits.
+SETTLE_COUNTER_PREFIX = "wgl.settle."
+
+
+def settle_counters() -> dict[str, Any]:
+    """The wgl.settle.* counters — per-tier key populations of the
+    cohort-settling ladder (empty when telemetry is disabled or no
+    independent check ran)."""
+    with _lock:
+        items = dict(_counters)
+    return {
+        k: v
+        for k, v in sorted(items.items())
+        if k.startswith(SETTLE_COUNTER_PREFIX)
+    }
+
+
 def chrome_trace() -> dict:
     """The recorded spans as a Chrome trace-event dict ("X" complete
     events, µs timestamps) — Perfetto / chrome://tracing loadable."""

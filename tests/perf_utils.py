@@ -1,12 +1,23 @@
-"""Shared de-flake helper for the asserted perf floors.
+"""Shared de-flake helpers for the asserted perf floors.
 
 VERDICT r4 'weak' #4: a floor that fails when neighbors compete for
-the (single!) CPU core trains people to ignore red.  The fix is not a
-lower floor — that concedes parity the code has — but adaptive
-patience: measure until the floor passes (early exit: a healthy build
-pays 1-2 reps) or the rep budget is exhausted (a REAL regression is
-slow on every rep, so it still fails).  A transient load spike costs
-extra reps instead of a red suite.
+the (single!) CPU core trains people to ignore red.  Two compounding
+fixes, neither of which is "lower the floor" (that concedes parity the
+code has):
+
+  * **adaptive patience** (`rate_until`) — measure until the floor
+    passes (early exit: a healthy build pays 1-2 reps) or the rep
+    budget is exhausted (a REAL regression is slow on every rep, so it
+    still fails).  A transient load spike costs extra reps, not a red
+    suite.
+  * **floor calibration** (`calibrated_floor`) — a deterministic
+    single-thread probe (a fixed sha256 chain: pure interpreter +
+    hashlib, no threads, no numpy) measures how fast THIS machine runs
+    single-core work RIGHT NOW, and the nominal floor scales by that
+    factor, clamped to [0.25, 1.0]x.  Sustained contention (loadavg 2:
+    every timeslice halved) slows the probe and the measured workload
+    alike, so the ratio cancels; the clamp keeps a floor from dropping
+    so far that a 4x real regression could hide behind a busy machine.
 
 gc.collect() before each rep keeps a neighbor test's garbage (packed
 histories are tens of MB) from billing its collection pause to the
@@ -16,7 +27,54 @@ timed region.
 from __future__ import annotations
 
 import gc
+import hashlib
+import time
 from typing import Callable
+
+#: Best-of-3 probe time on the calibration machine (idle, the machine
+#: every nominal floor in the suite was measured on).  Re-measure with
+#: `python tests/perf_utils.py` after changing the probe workload.
+PROBE_REFERENCE_S = 0.0152
+
+#: sha256-chain length.  ~40 ms on the calibration machine: long
+#: enough that scheduler noise averages out, short enough that three
+#: samples cost nothing next to the workloads being floored.
+_PROBE_ITERS = 40_000
+
+
+def probe_elapsed_s() -> float:
+    """One run of the deterministic single-thread probe: a fixed-length
+    sha256 chain over a fixed seed.  The work is identical on every
+    machine and every run, so elapsed time measures exactly the
+    single-core throughput the perf floors depend on — including
+    whatever contention exists at call time."""
+    b = b"jepsen-tpu-perf-probe"
+    t0 = time.perf_counter()
+    for _ in range(_PROBE_ITERS):
+        b = hashlib.sha256(b).digest()
+    return time.perf_counter() - t0
+
+
+def machine_speed_factor(samples: int = 3) -> float:
+    """reference_time / best observed probe time: ~1.0 on the idle
+    calibration machine, < 1 on slower hardware or under sustained
+    contention, > 1 on faster machines.  Best-of-N so a single
+    scheduler preemption doesn't masquerade as a slow machine."""
+    best = min(probe_elapsed_s() for _ in range(samples))
+    return PROBE_REFERENCE_S / best
+
+
+def calibrated_floor(
+    nominal: float,
+    lo: float = 0.25,
+    hi: float = 1.0,
+) -> float:
+    """The nominal floor scaled to this machine's measured single-core
+    speed, clamped to [lo, hi] x nominal.  `hi` defaults to 1.0 — a
+    faster machine must still beat the floor as published, not a
+    raised one (floors document guarantees, not hardware)."""
+    f = machine_speed_factor()
+    return nominal * min(hi, max(lo, f))
 
 
 def rate_until(
@@ -38,3 +96,13 @@ def rate_until(
         if best > floor:
             break
     return best
+
+
+if __name__ == "__main__":
+    # Calibration: prints the value to commit as PROBE_REFERENCE_S
+    # when re-baselining on a new reference machine (run idle).
+    times = sorted(probe_elapsed_s() for _ in range(5))
+    print(f"probe best-of-5: {times[0]:.4f}s  (all: "
+          f"{', '.join(f'{t:.4f}' for t in times)})")
+    print(f"current PROBE_REFERENCE_S={PROBE_REFERENCE_S} -> "
+          f"factor {PROBE_REFERENCE_S / times[0]:.2f}")

@@ -10,8 +10,17 @@ minutes — bench landed at 03:48Z and the chip was wedged again by
 timeout and be retried at the next window, never wedge the watcher):
 
   1. bench.py                    -> BENCH_TPU_LAST_GOOD.json
-  2. compact_ab  (--reps 1)      -> TPU_COMPACT_AB.json
-  3. profile_witness (--reps 1)  -> TPU_WITNESS_PROFILE.json
+  2. compact_ab  (--reps 3)      -> TPU_COMPACT_AB.json
+  3. profile_witness (--reps 3)  -> TPU_WITNESS_PROFILE.json
+  4. profile_witness 1M ops      -> TPU_WITNESS_PROFILE_1M.json
+  5. transfer_ab                 -> TPU_TRANSFER_AB.json
+  6. independent_bench           -> TPU_INDEPENDENT_BENCH.json
+     (stream-witness + invalid-heavy 200x100 shapes, >=3 reps,
+      median+spread — the chip-side counterpart of the CPU-mesh
+      floors in tests/test_whole_stack_perf.py)
+  7. bench.py scale child x3     -> TPU_SCALE_POINT.json
+     (JEPSEN_BENCH_SCALE_CHILD=1 JEPSEN_BENCH_SCALE_REPS=3; battery
+      steps can carry an env overlay as a 5th tuple element)
 
 Between battery steps the chip is re-probed so a mid-window wedge stops
 the battery instead of feeding it a dead tunnel.  The log makes "no TPU
@@ -58,14 +67,17 @@ def ensure_header() -> None:
 
 
 def run_capture(name: str, cmd: list[str], artifact: str,
-                timeout: float) -> bool:
+                timeout: float, env: dict | None = None) -> bool:
     """Run one battery step; write its stdout JSON lines to `artifact`.
     True only when the artifact actually landed — a failed capture must
-    NOT stop the watcher from retrying on the next healthy probe."""
+    NOT stop the watcher from retrying on the next healthy probe.
+    `env` entries overlay the watcher's environment (bench.py's
+    child-mode switches are env vars, not flags)."""
     log_line(f"probe=ok -> running {name} to capture TPU measurement")
     try:
         proc = subprocess.run(cmd, capture_output=True,
-                              timeout=timeout, cwd=REPO)
+                              timeout=timeout, cwd=REPO,
+                              env={**os.environ, **(env or {})})
     except subprocess.TimeoutExpired:
         log_line(f"{name} TIMED OUT ({timeout:.0f} s) despite ok probe")
         return False
@@ -114,6 +126,20 @@ BATTERY = [
     ("transfer_ab", [sys.executable, "tools/transfer_ab.py",
                      "--reps", "3", "--platform", "default"],
      "TPU_TRANSFER_AB.json", 1200.0),
+    # The jepsen.independent shapes (stream witness all-valid + the
+    # invalid-heavy settling ladder, 200 keys x 100 ops): the CPU-mesh
+    # floors live in tests/test_whole_stack_perf.py; this step records
+    # the same shapes on the real chip, median of >=3 memo-cold reps.
+    ("independent_bench", [sys.executable,
+                           "tools/independent_bench.py",
+                           "--reps", "3", "--platform", "default"],
+     "TPU_INDEPENDENT_BENCH.json", 1200.0),
+    # The scale point as its own >=3-rep capture (the embedded bench
+    # point is single-rep inside whatever wall the primary left): the
+    # child mode is env-switched, hence the env overlay.
+    ("scale_point", [sys.executable, "bench.py"],
+     "TPU_SCALE_POINT.json", 1800.0,
+     {"JEPSEN_BENCH_SCALE_CHILD": "1", "JEPSEN_BENCH_SCALE_REPS": "3"}),
 ]
 
 
@@ -129,12 +155,14 @@ def main() -> int:
         result = probe_chip()
         log_line(f"probe={result} ({time.time() - t0:.1f}s)")
         while result == "ok":
-            pending = [(n, c, a, t) for n, c, a, t in BATTERY
-                       if not os.path.exists(os.path.join(REPO, a))]
+            pending = [step for step in BATTERY
+                       if not os.path.exists(os.path.join(REPO,
+                                                          step[2]))]
             if not pending:
                 break
-            name, cmd, artifact, timeout = pending[0]
-            if not run_capture(name, cmd, artifact, timeout):
+            name, cmd, artifact, timeout, *env = pending[0]
+            if not run_capture(name, cmd, artifact, timeout,
+                               env[0] if env else None):
                 break  # wedged or failed mid-window; retry next window
             result = probe_chip()  # still breathing? then next step
         if args.once:
