@@ -25,9 +25,9 @@ from typing import Any, Optional
 from . import db as jdb
 from . import interpreter, oses, store, telemetry
 from .checker.core import check_safe
-from .control import with_sessions
+from .control import Session, with_sessions
 from .history import History
-from .nemesis import Nemesis, noop as noop_nemesis
+from .nemesis import Nemesis, ledger as fault_ledger, noop as noop_nemesis
 from .utils import real_pmap
 
 log = logging.getLogger(__name__)
@@ -108,17 +108,39 @@ def run_case(test: dict, history_writer=None) -> History:
     nem = setup_nemesis(test)
     test = dict(test)
     test["nemesis"] = nem
+    primary: Optional[BaseException] = None
     try:
         with telemetry.span("lifecycle.client-setup"):
             _with_clients(test, "setup")
         with telemetry.span("lifecycle.interpreter"):
             return interpreter.run(test, writer=history_writer)
+    except BaseException as e:
+        primary = e
+        raise
     finally:
+        # Teardown failures must not mask the interpreter's primary
+        # exception — that's the one that explains the run.  Each phase
+        # is isolated; failures are logged + counted, and only surface
+        # as the raised error when the run itself succeeded.
+        errors: list[tuple[str, BaseException]] = []
         try:
             with telemetry.span("lifecycle.client-teardown"):
                 _with_clients(test, "teardown")
-        finally:
+        except Exception as e:  # noqa: BLE001
+            errors.append(("client", e))
+        try:
             nem.teardown(test)
+        except Exception as e:  # noqa: BLE001
+            errors.append(("nemesis", e))
+        for what, e in errors:
+            telemetry.count("nemesis.teardown.failed")
+            log.warning(
+                "%s teardown failed%s: %r", what,
+                " (primary exception takes precedence)" if primary else "",
+                e,
+            )
+        if errors and primary is None:
+            raise errors[0][1]
 
 
 def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
@@ -189,6 +211,13 @@ def _run_prepared(test: dict) -> dict:
             with store.Store(test) as st:
                 st.save_0(test)
                 hw = st.history_writer()
+                # The fault ledger journals every nemesis intent into
+                # the store dir (lazily — fault-free runs never create
+                # the file), so a killed control process leaves a
+                # durable record of what is still broken on the nodes.
+                test["fault-ledger"] = fault_ledger.FaultLedger(
+                    fault_ledger.ledger_path(store.test_dir(test))
+                )
                 with with_sessions(test):
                     try:
                         with telemetry.span("lifecycle.os-setup"):
@@ -221,16 +250,59 @@ def _run_prepared(test: dict) -> dict:
                                 jdb.teardown(test)
                             except Exception as e:  # noqa: BLE001
                                 log.warning("db teardown failed: %r", e)
+                            else:
+                                # A completed DB teardown kills every
+                                # daemon: db-kill/db-pause faults can't
+                                # outlive it, and their compensator
+                                # (restart a binary teardown just
+                                # removed) must not be replayed by a
+                                # later `repair`.
+                                led = test.get("fault-ledger")
+                                if (led is not None
+                                        and test.get("db") is not None
+                                        and os.path.exists(led.path)):
+                                    for tag in ("db-kill", "db-pause"):
+                                        led.heal_matching(
+                                            tag=tag, by="db-teardown"
+                                        )
                         try:
                             oses.teardown(test)
                         except Exception as e:  # noqa: BLE001
                             log.warning("os teardown failed: %r", e)
+                        # Residue sweep: only when faults were actually
+                        # journaled (fault-free runs skip it entirely),
+                        # while sessions are still open — its
+                        # nemesis.residue.* counters then land in the
+                        # results' resilience block.
+                        led = test.get("fault-ledger")
+                        if led is not None and os.path.exists(led.path):
+                            try:
+                                with telemetry.span(
+                                    "lifecycle.residue-sweep"
+                                ):
+                                    residue = fault_ledger.probe_residue(
+                                        test, ledger=led
+                                    )
+                                if not residue["clean"]:
+                                    log.warning(
+                                        "fault residue after teardown: "
+                                        "%s — run `jepsen repair %s`",
+                                        residue, store.test_dir(test),
+                                    )
+                            except Exception as e:  # noqa: BLE001
+                                log.warning("residue sweep failed: %r", e)
                 results = analyze(test, test["history"])
                 test["results"] = results
                 with telemetry.span("lifecycle.save"):
                     st.save_2(results)
                 log_results(results)
         finally:
+            led = test.pop("fault-ledger", None)
+            if led is not None:
+                try:
+                    led.close()
+                except Exception:  # noqa: BLE001
+                    pass
             store.stop_logging(handler)
     return test
 
@@ -266,3 +338,98 @@ def rerun_analysis(test_dir: str, test: dict) -> dict:
         return merged
     finally:
         tf.close()
+
+
+def repair(test_dir: str, test: Optional[dict] = None) -> dict:
+    """Recovers a crashed run's cluster: loads the fault ledger from
+    `test_dir`, reopens sessions, replays outstanding compensators
+    newest-first (reverse injection order), journals a healed record
+    for each success, and finishes with a residue probe sweep — the
+    `jepsen repair` CLI subcommand.
+
+    `test` supplies live objects the stored map cannot carry (remote,
+    ssh opts, db for db-start/db-resume compensators); the stored test
+    map fills nodes and the rest.  Session opening is per-node
+    best-effort — one unreachable node is reported in "unreachable",
+    not fatal, and healing proceeds on the rest.
+
+    Returns {"outstanding": n, "healed": [ids], "failed": {id: result},
+    "unreachable": {node: err}, "residue": sweep, "clean": bool}.
+    Running repair on a clean dir (or twice) is a no-op."""
+    path = fault_ledger.ledger_path(test_dir)
+    outstanding = fault_ledger.outstanding_entries(
+        fault_ledger.read_records(path)
+    )
+
+    stored: dict = {}
+    tf_path = os.path.join(test_dir, store.TEST_FILE)
+    if os.path.exists(tf_path):
+        tf = store.load(test_dir)
+        try:
+            stored = tf.test or {}
+        finally:
+            tf.close()
+    test = test or {}
+    merged = {**test, **stored}
+    for k in store.NONSERIALIZABLE_KEYS:
+        if k in test:
+            merged[k] = test[k]
+
+    report: dict[str, Any] = {
+        "ledger": path,
+        "outstanding": len(outstanding),
+        "healed": [],
+        "failed": {},
+        "unreachable": {},
+    }
+    if not outstanding:
+        log.info("repair %s: ledger clean, nothing to do", test_dir)
+        report["residue"] = {"clean": True, "outstanding": 0, "nodes": {}}
+        report["clean"] = True
+        return report
+
+    sessions: dict[str, Session] = {}
+    for node in merged.get("nodes") or []:
+        try:
+            sessions[node] = Session.connect(merged, node)
+        except Exception as e:  # noqa: BLE001 — heal the reachable rest
+            log.warning("repair: cannot reach %s: %r", node, e)
+            report["unreachable"][node] = f"{type(e).__name__}: {e}"
+    merged["sessions"] = sessions
+
+    # Reopening truncates any torn tail the dying writer left, so the
+    # healed records land in a valid file.
+    led = fault_ledger.FaultLedger(path)
+    try:
+        for entry in outstanding:
+            res = fault_ledger.run_compensator(merged, entry)
+            if res["ok"]:
+                led.healed(entry["id"], by="repair")
+                report["healed"].append(entry["id"])
+                log.info(
+                    "repair: healed entry %s (%s/%s)", entry["id"],
+                    entry.get("fault"), entry.get("tag") or "-",
+                )
+            else:
+                report["failed"][entry["id"]] = res
+                log.warning(
+                    "repair: entry %s (%s/%s) NOT healed: %s",
+                    entry["id"], entry.get("fault"),
+                    entry.get("tag") or "-",
+                    res.get("error") or res.get("nodes"),
+                )
+        report["residue"] = fault_ledger.probe_residue(merged, ledger=led)
+    finally:
+        led.close()
+        for s in sessions.values():
+            try:
+                s.disconnect()
+            except Exception:  # noqa: BLE001
+                pass
+        merged.pop("sessions", None)
+    report["clean"] = (
+        report["residue"]["clean"]
+        and not report["failed"]
+        and not report["unreachable"]
+    )
+    return report

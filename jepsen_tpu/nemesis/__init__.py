@@ -4,10 +4,12 @@ Equivalent of /root/reference/jepsen/src/jepsen/nemesis.clj plus the
 nemesis/ subtree (combined packages, clock faults, membership churn).
 """
 
+from . import ledger
 from .core import (
     Compose,
     FMap,
     Nemesis,
+    NemesisTeardownError,
     NoopNemesis,
     Partitioner,
     Timeout,
@@ -32,7 +34,9 @@ __all__ = [
     "Compose",
     "FMap",
     "Nemesis",
+    "NemesisTeardownError",
     "NoopNemesis",
+    "ledger",
     "Partitioner",
     "Timeout",
     "bisect",

@@ -18,6 +18,7 @@ compose, like the reference.
 
 from __future__ import annotations
 
+import logging
 import random
 from typing import Any, Optional, Sequence
 
@@ -36,6 +37,8 @@ from .core import (
     split_one,
 )
 from .faults import Bitflip, ClockNemesis, DBNemesis, TruncateFile
+
+log = logging.getLogger(__name__)
 
 DEFAULT_INTERVAL = 10.0  # seconds between fault transitions (:22-24)
 
@@ -178,9 +181,14 @@ def packet_package(opts: dict) -> Optional[dict]:
             net = test.get("net")
             if net is not None:
                 try:
+                    # shape(None) -> net.fast, which journals the heal
+                    # (or leaves entries outstanding when abandoned).
                     net.shape(test, None)
-                except Exception:  # noqa: BLE001
-                    pass
+                except Exception as e:  # noqa: BLE001
+                    log.warning(
+                        "packet shaping teardown failed — netem may be "
+                        "stranded (see the fault ledger): %r", e,
+                    )
 
         def fs(self):
             return {"start-packet", "stop-packet"}

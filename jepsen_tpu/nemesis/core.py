@@ -14,11 +14,15 @@ network-manipulation `Net` interface carried in ``test["net"]``.
 
 from __future__ import annotations
 
+import logging
 import random
 from typing import Any, Callable, Iterable, Mapping, Optional, Sequence
 
 from ..history import INFO, Op
 from ..utils import JepsenTimeout, majority, timeout as run_timeout
+from . import ledger as fault_ledger
+
+log = logging.getLogger(__name__)
 
 
 class Nemesis:
@@ -175,19 +179,37 @@ class Partitioner(Nemesis):
                 raise ValueError(
                     "partition start op needs a grudge value or grudge_fn"
                 )
+            fault_ledger.intent(
+                test,
+                "partition",
+                nodes=sorted(str(n) for n in grudge),
+                params={"grudge": {str(k): sorted(v) for k, v in
+                                   grudge.items()}},
+                compensator={
+                    "type": "net-heal",
+                    "mech": fault_ledger.net_mech(net),
+                },
+            )
             net.drop_all(test, grudge)
             return op.replace(
                 value={k: sorted(v) for k, v in grudge.items()}
             )
         elif op.f == "stop":
+            if fault_ledger.heal_guard():
+                return op.replace(value="network heal abandoned")
             net.heal(test)
+            fault_ledger.healed(test, fault="partition")
             return op.replace(value="network healed")
         raise ValueError(f"partitioner got unknown f {op.f!r}")
 
     def teardown(self, test: dict) -> None:
         net = test.get("net")
-        if net is not None:
-            net.heal(test)
+        if net is None:
+            return
+        if fault_ledger.heal_guard():
+            return
+        net.heal(test)
+        fault_ledger.healed(test, fault="partition", by="teardown")
 
     def fs(self) -> set:
         return {"start", "stop"}
@@ -257,6 +279,21 @@ def f_map(fmap: Mapping[Any, Any], nem: Nemesis) -> FMap:
     return FMap(fmap, nem)
 
 
+class NemesisTeardownError(Exception):
+    """Aggregate of per-child Compose teardown failures: every child got
+    its teardown attempt; these are the ones that failed."""
+
+    def __init__(self, failures: list[tuple["Nemesis", BaseException]]):
+        self.failures = failures
+        super().__init__(
+            "nemesis teardown failed for "
+            + "; ".join(
+                f"{type(nem).__name__}: {type(e).__name__}: {e}"
+                for nem, e in failures
+            )
+        )
+
+
 class Compose(Nemesis):
     """Routes ops to one of several nemeses by :f (nemesis.clj:385-429).
     Takes a plain list of nemeses (fs taken from Reflection) or a list
@@ -308,8 +345,20 @@ class Compose(Nemesis):
         return self._route(op.f).invoke(test, op)
 
     def teardown(self, test: dict) -> None:
+        # One failing child must not strand its siblings' faults: every
+        # child gets its teardown attempt, then the failures surface as
+        # one aggregate.
+        failures: list[tuple[Nemesis, BaseException]] = []
         for _, nem in self.entries:
-            nem.teardown(test)
+            try:
+                nem.teardown(test)
+            except Exception as e:  # noqa: BLE001 — aggregated below
+                log.warning(
+                    "nemesis %s teardown failed: %r", type(nem).__name__, e
+                )
+                failures.append((nem, e))
+        if failures:
+            raise NemesisTeardownError(failures)
 
     def fs(self) -> set:
         out: set = set()

@@ -18,6 +18,8 @@ from typing import Any, Callable, Optional, Sequence
 
 from ..control import Session, on_nodes
 from ..history import Op
+from ..utils import with_retry
+from . import ledger as fault_ledger
 from .core import Nemesis, _rng
 
 log = logging.getLogger(__name__)
@@ -54,11 +56,34 @@ class DBNemesis(Nemesis):
             "resume": "resume",
         }[op.f]
 
+        if method == "kill":
+            fault_ledger.intent(
+                test, "process", nodes=[str(n) for n in nodes],
+                params={"f": "kill"},
+                compensator={"type": "db-start",
+                             "nodes": [str(n) for n in nodes]},
+                tag="db-kill",
+            )
+        elif method == "pause":
+            fault_ledger.intent(
+                test, "process", nodes=[str(n) for n in nodes],
+                params={"f": "pause"},
+                compensator={"type": "db-resume",
+                             "nodes": [str(n) for n in nodes]},
+                tag="db-pause",
+            )
+        elif fault_ledger.heal_guard():
+            return op.replace(value="heal abandoned")
+
         def act(sess: Session, node: str):
             getattr(db, method)(test, sess, node)
             return "done"
 
         res = on_nodes(test, act, nodes)
+        if method == "start":
+            fault_ledger.healed(test, tag="db-kill")
+        elif method == "resume":
+            fault_ledger.healed(test, tag="db-pause")
         return op.replace(value=res)
 
     def fs(self) -> set:
@@ -74,13 +99,27 @@ class HammerTime(Nemesis):
     def invoke(self, test: dict, op: Op) -> Op:
         sig = {"start": "STOP", "stop": "CONT"}[op.f]
         nodes = _pick_nodes(test, op.value)
+        if sig == "STOP":
+            fault_ledger.intent(
+                test, "process", nodes=[str(n) for n in nodes],
+                params={"process": self.process_name, "signal": "STOP"},
+                compensator={"type": "sigcont",
+                             "process": self.process_name,
+                             "nodes": [str(n) for n in nodes]},
+                tag="hammer",
+            )
+        elif fault_ledger.heal_guard():
+            return op.replace(value="heal abandoned")
 
         def act(sess: Session, node: str):
             with sess.su():
                 sess.exec_star("pkill", f"-{sig}", "-f", self.process_name)
             return f"SIG{sig}"
 
-        return op.replace(value=on_nodes(test, act, nodes))
+        res = on_nodes(test, act, nodes)
+        if sig == "CONT":
+            fault_ledger.healed(test, tag="hammer")
+        return op.replace(value=res)
 
     def fs(self) -> set:
         return {"start", "stop"}
@@ -102,15 +141,30 @@ def node_start_stopper(
         def invoke(self, test: dict, op: Op) -> Op:
             if op.f == "start":
                 nodes = list(targeter(test, list(test.get("nodes") or [])))
+                # The heal is an arbitrary closure — not data-describable,
+                # so repair can only report it, not replay it.
+                fault_ledger.intent(
+                    test, "process", nodes=[str(n) for n in nodes],
+                    params={"f": "start"},
+                    compensator={
+                        "type": "unreplayable",
+                        "note": "node_start_stopper closure; re-run its "
+                                "stop by hand",
+                    },
+                    tag="start-stopper",
+                )
                 res = on_nodes(
                     test, lambda s, n: start(test, s, n), nodes
                 )
                 self.affected = nodes
                 return op.replace(value=res)
             elif op.f == "stop":
+                if fault_ledger.heal_guard():
+                    return op.replace(value="heal abandoned")
                 nodes = self.affected or list(test.get("nodes") or [])
                 res = on_nodes(test, lambda s, n: stop(test, s, n), nodes)
                 self.affected = []
+                fault_ledger.healed(test, tag="start-stopper")
                 return op.replace(value=res)
             raise ValueError(f"unknown f {op.f!r}")
 
@@ -190,6 +244,13 @@ class ClockNemesis(Nemesis):
                 return delta
 
             nodes = list(spec.keys())
+            fault_ledger.intent(
+                test, "clock", nodes=[str(n) for n in nodes],
+                params={"f": "bump",
+                        "deltas_ms": {str(n): spec[n] for n in nodes}},
+                compensator={"type": "clock-reset",
+                             "nodes": [str(n) for n in nodes]},
+            )
             res = on_nodes(test, bump, nodes)
             return op.replace(value={
                 "bumped": res,
@@ -209,12 +270,22 @@ class ClockNemesis(Nemesis):
                     )
                 return "strobed"
 
+            fault_ledger.intent(
+                test, "clock", nodes=[str(n) for n in nodes],
+                params={"f": "strobe", "delta": v.get("delta", 200),
+                        "period": v.get("period", 10),
+                        "duration": v.get("duration", 1000)},
+                compensator={"type": "clock-reset",
+                             "nodes": [str(n) for n in nodes]},
+            )
             res = on_nodes(test, strobe, nodes)
             return op.replace(value={
                 "strobed": res,
                 "clock-offsets": self._offsets(test, nodes),
             })
         if op.f == "reset":
+            if fault_ledger.heal_guard():
+                return op.replace(value="heal abandoned")
             nodes = _pick_nodes(test, op.value)
 
             def reset(sess: Session, node: str):
@@ -223,6 +294,7 @@ class ClockNemesis(Nemesis):
                 return "reset"
 
             res = on_nodes(test, reset, nodes)
+            fault_ledger.healed(test, fault="clock")
             return op.replace(value={
                 "reset": res,
                 "clock-offsets": self._offsets(test, nodes),
@@ -234,13 +306,39 @@ class ClockNemesis(Nemesis):
         raise ValueError(f"unknown clock f {op.f!r}")
 
     def teardown(self, test: dict) -> None:
-        def heal(sess: Session, node: str):
-            sess.exec_star("ntpdate", "-b", "pool.ntp.org")
+        # Per-node, best-effort, retried: one unreachable node cannot
+        # abort resetting the rest, and a failed reset is stranded clock
+        # skew — warn loudly and leave its ledger entries outstanding
+        # for `jepsen repair` / the residue sweep.
+        if fault_ledger.heal_guard():
+            return
 
-        try:
-            on_nodes(test, heal)
-        except Exception as e:  # noqa: BLE001
-            log.debug("clock teardown failed: %r", e)
+        def reset_node(sess: Session) -> None:
+            with sess.su():
+                sess.exec_star("ntpdate", "-b", "pool.ntp.org")
+                # Restart the time daemons setup stopped.
+                sess.exec_star("systemctl", "start", "ntp", "chronyd",
+                               "systemd-timesyncd")
+
+        failed: list = []
+        for node, sess in (test.get("sessions") or {}).items():
+            try:
+                with_retry(
+                    lambda s=sess: reset_node(s),
+                    retries=2, backoff_ms=100.0,
+                )
+            except Exception as e:  # noqa: BLE001 — continue to siblings
+                log.warning(
+                    "clock reset failed on %s during teardown: %r", node, e
+                )
+                failed.append(node)
+        if failed:
+            log.warning(
+                "clock skew may be stranded on %s — ledger entries left "
+                "outstanding for `jepsen repair`", failed,
+            )
+        else:
+            fault_ledger.healed(test, fault="clock", by="teardown")
 
     def fs(self) -> set:
         return {"bump", "strobe", "reset", "check-offsets"}

@@ -32,6 +32,7 @@ from typing import Any, Optional
 from ..control import on_nodes
 from ..generator.core import PENDING, Generator, fill_in_op, stagger
 from ..history import Op
+from . import ledger as fault_ledger
 from .core import Nemesis
 
 log = logging.getLogger(__name__)
@@ -104,6 +105,10 @@ class MembershipNemesis(Nemesis):
         self.view_interval = view_interval
         self._stop = threading.Event()
         self._pollers: list[threading.Thread] = []
+        # Ledger entry ids per pending pair, keyed by id(pair): user
+        # code unpacks pending pairs as 2-tuples, so the id cannot ride
+        # the list itself.
+        self._intents: dict[int, int] = {}
 
     # -- resolution --------------------------------------------------------
 
@@ -117,6 +122,10 @@ class MembershipNemesis(Nemesis):
                 if st.resolve_op(test, pair):
                     log.info("resolved membership op: %s", pair[0])
                     st.pending.remove(pair)
+                    eid = self._intents.pop(id(pair), None)
+                    if eid is not None:
+                        fault_ledger.healed(test, entry_id=eid,
+                                            by="resolve")
                     changed = True
             if not changed:
                 return
@@ -167,8 +176,24 @@ class MembershipNemesis(Nemesis):
 
     def invoke(self, test: dict, op: Op) -> Op:
         with self.lock:
+            # Membership changes have no mechanical inverse the framework
+            # could replay (the state machine owns the cluster logic), so
+            # the ledger records them for the repair report only.
+            eid = fault_ledger.intent(
+                test, "membership",
+                params={"f": op.f, "value": op.value},
+                compensator={
+                    "type": "unreplayable",
+                    "note": "membership change; converge via the state "
+                            "machine or operator action",
+                },
+                tag="membership",
+            )
             op2 = self.state.invoke(test, op)
-            self.state.pending.append([op, op2])
+            pair = [op, op2]
+            self.state.pending.append(pair)
+            if eid is not None:
+                self._intents[id(pair)] = eid
             self._resolve(test)
             return op2
 

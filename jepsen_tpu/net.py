@@ -24,6 +24,7 @@ from typing import Any, Mapping, Optional, Sequence
 
 from .control import Session, on_nodes
 from .control.core import split_host_port
+from .nemesis import ledger as fault_ledger
 
 
 def node_address(test: dict, node: str) -> str:
@@ -139,10 +140,25 @@ class TcShapingNet(Net):
     def __init__(self, dev: str = "eth0"):
         self.dev = dev
 
+    def _shaping_intent(self, test: dict, params: dict,
+                        nodes: Optional[Sequence[str]] = None) -> None:
+        """Journals a netem/tbf shaping fault; the compensator is always
+        the same qdisc delete, whatever the behavior was."""
+        targets = list(nodes) if nodes else list(test.get("nodes") or [])
+        fault_ledger.intent(
+            test, "netem", nodes=[str(n) for n in targets],
+            params=params,
+            compensator={"type": "tc-del", "dev": self.dev,
+                         "nodes": [str(n) for n in targets]},
+        )
+
     def slow(self, test: dict, **opts: Any) -> None:
         mean = opts.get("mean", 50)
         variance = opts.get("variance", 10)
         dist = opts.get("distribution", "normal")
+        self._shaping_intent(
+            test, {"f": "slow", "mean": mean, "variance": variance}
+        )
 
         def do(sess: Session, node: str) -> None:
             with sess.su():
@@ -155,6 +171,8 @@ class TcShapingNet(Net):
         on_nodes(test, do)
 
     def flaky(self, test: dict) -> None:
+        self._shaping_intent(test, {"f": "flaky", "loss": "20%"})
+
         def do(sess: Session, node: str) -> None:
             with sess.su():
                 sess.exec(
@@ -165,6 +183,9 @@ class TcShapingNet(Net):
         on_nodes(test, do)
 
     def fast(self, test: dict) -> None:
+        if fault_ledger.heal_guard():
+            return
+
         def do(sess: Session, node: str) -> None:
             with sess.su():
                 # Deleting a nonexistent qdisc fails; ignore like the
@@ -175,11 +196,15 @@ class TcShapingNet(Net):
                 del res
 
         on_nodes(test, do)
+        fault_ledger.healed(test, fault="netem")
 
     def shape(self, test: dict, behavior, nodes=None) -> None:
         if not behavior:
             self.fast(test)
             return
+        self._shaping_intent(
+            test, {"f": "shape", "behavior": dict(behavior)}, nodes
+        )
         args = self._shape_args(behavior)
 
         def do(sess: Session, node: str) -> None:

@@ -35,6 +35,9 @@ NONSERIALIZABLE_KEYS = (
     "sessions",
     "barrier",
     "store",
+    # Live FaultLedger handle; its durable form is nemesis.ledger in
+    # the same store dir.
+    "fault-ledger",
     # Run outputs saved in their own blocks, not inside the test map:
     "history",
     "results",

@@ -45,6 +45,9 @@ BLOCK_INDEX = 1
 BLOCK_TEST = 2
 BLOCK_CHUNK = 3
 BLOCK_RESULTS = 4
+#: Fault-ledger record (nemesis/ledger.py): one intent/healed entry per
+#: block, appended + fsynced before/after each cluster-touching fault.
+BLOCK_LEDGER = 5
 
 #: Ops per sealed history chunk (format.clj:372-375).
 CHUNK_SIZE = 16384

@@ -247,6 +247,10 @@ RESILIENCE_COUNTER_PREFIXES = (
     "checker.budget-exceeded",
     "wgl.degrade.",
     "daemon.start-retries",
+    # Fault-ledger events: nemesis.residue.* (stranded iptables/tc/
+    # clock state found by the post-teardown sweep), nemesis.teardown.
+    # failed, nemesis.ledger.{intents,healed}.
+    "nemesis.",
 )
 
 

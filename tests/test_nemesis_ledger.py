@@ -1,0 +1,366 @@
+"""Fault-ledger tests: intent-before-inject ordering, crash-mid-inject
+and crash-mid-heal via the JEPSEN_NEMESIS_FAULT hook, repair (including
+idempotence), Compose aggregate teardown, run_case primary-exception
+precedence, and ledger readability after torn writes (the BlockWriter
+`_valid_end` recovery)."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                "tools"))
+
+from jepsen_tpu import core, net as jnet, telemetry
+from jepsen_tpu.history import Op
+from jepsen_tpu.nemesis import (
+    Compose,
+    Nemesis,
+    NemesisTeardownError,
+    compose,
+    ledger,
+    partitioner,
+)
+from jepsen_tpu.nemesis.core import complete_grudge, bisect
+from jepsen_tpu.store import format as store_format
+
+
+@pytest.fixture
+def telem():
+    old = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.enable(old)
+
+
+class FakeNet(jnet.Net):
+    """Records calls; optionally raises on drop_all."""
+
+    def __init__(self, fail_drop=False):
+        self.calls = []
+        self.fail_drop = fail_drop
+
+    def drop_all(self, test, grudge):
+        self.calls.append("drop_all")
+        if self.fail_drop:
+            raise RuntimeError("cluster unreachable mid-inject")
+
+    def heal(self, test):
+        self.calls.append("heal")
+
+
+def _test_map(tmp_path, net=None):
+    led = ledger.FaultLedger(str(tmp_path / ledger.LEDGER_FILE))
+    return {
+        "nodes": ["n1", "n2", "n3"],
+        "net": net if net is not None else FakeNet(),
+        "fault-ledger": led,
+    }
+
+
+def _start(value=None):
+    return Op(type="info", f="start", value=value)
+
+
+def _stop():
+    return Op(type="info", f="stop")
+
+
+# -- intent-before-inject ordering ---------------------------------------
+
+
+def test_intent_journaled_before_cluster_touch(tmp_path):
+    """The intent record must hit the ledger before the net is touched:
+    even when the injection itself crashes, the fault is on record."""
+    net = FakeNet(fail_drop=True)
+    t = _test_map(tmp_path, net)
+    nem = partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+    with pytest.raises(RuntimeError):
+        nem.invoke(t, _start())
+    out = t["fault-ledger"].outstanding()
+    assert len(out) == 1
+    assert out[0]["fault"] == "partition"
+    assert out[0]["comp"]["type"] == "net-heal"
+    assert net.calls == ["drop_all"]
+
+
+def test_start_stop_cycle_settles_ledger(tmp_path):
+    t = _test_map(tmp_path)
+    nem = partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+    nem.invoke(t, _start())
+    assert len(t["fault-ledger"].outstanding()) == 1
+    nem.invoke(t, _stop())
+    assert t["fault-ledger"].outstanding() == []
+
+
+def test_no_ledger_bound_is_harmless(tmp_path):
+    """Library use without a run lifecycle: nemeses still work, and no
+    ledger file appears anywhere."""
+    net = FakeNet()
+    t = {"nodes": ["n1", "n2"], "net": net}
+    nem = partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+    nem.invoke(t, _start())
+    nem.invoke(t, _stop())
+    assert net.calls == ["drop_all", "heal"]
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_fault_free_run_creates_no_ledger_file(tmp_path):
+    """The lazy-open contract: a ledger that never records an intent
+    never creates its file (no overhead on fault-free runs)."""
+    led = ledger.FaultLedger(str(tmp_path / ledger.LEDGER_FILE))
+    assert led.outstanding() == []
+    led.close()
+    assert not os.path.exists(led.path)
+
+
+# -- the JEPSEN_NEMESIS_FAULT hook ---------------------------------------
+
+
+def test_crash_mid_inject_leaves_outstanding_entry(tmp_path, monkeypatch):
+    monkeypatch.setenv(ledger.FAULT_ENV, "inject")
+    net = FakeNet()
+    t = _test_map(tmp_path, net)
+    nem = partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+    with pytest.raises(ledger.InjectedNemesisFault):
+        nem.invoke(t, _start())
+    # The session dropped after journaling, before touching the net:
+    # the entry is outstanding, the cluster untouched (so the spurious
+    # compensator replay is the safe direction).
+    assert net.calls == []
+    assert len(t["fault-ledger"].outstanding()) == 1
+
+
+def test_crash_mid_heal_keeps_entry_outstanding(tmp_path, monkeypatch):
+    net = FakeNet()
+    t = _test_map(tmp_path, net)
+    nem = partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+    nem.invoke(t, _start())
+    monkeypatch.setenv(ledger.FAULT_ENV, "heal")
+    with pytest.raises(ledger.InjectedNemesisFault):
+        nem.invoke(t, _stop())
+    assert net.calls == ["drop_all"]  # heal never ran
+    assert len(t["fault-ledger"].outstanding()) == 1
+    # Teardown is a heal path too.
+    with pytest.raises(ledger.InjectedNemesisFault):
+        nem.teardown(t)
+    assert len(t["fault-ledger"].outstanding()) == 1
+
+
+def test_abandon_skips_heal_silently(tmp_path, monkeypatch):
+    net = FakeNet()
+    t = _test_map(tmp_path, net)
+    nem = partitioner(lambda nodes: complete_grudge(bisect(nodes)))
+    nem.invoke(t, _start())
+    monkeypatch.setenv(ledger.FAULT_ENV, "abandon")
+    op2 = nem.invoke(t, _stop())
+    assert "abandoned" in op2.value
+    nem.teardown(t)
+    assert net.calls == ["drop_all"]
+    assert len(t["fault-ledger"].outstanding()) == 1
+
+
+# -- repair ---------------------------------------------------------------
+
+
+def _stranded_dir(tmp_path, comp=None):
+    """A test dir whose ledger holds one outstanding sigcont entry."""
+    d = tmp_path / "run"
+    d.mkdir()
+    led = ledger.FaultLedger(ledger.ledger_path(str(d)))
+    led.intent(
+        "process", nodes=["n1", "n2"],
+        compensator=comp or {"type": "sigcont", "process": "regd",
+                             "nodes": ["n1", "n2"]},
+        tag="hammer",
+    )
+    led.close()
+    return str(d)
+
+
+REPAIR_TEST = {"nodes": ["n1", "n2"], "ssh": {"dummy?": True}}
+
+
+def test_repair_heals_and_is_idempotent(tmp_path):
+    d = _stranded_dir(tmp_path)
+    report = core.repair(d, dict(REPAIR_TEST))
+    assert report["outstanding"] == 1
+    assert report["healed"] and not report["failed"]
+    assert report["clean"], report
+    # Twice = no-op.
+    report2 = core.repair(d, dict(REPAIR_TEST))
+    assert report2["outstanding"] == 0
+    assert report2["clean"] and not report2["healed"]
+
+
+def test_repair_fault_site_marks_entry_failed(tmp_path, monkeypatch):
+    d = _stranded_dir(tmp_path)
+    monkeypatch.setenv(ledger.FAULT_ENV, "repair")
+    report = core.repair(d, dict(REPAIR_TEST))
+    assert report["failed"] and not report["healed"]
+    assert not report["clean"]
+    # The entry stayed outstanding; a later repair (hook cleared) heals.
+    monkeypatch.delenv(ledger.FAULT_ENV)
+    report2 = core.repair(d, dict(REPAIR_TEST))
+    assert report2["healed"] and report2["clean"]
+
+
+def test_repair_reports_unreplayable_compensators(tmp_path):
+    d = _stranded_dir(
+        tmp_path, comp={"type": "unreplayable", "note": "closure"}
+    )
+    report = core.repair(d, dict(REPAIR_TEST))
+    assert not report["clean"]
+    (res,) = report["failed"].values()
+    assert "unreplayable" in res["error"]
+
+
+# -- Compose aggregate teardown ------------------------------------------
+
+
+class _TeardownProbe(Nemesis):
+    def __init__(self, name, fail=False):
+        self.name = name
+        self.fail = fail
+        self.torn = False
+
+    def invoke(self, test, op):
+        return op
+
+    def teardown(self, test):
+        self.torn = True
+        if self.fail:
+            raise RuntimeError(f"{self.name} teardown boom")
+
+    def fs(self):
+        return {self.name}
+
+
+def test_compose_teardown_reaches_all_children_and_aggregates():
+    kids = [
+        _TeardownProbe("a", fail=True),
+        _TeardownProbe("b"),
+        _TeardownProbe("c", fail=True),
+        _TeardownProbe("d"),
+    ]
+    nem = compose(kids)
+    with pytest.raises(NemesisTeardownError) as ei:
+        nem.teardown({})
+    assert all(k.torn for k in kids), "a failing child stranded siblings"
+    assert len(ei.value.failures) == 2
+    msg = str(ei.value)
+    assert "a teardown boom" in msg and "c teardown boom" in msg
+
+
+def test_compose_teardown_clean_path():
+    kids = [_TeardownProbe("a"), _TeardownProbe("b")]
+    compose(kids).teardown({})
+    assert all(k.torn for k in kids)
+
+
+# -- run_case: teardown must not mask the primary exception ---------------
+
+
+class _FailingTeardownNemesis(Nemesis):
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        return op
+
+    def teardown(self, test):
+        raise RuntimeError("nemesis teardown boom")
+
+
+def test_run_case_primary_exception_wins(monkeypatch, telem):
+    def explode(test, writer=None):
+        raise ValueError("interpreter primary failure")
+
+    monkeypatch.setattr(core.interpreter, "run", explode)
+    t = {"nodes": ["n1"], "nemesis": _FailingTeardownNemesis()}
+    with pytest.raises(ValueError, match="interpreter primary failure"):
+        core.run_case(t)
+    assert telemetry.resilience_counters()["nemesis.teardown.failed"] == 1
+
+
+def test_run_case_surfaces_teardown_failure_when_run_succeeds(
+    monkeypatch, telem
+):
+    monkeypatch.setattr(core.interpreter, "run",
+                        lambda test, writer=None: "history")
+    t = {"nodes": ["n1"], "nemesis": _FailingTeardownNemesis()}
+    with pytest.raises(RuntimeError, match="nemesis teardown boom"):
+        core.run_case(t)
+    assert telemetry.resilience_counters()["nemesis.teardown.failed"] == 1
+
+
+# -- crash recovery of the ledger file itself -----------------------------
+
+
+def test_ledger_survives_torn_tail(tmp_path):
+    path = str(tmp_path / ledger.LEDGER_FILE)
+    led = ledger.FaultLedger(path)
+    i1 = led.intent("partition", compensator={"type": "net-heal"})
+    i2 = led.intent("clock", compensator={"type": "clock-reset"})
+    led.close()
+
+    # Tear the tail mid-block, like a dying writer would.
+    whole = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.write(os.urandom(0))  # no-op; keep handle semantics obvious
+        f.truncate(whole - 7)
+
+    records = ledger.read_records(path)
+    assert [r["id"] for r in records] == [i1]  # torn block ignored
+    out = ledger.outstanding_entries(records)
+    assert [e["id"] for e in out] == [i1]
+
+    # Reopening truncates the tear (BlockWriter._valid_end) and appends
+    # cleanly; new ids continue past every readable record.
+    led2 = ledger.FaultLedger(path)
+    i3 = led2.intent("netem", compensator={"type": "tc-del"})
+    assert i3 == i1 + 1
+    led2.healed(i1, by="repair")
+    led2.close()
+    size = os.path.getsize(path)
+    assert store_format._valid_end(path, size) == size
+    assert [e["id"] for e in ledger.FaultLedger(path).outstanding()] == [i3]
+
+
+def test_ledger_ignores_foreign_and_garbage_files(tmp_path):
+    not_jtpu = tmp_path / "x.ledger"
+    not_jtpu.write_bytes(b"definitely not a ledger")
+    assert ledger.read_records(str(not_jtpu)) == []
+    assert ledger.read_records(str(tmp_path / "missing")) == []
+
+
+def test_heal_matching_filters(tmp_path):
+    led = ledger.FaultLedger(str(tmp_path / ledger.LEDGER_FILE))
+    a = led.intent("process", tag="db-kill",
+                   compensator={"type": "db-start"})
+    b = led.intent("process", tag="hammer",
+                   compensator={"type": "sigcont"})
+    c = led.intent("clock", compensator={"type": "clock-reset"})
+    assert led.heal_matching(tag="db-kill") == [a]
+    assert {e["id"] for e in led.outstanding()} == {b, c}
+    assert led.heal_matching(fault="clock") == [c]
+    assert led.heal_matching(fault="clock") == []  # already healed
+    led.close()
+
+
+# -- the fifth fault-matrix cell, pytest-reachable ------------------------
+
+
+@pytest.mark.slow
+def test_fault_matrix_nemesis_crash_cell(tmp_path):
+    from fault_matrix import scenario_nemesis_crash
+
+    detail = scenario_nemesis_crash(str(tmp_path / "store"))
+    assert detail["stranded_families"] == [
+        "clock", "netem", "partition", "process"
+    ]
+    assert detail["healed"] == detail["stranded_entries"]
+    assert detail["second_repair_outstanding"] == 0

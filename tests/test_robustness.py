@@ -471,8 +471,10 @@ def test_fault_matrix_all_cells(tmp_path):
 
     out = run_matrix()
     assert set(out) == {"hanging-client", "hanging-checker",
-                        "crashing-checker", "wgl-fault"}
+                        "crashing-checker", "wgl-fault",
+                        "nemesis-crash"}
     assert "device" in out["wgl-fault"]["degraded_tiers"]
+    assert out["nemesis-crash"]["second_repair_outstanding"] == 0
 
 
 # -- surfacing ----------------------------------------------------------

@@ -289,11 +289,98 @@ def scenario_wgl_fault(store_dir: str) -> dict:
     }
 
 
+def scenario_nemesis_crash(store_dir: str) -> dict:
+    """Control-plane crash mid-fault, then `jepsen repair`: all four
+    fault families (partition, netem, clock, process) are injected and
+    their heals abandoned via JEPSEN_NEMESIS_FAULT=abandon — the
+    in-test stand-in for a SIGKILL'd control process.  The run must
+    leave outstanding ledger entries on disk (and count them as
+    nemesis.residue.outstanding), and `core.repair` must replay every
+    compensator until the residue sweep reports clean — twice, since
+    repairing a clean dir is a no-op."""
+    import random
+
+    from jepsen_tpu import core, generator as gen, net as jnet
+    from jepsen_tpu import store, telemetry
+    from jepsen_tpu.nemesis import combined as ncombined, core as ncore
+    from jepsen_tpu.nemesis import ledger as nledger
+    from jepsen_tpu.nemesis.faults import ClockNemesis, HammerTime
+
+    packet_nem = ncombined.packet_package(
+        {"faults": {"packet"}, "interval": 0.05}
+    )["nemesis"]
+    nem = ncore.compose([
+        ({"start-partition": "start", "stop-partition": "stop"},
+         ncore.partitioner(
+             lambda nodes: ncore.complete_grudge(ncore.bisect(nodes)))),
+        packet_nem,
+        ClockNemesis(),
+        ({"start-hammer": "start", "stop-hammer": "stop"},
+         HammerTime("regd")),
+    ])
+    nem_gen = [
+        {"type": "info", "f": "start-partition", "value": None},
+        {"type": "info", "f": "start-packet"},
+        {"type": "info", "f": "bump", "value": 1000},
+        {"type": "info", "f": "start-hammer", "value": None},
+    ]
+    client_gen = gen.stagger(0.005, gen.mix([
+        gen.FnGen(lambda: {"f": "read"}),
+        gen.FnGen(lambda: {"f": "write", "value": random.randrange(5)}),
+    ]))
+    test = _register_test(
+        store_dir,
+        net=jnet.iptables,  # real net impl; commands no-op on dummy remotes
+        nemesis=nem,
+        generator=gen.time_limit(0.8, gen.nemesis(nem_gen, client_gen)),
+    )
+    old_fault = os.environ.get(nledger.FAULT_ENV)
+    was_enabled = telemetry.enabled()
+    os.environ[nledger.FAULT_ENV] = "abandon"
+    telemetry.enable(True)
+    try:
+        test = _run_with_deadline(test)
+    finally:
+        if old_fault is None:
+            os.environ.pop(nledger.FAULT_ENV, None)
+        else:
+            os.environ[nledger.FAULT_ENV] = old_fault
+        telemetry.enable(was_enabled)
+    _assert_history_saved(test)
+
+    d = store.test_dir(test)
+    led_path = nledger.ledger_path(d)
+    outstanding = nledger.outstanding_entries(
+        nledger.read_records(led_path)
+    )
+    fams = {e["fault"] for e in outstanding}
+    assert {"partition", "netem", "clock", "process"} <= fams, (
+        f"expected all four families stranded, got {sorted(fams)}"
+    )
+    resil = test["results"].get("resilience") or {}
+    assert resil.get("nemesis.residue.outstanding", 0) >= 4, resil
+
+    # Recovery: repair reopens sessions from the stored test map alone.
+    report = core.repair(d)
+    assert report["clean"], report
+    assert set(report["healed"]) == {e["id"] for e in outstanding}, report
+    # Idempotence: a second repair finds nothing to do.
+    report2 = core.repair(d)
+    assert report2["outstanding"] == 0 and report2["clean"], report2
+    return {
+        "stranded_families": sorted(fams),
+        "stranded_entries": len(outstanding),
+        "healed": len(report["healed"]),
+        "second_repair_outstanding": report2["outstanding"],
+    }
+
+
 SCENARIOS = {
     "hanging-client": scenario_hanging_client,
     "hanging-checker": scenario_hanging_checker,
     "crashing-checker": scenario_crashing_checker,
     "wgl-fault": scenario_wgl_fault,
+    "nemesis-crash": scenario_nemesis_crash,
 }
 
 
