@@ -77,6 +77,12 @@ def add_standard_opts(p: argparse.ArgumentParser) -> None:
         help="RNG seed for reproducible generator schedules",
     )
     p.add_argument(
+        "--node-loss-policy", default="abort", metavar="POLICY",
+        help='what to do when a node dies at setup: "abort" (default) '
+        'or "tolerate[:<min_nodes>]" — quarantine the node and run on '
+        "the survivors, aborting only below min_nodes",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu"],
         help="pin the JAX backend for the device checkers (use cpu "
         "when no healthy accelerator is attached; site configs can "

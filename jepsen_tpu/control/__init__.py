@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import threading
 from typing import Any, Callable, Iterator, Optional, Sequence
 
 from ..utils import real_pmap
@@ -44,6 +43,10 @@ from .remotes import (
     default_remote,
 )
 
+# Imported after Session's dependencies: health only needs telemetry at
+# import time (it reaches back for Session lazily inside its probe).
+from . import health  # noqa: E402
+
 log = logging.getLogger(__name__)
 
 __all__ = [
@@ -63,6 +66,7 @@ __all__ = [
     "default_remote",
     "escape",
     "escape_arg",
+    "health",
     "lit",
     "on_nodes",
     "with_sessions",
@@ -188,21 +192,20 @@ class Session:
 
 
 def sessions_for(test: dict) -> dict[str, Session]:
-    """Opens one session per node in parallel; if any connect fails,
-    the ones that succeeded are closed before re-raising (core.clj:69-90
-    with-resources closes already-opened resources on error)."""
-    nodes = test.get("nodes", [])
-    opened: dict[str, Session] = {}
-    lock = threading.Lock()
-
-    def connect(node: str) -> tuple:
-        s = Session.connect(test, node)
-        with lock:
-            opened[node] = s
-        return node, s
-
+    """Opens one session per node in parallel; applies the node-loss
+    policy to connect failures (abort: close the ones that succeeded
+    and raise — one aggregate error naming every failed node when
+    several fail, the lone original exception otherwise — the
+    core.clj:69-90 with-resources contract; tolerate: quarantine the
+    unreachable nodes and return the survivors' sessions — a node
+    without a session is naturally skipped by `on_nodes`)."""
+    nodes = list(test.get("nodes") or [])
+    todo = [n for n in nodes if not health.is_quarantined(test, n)]
+    opened, failed = health.node_fanout(
+        todo, lambda node: Session.connect(test, node)
+    )
     try:
-        return dict(real_pmap(connect, nodes))
+        health.absorb_failures(test, "session connect", failed)
     except Exception:
         for s in opened.values():
             try:
@@ -210,6 +213,7 @@ def sessions_for(test: dict) -> dict[str, Session]:
             except Exception:  # noqa: BLE001
                 pass
         raise
+    return opened
 
 
 @contextlib.contextmanager

@@ -17,9 +17,10 @@ import os
 import shutil
 import subprocess
 import threading
-import time
 from typing import Any, Optional, Sequence
 
+from .. import telemetry
+from ..utils import with_retry
 from .core import ConnSpec, Remote, RemoteDisconnected, RemoteError
 
 log = logging.getLogger(__name__)
@@ -353,10 +354,14 @@ class K8sRemote(Remote):
 
 class RetryRemote(Remote):
     """Wraps any Remote with reconnect-and-retry on connection failures:
-    ≤5 tries, ~100 ms backoff (control/retry.clj:15-33)."""
+    ≤5 tries with exponential backoff + jitter (control/retry.clj:15-33
+    gives the try count; the schedule is utils.with_retry's, capped low
+    so exhaustion stays a few seconds, not half a minute)."""
 
     TRIES = 5
-    BACKOFF_S = 0.1
+    BACKOFF_MS = 100.0
+    MAX_BACKOFF_MS = 2000.0
+    JITTER = 0.5
 
     def __init__(self, inner: Remote):
         self.inner = inner
@@ -380,26 +385,38 @@ class RetryRemote(Remote):
             self.bound = self.inner.connect(self.spec)
 
     def _with_retry(self, f):
-        last: Optional[Exception] = None
-        for attempt in range(self.TRIES):
-            try:
-                return f()
-            except RemoteDisconnected:
-                # The command itself ended the session and may have been
-                # applied; replaying a non-idempotent command is worse
-                # than surfacing the disconnect.
-                raise
-            except RemoteError as e:
-                last = e
-                log.debug(
-                    "remote call failed (%d/%d): %s", attempt + 1, self.TRIES, e
-                )
-                time.sleep(self.BACKOFF_S)
-                try:
-                    self._reconnect()
-                except RemoteError as e2:
-                    last = e2
-        raise last  # type: ignore[misc]
+        # RemoteDisconnected passes straight through: the command itself
+        # ended the session and may have been applied; replaying a
+        # non-idempotent command is worse than surfacing the disconnect.
+        first = True
+
+        def attempt():
+            nonlocal first
+            if not first:
+                # A previous attempt failed: rebuild the session before
+                # replaying.  A reconnect failure is itself a RemoteError
+                # and rides the same retry schedule.
+                telemetry.count("net.reconnects")
+                self._reconnect()
+            first = False
+            return f()
+
+        try:
+            return with_retry(
+                attempt,
+                retries=self.TRIES - 1,
+                backoff_ms=self.BACKOFF_MS,
+                max_backoff_ms=self.MAX_BACKOFF_MS,
+                jitter=self.JITTER,
+                retry_on=(RemoteError,),
+                no_retry_on=(RemoteDisconnected,),
+                log=lambda m: log.debug("remote call %s", m),
+            )
+        except RemoteDisconnected:
+            raise
+        except RemoteError:
+            telemetry.count("net.retry.exhausted")
+            raise
 
     def execute(self, action: dict) -> dict:
         return self._with_retry(lambda: self.bound.execute(action))

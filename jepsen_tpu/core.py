@@ -25,7 +25,7 @@ from typing import Any, Optional
 from . import db as jdb
 from . import interpreter, oses, store, telemetry
 from .checker.core import check_safe
-from .control import Session, with_sessions
+from .control import Session, health, with_sessions
 from .history import History
 from .nemesis import Nemesis, ledger as fault_ledger, noop as noop_nemesis
 from .utils import real_pmap
@@ -90,7 +90,8 @@ def _with_clients(test: dict, method: str) -> None:
 
     if method == "teardown":
         # Best-effort: a node the nemesis left dead must not turn a
-        # finished run into an error.
+        # finished run into an error.  Runs over ALL nodes, quarantined
+        # included — teardown owes dead nodes an attempt.
         def one_safe(node: str) -> None:
             try:
                 one(node)
@@ -99,7 +100,11 @@ def _with_clients(test: dict, method: str) -> None:
 
         real_pmap(one_safe, test.get("nodes") or [])
     else:
-        real_pmap(one, test.get("nodes") or [])
+        # Setup fans out over the non-quarantined nodes, collects every
+        # per-node failure (real_pmap would hide siblings behind the
+        # first), and lets the node-loss policy decide abort vs shrink.
+        _ok, failed = health.node_fanout(health.eligible_nodes(test), one)
+        health.absorb_failures(test, "client setup", failed)
 
 
 def run_case(test: dict, history_writer=None) -> History:
@@ -164,8 +169,15 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
     # degradation-ladder steps) next to the verdicts they shaped, so a
     # report reader can tell a clean "valid" from a degraded one.
     res_counters = telemetry.resilience_counters()
-    if res_counters and isinstance(results, dict):
-        results.setdefault("resilience", res_counters)
+    resil: dict[str, Any] = dict(res_counters)
+    hm = health.monitor_of(test)
+    if hm is not None and hm.active:
+        # Per-node availability timeline — only once any failure signal
+        # fired, so a healthy run's results are byte-identical to a run
+        # without the monitor.
+        resil["nodes"] = hm.summary()
+    if resil and isinstance(results, dict):
+        results.setdefault("resilience", resil)
     return results
 
 
@@ -218,6 +230,10 @@ def _run_prepared(test: dict) -> dict:
                 test["fault-ledger"] = fault_ledger.FaultLedger(
                     fault_ledger.ledger_path(store.test_dir(test))
                 )
+                # The node health monitor is passive until the first
+                # failure signal: no thread, no probes, no overhead on
+                # a healthy run (same lazy contract as the ledger).
+                test["node-health"] = health.HealthMonitor(test)
                 with with_sessions(test):
                     try:
                         with telemetry.span("lifecycle.os-setup"):
@@ -297,6 +313,12 @@ def _run_prepared(test: dict) -> dict:
                     st.save_2(results)
                 log_results(results)
         finally:
+            hm = test.pop("node-health", None)
+            if hm is not None:
+                try:
+                    hm.stop()
+                except Exception:  # noqa: BLE001
+                    pass
             led = test.pop("fault-ledger", None)
             if led is not None:
                 try:

@@ -11,7 +11,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Optional, Sequence
 
-from .control import Session, on_nodes
+from .control import Session, health, on_nodes
 
 log = logging.getLogger(__name__)
 
@@ -216,16 +216,25 @@ class ComposedDB(DB):
 
 
 def setup(test: dict, db: Optional[DB] = None) -> None:
-    """Sets up the DB on all nodes in parallel, then primary setup on
-    the first node (core.clj:164-173)."""
+    """Sets up the DB on all surviving nodes in parallel (per-node
+    failures go through the node-loss policy), then primary setup on
+    the first node still in rotation (core.clj:164-173)."""
     db = db or test.get("db") or noop
-    on_nodes(test, lambda s, n: db.setup(test, s, n))
-    nodes = test.get("nodes") or []
-    if nodes:
-        on_nodes(
+    health.run_phase(test, "db setup", lambda s, n: db.setup(test, s, n))
+    sessions = test.get("sessions") or {}
+    primary = next(
+        (
+            n for n in test.get("nodes") or []
+            if n in sessions and not health.is_quarantined(test, n)
+        ),
+        None,
+    )
+    if primary is not None:
+        health.run_phase(
             test,
+            "db primary setup",
             lambda s, n: db.setup_primary(test, s, n),
-            [nodes[0]],
+            [primary],
         )
 
 

@@ -31,6 +31,8 @@ from typing import Any, Callable, Optional
 from . import client as jepsen_client
 from . import telemetry
 from .client import Client
+from .control import health
+from .control.core import RemoteDisconnected
 from .generator import (
     PENDING,
     Context,
@@ -147,6 +149,14 @@ class Worker:
         pass
 
 
+#: Open-failure backoff: first retry waits this long, doubling per
+#: consecutive failure up to the cap.  Keeps a dead node from hot-looping
+#: opens even when health quarantine is disabled, while staying well
+#: under any realistic op cadence once the node recovers.
+OPEN_BACKOFF_BASE_S = 0.05
+OPEN_BACKOFF_CAP_S = 1.0
+
+
 class ClientWorker(Worker):
     """Wraps a Client; re-opens it when the op's process changes
     (interpreter.clj:36-70)."""
@@ -169,30 +179,70 @@ class ClientWorker(Worker):
         # process id rotates across crashes (interpreter.clj:87-89).
         nodes = test.get("nodes") or [None]
         self.node: Any = nodes[id % len(nodes)] if isinstance(id, int) else None
+        # Open-failure backoff state: seconds for the NEXT wait, and the
+        # monotonic instant before which we won't attempt another open.
+        self._open_backoff_s = 0.0
+        self._open_not_before = 0.0
 
-    def transact(self, op: Op) -> Op:
-        if (
-            self.client is not None
-            and self.process != op.process
-            and not self.client.reusable(self.test)
-        ):
+    def _drop_client(self) -> None:
+        if self.client is not None:
             try:
                 self.client.close(self.test)
             except Exception as e:  # noqa: BLE001
                 log.debug("worker %s: close failed: %r", self.id, e)
             self.client = None
+
+    def transact(self, op: Op) -> Op:
+        if self.node is not None and health.is_quarantined(
+            self.test, self.node
+        ):
+            # Fast-fail: invoke never reached the node, so :fail is
+            # sound, and we pay no open/op timeout against the corpse.
+            # Drop the stale client so re-admission reopens a fresh one.
+            self._drop_client()
+            self.process = op.process
+            return op.complete(
+                FAIL, error=f"node {self.node} quarantined"
+            )
+        if (
+            self.client is not None
+            and self.process != op.process
+            and not self.client.reusable(self.test)
+        ):
+            self._drop_client()
         if self.client is None:
+            wait = self._open_not_before - time_mod.monotonic()
+            if wait > 0:
+                time_mod.sleep(wait)
             try:
                 self.client = self.prototype.open(self.test, self.node)
+                self._open_backoff_s = 0.0
             except Exception as e:  # noqa: BLE001
                 # Can't even get a client: the op certainly didn't run
-                # (interpreter.clj:47-58).
+                # (interpreter.clj:47-58).  Back off before the next
+                # attempt so a dead node can't hot-loop opens, and feed
+                # the health monitor its passive signal.
+                telemetry.count("client.open.failed")
+                health.signal(self.test, self.node, "open-failed")
+                self._open_backoff_s = min(
+                    max(self._open_backoff_s * 2, OPEN_BACKOFF_BASE_S),
+                    OPEN_BACKOFF_CAP_S,
+                )
+                self._open_not_before = (
+                    time_mod.monotonic() + self._open_backoff_s
+                )
                 self.process = op.process
                 return op.complete(
                     FAIL, error=f"no client: {type(e).__name__}: {e}"
                 )
         self.process = op.process
-        return self.client.invoke(self.test, op)
+        try:
+            return self.client.invoke(self.test, op)
+        except (RemoteDisconnected, ConnectionError):
+            # The transport died mid-op: indeterminate for the op (the
+            # worker loop completes it :info) but a clear health signal.
+            health.signal(self.test, self.node, "disconnect")
+            raise
 
     def _cleanup(self) -> None:
         if self.client is not None:
@@ -347,6 +397,9 @@ def run(
                             thread, op.f, op_timeout,
                         )
                         telemetry.count("interpreter.op-timeouts")
+                        stuck_node = getattr(workers[thread], "node", None)
+                        if stuck_node is not None:
+                            health.signal(test, stuck_node, "op-timeout")
                         now = relative_time_nanos()
                         timed_out = op.complete(
                             INFO,

@@ -18,6 +18,7 @@ import logging
 import random
 from typing import Any, Callable, Iterable, Mapping, Optional, Sequence
 
+from ..control import health
 from ..history import INFO, Op
 from ..utils import JepsenTimeout, majority, timeout as run_timeout
 from . import ledger as fault_ledger
@@ -174,7 +175,9 @@ class Partitioner(Nemesis):
             if isinstance(op.value, Mapping):
                 grudge = {k: set(v) for k, v in op.value.items()}
             elif self.grudge_fn is not None:
-                grudge = self.grudge_fn(test["nodes"])
+                # Grudges form over the nodes still in rotation: cutting
+                # links to a quarantined corpse wastes the fault budget.
+                grudge = self.grudge_fn(health.eligible_nodes(test))
             else:
                 raise ValueError(
                     "partition start op needs a grudge value or grudge_fn"

@@ -16,7 +16,8 @@ import logging
 import os
 from typing import Any, Callable, Optional, Sequence
 
-from ..control import Session, on_nodes
+from .. import telemetry
+from ..control import Session, health, on_nodes
 from ..history import Op
 from ..utils import with_retry
 from . import ledger as fault_ledger
@@ -29,8 +30,19 @@ RESOURCE_DIR = os.path.join(os.path.dirname(__file__), "..", "resources")
 
 def _pick_nodes(test: dict, spec: Any) -> list:
     """Node selection spec: None = all, int = that many random, list =
-    exactly those, callable = filter (nemesis.clj:453-467)."""
-    nodes = list(test.get("nodes") or [])
+    exactly those, callable = filter (nemesis.clj:453-467).  Quarantined
+    nodes are out of the draw — faulting a corpse is wasted fault budget
+    and would muddy the health timeline — and the ledger records the
+    skip so a post-mortem reader knows why the fault's footprint
+    shrank."""
+    all_nodes = list(test.get("nodes") or [])
+    nodes = [n for n in all_nodes if not health.is_quarantined(test, n)]
+    skipped = [n for n in all_nodes if n not in nodes]
+    if skipped:
+        telemetry.count("nemesis.skip.quarantined", len(skipped))
+        fault_ledger.note(
+            test, why="quarantined-skip", nodes=list(skipped)
+        )
     if spec is None:
         return nodes
     if isinstance(spec, int):
@@ -140,7 +152,7 @@ def node_start_stopper(
 
         def invoke(self, test: dict, op: Op) -> Op:
             if op.f == "start":
-                nodes = list(targeter(test, list(test.get("nodes") or [])))
+                nodes = list(targeter(test, health.eligible_nodes(test)))
                 # The heal is an arbitrary closure — not data-describable,
                 # so repair can only report it, not replay it.
                 fault_ledger.intent(

@@ -175,6 +175,16 @@ class FaultLedger:
             self._append(rec)
         telemetry.count("nemesis.ledger.healed")
 
+    def note(self, why: str, **fields: Any) -> None:
+        """Journals an informational record (e.g. the nemesis skipping a
+        quarantined node).  Notes carry no compensator and are ignored
+        by `outstanding_entries` — pure post-mortem context."""
+        with self._lock:
+            self._append({
+                "rec": "note", "why": why, "t": time.time(), **fields,
+            })
+        telemetry.count("nemesis.ledger.notes")
+
     def heal_matching(
         self,
         *,
@@ -311,6 +321,14 @@ def healed(
         led.healed(entry_id, by=by)
         return [entry_id]
     return led.heal_matching(fault=fault, tag=tag, ctype=ctype, by=by)
+
+
+def note(test: dict, why: str, **fields: Any) -> None:
+    """Journal an informational note when a ledger is bound; silently a
+    no-op otherwise (notes are context, never obligations)."""
+    led = ledger_of(test)
+    if led is not None:
+        led.note(why, **fields)
 
 
 def net_mech(net: Any) -> str:

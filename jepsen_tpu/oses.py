@@ -10,7 +10,7 @@ from __future__ import annotations
 import logging
 from typing import Any, Sequence
 
-from .control import Session, on_nodes
+from .control import Session, health, on_nodes
 
 log = logging.getLogger(__name__)
 
@@ -175,9 +175,11 @@ smartos = SmartOSOS()
 
 
 def setup(test: dict) -> None:
-    """OS setup across all nodes (core.clj:92-99 with-os)."""
+    """OS setup across the surviving nodes (core.clj:92-99 with-os);
+    per-node failures go through the node-loss policy (abort vs
+    quarantine-and-shrink)."""
     osys = test.get("os") or noop
-    on_nodes(test, lambda s, n: osys.setup(test, s, n))
+    health.run_phase(test, "os setup", lambda s, n: osys.setup(test, s, n))
 
 
 def teardown(test: dict) -> None:

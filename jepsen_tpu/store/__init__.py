@@ -38,6 +38,10 @@ NONSERIALIZABLE_KEYS = (
     # Live FaultLedger handle; its durable form is nemesis.ledger in
     # the same store dir.
     "fault-ledger",
+    # Live HealthMonitor + a test-supplied probe callable; their durable
+    # form is results["resilience"]["nodes"].
+    "node-health",
+    "health-probe",
     # Run outputs saved in their own blocks, not inside the test map:
     "history",
     "results",

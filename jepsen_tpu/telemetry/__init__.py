@@ -251,6 +251,13 @@ RESILIENCE_COUNTER_PREFIXES = (
     # clock state found by the post-teardown sweep), nemesis.teardown.
     # failed, nemesis.ledger.{intents,healed}.
     "nemesis.",
+    # Node health: node.{suspect,quarantined,readmitted}, node.probe.*,
+    # node.signal.*, node.setup.failed.
+    "node.",
+    # Transport flapping: net.reconnects, net.retry.exhausted.
+    "net.",
+    # Per-worker client open failures against a dead/dying node.
+    "client.open.",
 )
 
 

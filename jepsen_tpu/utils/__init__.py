@@ -232,6 +232,7 @@ def with_retry(
     max_backoff_ms: float = 30_000.0,
     jitter: float = 0.5,
     retry_on: tuple[type[BaseException], ...] = (Exception,),
+    no_retry_on: tuple[type[BaseException], ...] = (),
     deadline: Deadline | None = None,
     log: Callable[[str], None] | None = None,
 ) -> T:
@@ -239,6 +240,9 @@ def with_retry(
     jitter, like `with-retry` (util.clj:487-527) and the SSH retry policy
     (control/retry.clj:15-21: 5 retries, ~100 ms base).  Only exceptions
     matching `retry_on` are retried; anything else propagates at once.
+    `no_retry_on` wins over `retry_on`, for carving a non-retryable
+    subclass out of a retryable base (e.g. RemoteDisconnected under
+    RemoteError, where the command may already have applied).
     Sleep for attempt k is `backoff_ms * 2^(k-1)`, capped at
     `max_backoff_ms`, stretched by up to `jitter` fraction.  An optional
     `deadline` bounds the whole loop: when the budget would be exceeded
@@ -248,6 +252,8 @@ def with_retry(
         try:
             return f()
         except retry_on as e:
+            if no_retry_on and isinstance(e, no_retry_on):
+                raise
             attempt += 1
             if attempt > retries:
                 raise

@@ -170,6 +170,42 @@ class Handler(http.server.BaseHTTPRequestHandler):
             if any(k.startswith(p)
                    for p in telemetry.RESILIENCE_COUNTER_PREFIXES)
         }
+        # Per-node availability (results["resilience"]["nodes"], written
+        # by the health monitor when any node went suspect): state plus
+        # the quarantine/re-admission timeline.
+        node_health: dict = {}
+        try:
+            tf = store.load(run_dir)
+            try:
+                node_health = (
+                    (tf.results or {}).get("resilience") or {}
+                ).get("nodes") or {}
+            finally:
+                tf.close()
+        except Exception:  # noqa: BLE001 — no stored results: skip
+            node_health = {}
+        if node_health:
+            nrows = ""
+            for n, d in sorted(node_health.items()):
+                probes = d.get("probes") or {}
+                timeline = ", ".join(
+                    "{}→{} ({})".format(
+                        e.get("from"), e.get("to"), e.get("reason")
+                    )
+                    for e in d.get("timeline") or []
+                ) or "-"
+                nrows += (
+                    f"<tr><td>{html.escape(str(n))}</td>"
+                    f"<td>{html.escape(str(d.get('state')))}</td>"
+                    f"<td>{d.get('signals')}</td>"
+                    f"<td>{probes.get('pass')}/{probes.get('fail')}</td>"
+                    f"<td>{html.escape(timeline)}</td></tr>"
+                )
+            extras.append(
+                "<h2>node availability</h2><table><tr><th>node</th>"
+                "<th>state</th><th>signals</th><th>probes ok/fail</th>"
+                "<th>timeline</th></tr>" + nrows + "</table>"
+            )
         for title, d in (("resilience", resil),
                          ("counters", counters),
                          ("gauges", summ.get("gauges") or {})):

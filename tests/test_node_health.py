@@ -1,0 +1,413 @@
+"""Node health tests: the healthy→suspect→quarantined→readmitted state
+machine, passive-only overhead, the node-loss policy (abort vs
+tolerate), aggregate setup errors, and the interpreter's quarantine
+fast-fail path."""
+
+import queue
+import threading
+
+import pytest
+
+from jepsen_tpu import client as jc
+from jepsen_tpu import interpreter, telemetry
+from jepsen_tpu.control import DummyRemote, health, sessions_for
+from jepsen_tpu.control.core import RemoteError
+from jepsen_tpu.history import FAIL, INVOKE, OK, Op
+
+
+@pytest.fixture
+def telem():
+    old = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.enable(old)
+
+
+def _monitor(probe, **knobs):
+    """A monitor with no background thread: tests drive probe_sweep()
+    themselves for determinism."""
+    test = {
+        "nodes": ["n1", "n2", "n3"],
+        "health-probe": probe,
+        "health-quarantine-after": 2,
+        "health-readmit-after": 3,
+        **knobs,
+    }
+    hm = health.HealthMonitor(test, start_thread=False)
+    test["node-health"] = hm
+    return test, hm
+
+
+# -- policy parsing -----------------------------------------------------
+
+
+def test_node_loss_policy_parsing():
+    assert health.node_loss_policy({}) == ("abort", 0)
+    assert health.node_loss_policy({"node-loss-policy": "abort"}) == \
+        ("abort", 0)
+    assert health.node_loss_policy({"node-loss-policy": "tolerate"}) == \
+        ("tolerate", 1)
+    assert health.node_loss_policy(
+        {"node-loss-policy": "tolerate:3"}
+    ) == ("tolerate", 3)
+    with pytest.raises(ValueError):
+        health.node_loss_policy({"node-loss-policy": "tolerate:0"})
+    with pytest.raises(ValueError):
+        health.node_loss_policy({"node-loss-policy": "shrug"})
+
+
+# -- state machine ------------------------------------------------------
+
+
+def test_monitor_is_passive_until_first_signal():
+    test, hm = _monitor(lambda t, n: True)
+    assert not hm.active
+    assert hm._thread is None
+    assert hm.quarantined_nodes() == frozenset()
+    # A probe sweep with no states is a no-op, not a crash.
+    hm.probe_sweep()
+    assert hm.summary()["n1"]["state"] == "healthy"
+
+
+def test_signal_then_probe_pass_recovers(telem):
+    test, hm = _monitor(lambda t, n: True)
+    hm.signal("n1", "open-failed")
+    assert hm.summary()["n1"]["state"] == "suspect"
+    hm.probe_sweep()
+    assert hm.summary()["n1"]["state"] == "healthy"
+    assert not hm.is_quarantined("n1")
+    rc = telemetry.resilience_counters()
+    assert rc["node.signal.open-failed"] == 1
+    assert rc["node.suspect"] == 1
+    assert rc["node.probe.pass"] == 1
+
+
+def test_consecutive_probe_failures_quarantine(telem):
+    down = {"n1": True}
+    test, hm = _monitor(lambda t, n: not down.get(n))
+    hm.signal("n1", "disconnect")
+    hm.probe_sweep()  # 1st failure: still suspect
+    assert hm.summary()["n1"]["state"] == "suspect"
+    assert not hm.is_quarantined("n1")
+    hm.probe_sweep()  # 2nd consecutive failure: quarantined
+    assert hm.is_quarantined("n1")
+    assert health.is_quarantined(test, "n1")
+    assert health.eligible_nodes(test) == ["n2", "n3"]
+    rc = telemetry.resilience_counters()
+    assert rc["node.quarantined"] == 1
+    assert rc["node.probe.fail"] == 2
+    tl = hm.summary()["n1"]["timeline"]
+    assert [e["to"] for e in tl] == ["suspect", "quarantined"]
+
+
+def test_single_probe_failure_is_not_node_death():
+    """A nemesis window that heals between probes must not quarantine:
+    one failed probe resets on the next pass."""
+    down = {"n1": True}
+    test, hm = _monitor(lambda t, n: not down.get(n))
+    hm.signal("n1", "disconnect")
+    hm.probe_sweep()  # fails once
+    down.clear()  # the partition heals
+    hm.probe_sweep()  # passes: back to healthy
+    assert hm.summary()["n1"]["state"] == "healthy"
+    assert not hm.is_quarantined("n1")
+
+
+def test_readmission_after_consecutive_passes(telem):
+    down = {"n1": True}
+    test, hm = _monitor(lambda t, n: not down.get(n))
+    hm.signal("n1", "op-timeout")
+    hm.probe_sweep()
+    hm.probe_sweep()
+    assert hm.is_quarantined("n1")
+    down.clear()  # node comes back
+    hm.probe_sweep()
+    hm.probe_sweep()
+    assert hm.is_quarantined("n1")  # 2 passes: not yet
+    hm.probe_sweep()  # 3rd consecutive pass: readmitted
+    assert not hm.is_quarantined("n1")
+    s = hm.summary()["n1"]
+    assert s["state"] == "readmitted"
+    assert [e["to"] for e in s["timeline"]] == [
+        "suspect", "quarantined", "readmitted",
+    ]
+    assert telemetry.resilience_counters()["node.readmitted"] == 1
+
+
+def test_direct_quarantine_and_monitor_stop():
+    test, hm = _monitor(lambda t, n: True)
+    hm.quarantine("n2", "db setup: RemoteError")
+    assert hm.is_quarantined("n2")
+    assert hm.active
+    hm.stop()  # idempotent, no thread was running
+    hm.stop()
+
+
+# -- fan-out + policy ---------------------------------------------------
+
+
+def test_node_fanout_collects_all_failures():
+    def f(node):
+        if node in ("n2", "n3"):
+            raise RuntimeError(f"{node} down")
+        return f"ok-{node}"
+
+    ok, failed = health.node_fanout(["n1", "n2", "n3"], f)
+    assert ok == {"n1": "ok-n1"}
+    assert set(failed) == {"n2", "n3"}
+
+
+def test_absorb_failures_abort_names_every_node():
+    test = {"nodes": ["n1", "n2", "n3"]}
+    failures = {
+        "n2": RuntimeError("boom2"), "n3": ConnectionError("boom3"),
+    }
+    with pytest.raises(health.NodeLossError) as ei:
+        health.absorb_failures(test, "client setup", failures)
+    msg = str(ei.value)
+    assert "n2" in msg and "n3" in msg
+    assert "boom2" in msg and "boom3" in msg
+    assert ei.value.phase == "client setup"
+
+
+def test_absorb_failures_abort_single_failure_passes_through():
+    """One failed node under abort re-raises the original exception
+    untouched, so callers catching specific types keep working."""
+    test = {"nodes": ["n1", "n2"]}
+    with pytest.raises(RuntimeError, match="boom"):
+        health.absorb_failures(test, "setup", {"n2": RuntimeError("boom")})
+
+
+def test_absorb_failures_tolerate_quarantines(telem):
+    test, hm = _monitor(
+        lambda t, n: True, **{"node-loss-policy": "tolerate:2"}
+    )
+    health.absorb_failures(test, "db setup", {"n3": RuntimeError("gone")})
+    assert hm.is_quarantined("n3")
+    assert health.eligible_nodes(test) == ["n1", "n2"]
+    assert telemetry.resilience_counters()["node.setup.failed"] == 1
+
+
+def test_absorb_failures_tolerate_enforces_floor():
+    test, hm = _monitor(
+        lambda t, n: True, **{"node-loss-policy": "tolerate:2"}
+    )
+    with pytest.raises(health.NodeLossError):
+        health.absorb_failures(
+            test, "os setup",
+            {"n2": RuntimeError("x"), "n3": RuntimeError("y")},
+        )
+
+
+def test_absorb_failures_without_monitor_aborts():
+    test = {"nodes": ["n1", "n2", "n3"], "node-loss-policy": "tolerate"}
+    with pytest.raises(health.NodeLossError):
+        health.absorb_failures(
+            test, "setup",
+            {"n2": RuntimeError("x"), "n3": RuntimeError("y")},
+        )
+
+
+# -- sessions under the policy ------------------------------------------
+
+
+def _partial_remote(dead):
+    """A dummy remote whose connect refuses the given nodes.  Closure
+    subclass so the dead set survives DummyRemote's type(self) connect
+    copy."""
+    dead = set(dead)
+
+    class _PartialRemote(DummyRemote):
+        def connect(self, spec):
+            if spec.host in dead:
+                raise RemoteError(f"no route to {spec.host}")
+            return super().connect(spec)
+
+    return _PartialRemote()
+
+
+def _session_test(dead, **overrides):
+    t = {
+        "nodes": ["n1", "n2", "n3"],
+        "ssh": {},
+        "remote": _partial_remote(dead),
+    }
+    t.update(overrides)
+    return t
+
+
+def test_sessions_for_abort_is_aggregate():
+    test = _session_test({"n1", "n3"})
+    with pytest.raises(health.NodeLossError) as ei:
+        sessions_for(test)
+    assert "n1" in str(ei.value) and "n3" in str(ei.value)
+
+
+def test_sessions_for_tolerate_shrinks(telem):
+    test = _session_test({"n2"}, **{"node-loss-policy": "tolerate"})
+    hm = health.HealthMonitor(test, start_thread=False)
+    test["node-health"] = hm
+    sessions = sessions_for(test)
+    assert sorted(sessions) == ["n1", "n3"]
+    assert hm.is_quarantined("n2")
+
+
+# -- client setup aggregate error ---------------------------------------
+
+
+class _OpenFails(jc.Client):
+    def __init__(self, dead=()):
+        self.dead = set(dead)
+
+    def open(self, test, node):
+        if node in self.dead:
+            raise ConnectionRefusedError(f"{node} refused")
+        return self
+
+    def setup(self, test):
+        pass
+
+    def invoke(self, test, op):
+        return op.complete(OK)
+
+
+def test_with_clients_setup_aggregates_failures():
+    from jepsen_tpu import core
+
+    test = {
+        "nodes": ["n1", "n2", "n3"],
+        "client": _OpenFails({"n1", "n2"}),
+    }
+    with pytest.raises(health.NodeLossError) as ei:
+        core._with_clients(test, "setup")
+    assert "n1" in str(ei.value) and "n2" in str(ei.value)
+
+
+def test_with_clients_teardown_stays_best_effort():
+    from jepsen_tpu import core
+
+    test = {
+        "nodes": ["n1", "n2", "n3"],
+        "client": _OpenFails({"n1", "n2", "n3"}),
+    }
+    core._with_clients(test, "teardown")  # must not raise
+
+
+# -- interpreter fast-fail ----------------------------------------------
+
+
+class _CountingClient(jc.Client):
+    def __init__(self, opens=None, invokes=None):
+        self.opens = opens if opens is not None else [0]
+        self.invokes = invokes if invokes is not None else [0]
+
+    def open(self, test, node):
+        self.opens[0] += 1
+        return _CountingClient(self.opens, self.invokes)
+
+    def invoke(self, test, op):
+        self.invokes[0] += 1
+        return op.complete(OK, value=1)
+
+
+def test_quarantined_worker_fast_fails_and_recovers(telem):
+    down = {"n1": True}
+    test, hm = _monitor(lambda t, n: not down.get(n))
+    client = _CountingClient()
+    test["client"] = client
+    test["nodes"] = ["n1"]
+    hm.signal("n1", "open-failed")
+    hm.probe_sweep()
+    hm.probe_sweep()
+    assert hm.is_quarantined("n1")
+
+    w = interpreter.ClientWorker(0, queue.SimpleQueue(), test)
+    out = w.transact(Op(type=INVOKE, f="read", process=0))
+    assert out.type == FAIL
+    assert "quarantined" in out.error
+    # Fast-fail never touched the client protocol.
+    assert client.opens[0] == 0 and client.invokes[0] == 0
+    assert w.client is None
+
+    # Re-admission puts the node back: the next op opens and invokes.
+    down.clear()
+    hm.probe_sweep()
+    hm.probe_sweep()
+    hm.probe_sweep()
+    assert not hm.is_quarantined("n1")
+    out = w.transact(Op(type=INVOKE, f="read", process=0))
+    assert out.type == OK
+    assert client.opens[0] == 1 and client.invokes[0] == 1
+
+
+def test_open_failure_backs_off_and_counts(telem, monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(
+        interpreter.time_mod, "sleep", lambda s: sleeps.append(s)
+    )
+
+    class _RefusedClient(jc.Client):
+        def open(self, test, node):
+            raise ConnectionRefusedError("nope")
+
+        def invoke(self, test, op):  # pragma: no cover
+            raise AssertionError("unreachable")
+
+    test = {"nodes": ["n1"], "client": _RefusedClient()}
+    w = interpreter.ClientWorker(0, queue.SimpleQueue(), test)
+    out1 = w.transact(Op(type=INVOKE, f="read", process=0))
+    assert out1.type == FAIL and "no client" in out1.error
+    assert w._open_backoff_s == interpreter.OPEN_BACKOFF_BASE_S
+    out2 = w.transact(Op(type=INVOKE, f="read", process=0))
+    assert out2.type == FAIL
+    # Backoff doubles per consecutive failure, and the second attempt
+    # actually waited out the first window.
+    assert w._open_backoff_s == 2 * interpreter.OPEN_BACKOFF_BASE_S
+    assert sleeps and sleeps[0] > 0
+    for _ in range(10):
+        w.transact(Op(type=INVOKE, f="read", process=0))
+    assert w._open_backoff_s == interpreter.OPEN_BACKOFF_CAP_S
+    assert telemetry.resilience_counters()["client.open.failed"] == 12
+
+
+def test_op_timeout_signals_health(telem):
+    """The watchdog's abandon feeds the health monitor a passive
+    signal for the stuck worker's node."""
+    from jepsen_tpu import generator as gen
+    from jepsen_tpu import nemesis as nem
+
+    release = threading.Event()
+
+    class _Hang(jc.Client):
+        def open(self, test, node):
+            return self
+
+        def invoke(self, test, op):
+            if op.value == "hang":
+                release.wait(30.0)
+            return op.complete(OK, value=1)
+
+    test = {
+        "concurrency": 1,
+        "nodes": ["n1"],
+        "client": _Hang(),
+        "nemesis": nem.noop,
+        "generator": gen.clients([
+            gen.once({"f": "w", "value": "hang"}),
+        ]),
+        "op_timeout": 0.3,
+        "health-probe": lambda t, n: True,
+    }
+    hm = health.HealthMonitor(test, start_thread=False)
+    test["node-health"] = hm
+    try:
+        interpreter.run(test)
+    finally:
+        release.set()
+        hm.stop()
+    assert hm.active
+    assert hm.summary()["n1"]["signals"] >= 1
+    rc = telemetry.resilience_counters()
+    assert rc["node.signal.op-timeout"] == 1

@@ -451,6 +451,120 @@ def test_retrying_daemon_start_exhausts():
         )
 
 
+# -- transport resilience (RetryRemote) ---------------------------------
+
+
+from jepsen_tpu.control import ConnSpec, RetryRemote  # noqa: E402
+from jepsen_tpu.control.core import (  # noqa: E402
+    Remote,
+    RemoteDisconnected,
+    RemoteError,
+)
+
+
+class _FlakyRemote(Remote):
+    """Fails `fails` times with the given exception, then succeeds."""
+
+    def __init__(self, fails=0, exc=None):
+        self.fails = fails
+        self.exc = exc or RemoteError("transient")
+        self.calls = 0
+        self.connects = 0
+
+    def connect(self, spec):
+        self.connects += 1
+        return self
+
+    def execute(self, action):
+        self.calls += 1
+        if self.fails > 0:
+            self.fails -= 1
+            raise self.exc
+        return {**action, "out": "ok", "err": "", "exit": 0}
+
+
+def test_retry_remote_disconnect_is_not_replayed(telem):
+    """RemoteDisconnected means the command may already have applied:
+    it must pass straight through with no retry and no reconnect."""
+    inner = _FlakyRemote(fails=5, exc=RemoteDisconnected("conn reset"))
+    r = RetryRemote(inner).connect(ConnSpec("n1"))
+    with pytest.raises(RemoteDisconnected):
+        r.execute({"cmd": "x"})
+    assert inner.calls == 1
+    assert inner.connects == 1  # only the initial connect
+    rc = telemetry.resilience_counters()
+    assert "net.reconnects" not in rc
+    assert "net.retry.exhausted" not in rc
+
+
+def test_retry_remote_exhaustion_raises_last_error(telem):
+    class _Dead(Remote):
+        def __init__(self):
+            self.calls = 0
+
+        def connect(self, spec):
+            return self
+
+        def execute(self, action):
+            self.calls += 1
+            raise RemoteError(f"down #{self.calls}")
+
+    inner = _Dead()
+    r = RetryRemote(inner).connect(ConnSpec("n1"))
+    r.BACKOFF_MS = 1.0  # keep the test fast
+    with pytest.raises(RemoteError, match=f"down #{RetryRemote.TRIES}"):
+        r.execute({"cmd": "x"})
+    assert inner.calls == RetryRemote.TRIES
+    rc = telemetry.resilience_counters()
+    # One reconnect before each attempt after the first.
+    assert rc["net.reconnects"] == RetryRemote.TRIES - 1
+    assert rc["net.retry.exhausted"] == 1
+
+
+def test_retry_remote_backoff_is_exponential_with_jitter(monkeypatch):
+    import types
+
+    import jepsen_tpu.utils as utils
+
+    sleeps = []
+    fake = types.SimpleNamespace(
+        sleep=lambda s: sleeps.append(s),
+        monotonic=time.monotonic,
+        time=time.time,
+        perf_counter=time.perf_counter,
+        perf_counter_ns=time.perf_counter_ns,
+    )
+    monkeypatch.setattr(utils, "_time", fake)
+
+    inner = _FlakyRemote(fails=4)
+    r = RetryRemote(inner).connect(ConnSpec("n1"))
+    assert r.execute({"cmd": "x"})["out"] == "ok"
+    assert len(sleeps) == 4
+    for k, s in enumerate(sleeps):
+        base = min(
+            RetryRemote.BACKOFF_MS * 2 ** k, RetryRemote.MAX_BACKOFF_MS
+        ) / 1000.0
+        assert base <= s <= base * (1 + RetryRemote.JITTER), (k, s)
+    # The schedule grows: attempt 3's pause is at least double attempt
+    # 1's (pure-constant backoff would fail this).
+    assert sleeps[2] >= 2 * sleeps[0] * 0.99
+
+
+def test_with_retry_no_retry_on_carves_out_subclass():
+    calls = []
+
+    def f():
+        calls.append(1)
+        raise RemoteDisconnected("gone")
+
+    with pytest.raises(RemoteDisconnected):
+        with_retry(
+            f, retries=5, backoff_ms=1.0,
+            retry_on=(RemoteError,), no_retry_on=(RemoteDisconnected,),
+        )
+    assert len(calls) == 1
+
+
 # -- fault matrix (tools/fault_matrix.py) -------------------------------
 
 
@@ -472,9 +586,22 @@ def test_fault_matrix_all_cells(tmp_path):
     out = run_matrix()
     assert set(out) == {"hanging-client", "hanging-checker",
                         "crashing-checker", "wgl-fault",
-                        "nemesis-crash"}
+                        "nemesis-crash", "node-death"}
     assert "device" in out["wgl-fault"]["degraded_tiers"]
     assert out["nemesis-crash"]["second_repair_outstanding"] == 0
+    assert out["node-death"]["fast_fails"] > 0
+
+
+def test_fault_matrix_node_death_cell(tmp_path):
+    """Tier-1 partial-cluster survival: one node dies mid-run under
+    tolerate policy; the run completes on the survivors, the node is
+    quarantined with a timeline, and its ops fast-fail."""
+    from fault_matrix import scenario_node_death
+
+    detail = scenario_node_death(str(tmp_path / "store"))
+    assert detail["ok_ops"] > 0
+    assert detail["fast_fails"] > 0
+    assert {"from": "suspect", "to": "quarantined"} in detail["timeline"]
 
 
 # -- surfacing ----------------------------------------------------------

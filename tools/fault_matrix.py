@@ -130,6 +130,27 @@ class _HangingRegister(_AtomRegister):
         return super().invoke(test, op)
 
 
+class _MortalRegister(_AtomRegister):
+    """An _AtomRegister whose node can die: once `dead[node]` is set,
+    opens are refused and in-flight invokes drop the connection — the
+    client's-eye view of a host that is simply gone."""
+
+    def __init__(self, state=None, lock=None, dead=None, node=None):
+        super().__init__(state, lock)
+        self.dead = dead if dead is not None else {}
+        self.node = node
+
+    def open(self, test, node):
+        if self.dead.get(node):
+            raise ConnectionRefusedError(f"{node} is dead")
+        return _MortalRegister(self.state, self.lock, self.dead, node)
+
+    def invoke(self, test, op):
+        if self.dead.get(self.node):
+            raise ConnectionResetError(f"{self.node} died mid-op")
+        return super().invoke(test, op)
+
+
 def _run_with_deadline(test: dict) -> dict:
     """core.run under the scenario deadline: a matrix cell that hangs
     is itself a robustness failure and must be reported, not waited on."""
@@ -375,12 +396,96 @@ def scenario_nemesis_crash(store_dir: str) -> dict:
     }
 
 
+def scenario_node_death(store_dir: str) -> dict:
+    """One node dies permanently mid-run under `tolerate` policy: its
+    opens are refused and in-flight invokes disconnect.  The health
+    monitor must pick up the passive signals, confirm via probes, and
+    quarantine the node; from then on its ops complete as fast :fail
+    (the armed op_timeout must never fire), the run completes on the
+    two survivors, and the results carry the availability timeline."""
+    from jepsen_tpu import telemetry
+
+    dead: dict = {}
+    victim = "n3"
+    test = _register_test(
+        store_dir,
+        client=_MortalRegister(dead=dead),
+        generator=None,  # replaced below: longer window than default
+        op_timeout=5.0,
+        **{
+            "node-loss-policy": "tolerate:2",
+            "health-probe": lambda test, node: not dead.get(node),
+            # ~0.45 s of probation before quarantine: long enough that
+            # the dead node's workers demonstrably retry (and fail)
+            # opens first, short enough to leave >1 s of fast-fail.
+            "health-probe-interval": 0.15,
+            "health-quarantine-after": 3,
+        },
+    )
+    import random
+
+    from jepsen_tpu import generator as gen
+
+    test["generator"] = gen.time_limit(
+        2.0,
+        gen.clients(gen.stagger(0.01, gen.mix([
+            gen.FnGen(lambda: {"f": "read"}),
+            gen.FnGen(lambda: {"f": "write",
+                               "value": random.randrange(5)}),
+        ]))),
+    )
+    killer = threading.Timer(0.4, lambda: dead.__setitem__(victim, True))
+    was_enabled = telemetry.enabled()
+    telemetry.enable(True)
+    killer.start()
+    try:
+        test = _run_with_deadline(test)
+    finally:
+        killer.cancel()
+        telemetry.enable(was_enabled)
+    _assert_history_saved(test)
+
+    res = test["results"]
+    resil = res.get("resilience") or {}
+    nodes = resil.get("nodes") or {}
+    assert nodes.get(victim, {}).get("state") == "quarantined", nodes
+    timeline = nodes[victim]["timeline"]
+    assert any(e["to"] == "quarantined" for e in timeline), timeline
+    # Survivors stayed healthy and did real work.
+    h = test["history"]
+    oks = [o for o in h if o.is_ok]
+    assert oks, "no successful ops on the surviving nodes"
+    for n in ("n1", "n2"):
+        assert nodes.get(n, {}).get("state") == "healthy", nodes
+    # Ops against the corpse fast-failed — no per-op timeout burn: the
+    # armed watchdog never fired.
+    from jepsen_tpu.history import FAIL
+
+    fast_fails = [
+        o for o in h
+        if o.type == FAIL and "quarantined" in (o.error or "")
+    ]
+    assert fast_fails, "no fast-fail ops against the quarantined node"
+    assert resil.get("interpreter.op-timeouts", 0) == 0, resil
+    assert resil.get("node.quarantined", 0) >= 1, resil
+    assert resil.get("client.open.failed", 0) >= 1, resil
+    return {
+        "ops": len(h),
+        "ok_ops": len(oks),
+        "fast_fails": len(fast_fails),
+        "timeline": [
+            {"from": e["from"], "to": e["to"]} for e in timeline
+        ],
+    }
+
+
 SCENARIOS = {
     "hanging-client": scenario_hanging_client,
     "hanging-checker": scenario_hanging_checker,
     "crashing-checker": scenario_crashing_checker,
     "wgl-fault": scenario_wgl_fault,
     "nemesis-crash": scenario_nemesis_crash,
+    "node-death": scenario_node_death,
 }
 
 
