@@ -1,0 +1,40 @@
+"""Checker-as-a-service: a long-lived linearizability-checker daemon.
+
+The production story for many concurrent test runs (CI fleets,
+continuous verification of a live DB fleet) is one accelerator pool
+shared by everyone — not one process per run, each paying its own JAX
+startup and XLA compile.  This package is that pool:
+
+  * ``server``    — a TCP daemon owning the JAX devices.  One worker
+                    thread drains a scheduler queue, merging compatible
+                    per-key cohorts from *multiple concurrent runs* into
+                    a single pass through the settling ladder
+                    (parallel/independent.py), sharded over the device
+                    mesh — so XLA compilation, the settle memo, and warm
+                    devices are amortized fleet-wide.
+  * ``protocol``  — the framed wire protocol.  Frames reuse the store's
+                    block layout (store/format.py: [len][crc32][type]
+                    [payload]); history payloads are op-dict chunks
+                    shaped like BLOCK_CHUNK, or raw packed-column
+                    tensors (history/packed.py packed_to_bytes).
+  * ``scheduler`` — the cohort queue: admission, cross-run merge,
+                    per-request budgets, fleet stats.
+  * ``client``    — CheckerdClient (submit/poll/stats) and
+                    RemoteChecker, the drop-in Checker that ships the
+                    work to a daemon and falls back to in-process
+                    checking when the daemon is unreachable.
+
+Start one with ``jepsen checkerd`` (any suite CLI) or
+``python -m jepsen_tpu.checkerd``; point runs at it with
+``--remote host:port`` or the JEPSEN_CHECKERD env var.  The web
+dashboard's ``/fleet`` page renders its stats.
+"""
+
+from __future__ import annotations
+
+#: Default TCP port for the daemon (client, CLI, and /fleet page agree).
+DEFAULT_PORT = 7462
+
+#: Environment variable naming a default daemon address ("host:port").
+#: When set, core.analyze routes every linearizable check through it.
+ADDR_ENV = "JEPSEN_CHECKERD"

@@ -1,0 +1,8 @@
+"""`python -m jepsen_tpu.checkerd` — run the checker daemon."""
+
+import sys
+
+from .server import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,503 @@
+"""The checkerd cohort scheduler: cross-run merge onto one device pool.
+
+One worker thread owns the devices.  It pops the queue, waits one
+batch window so concurrent runs' submissions land, then takes every
+queued request *compatible* with the head (same model spec, algorithm,
+and budgets — budgets gate compatibility so a tight-budget request
+never rides a cohort that outlives it) and checks them as ONE merged
+cohort through the existing settling ladder
+(parallel/independent.py._check_linearizable): every key of every
+request becomes a (ticket, key-index) entry in one subs map, so the
+stream witness, refutation screens, batched BFS, settle memo, and mesh
+sharding amortize across runs exactly as they do across keys.
+
+Budgets: a request's `budget-s` (the run's checker_budget) bounds its
+cohort's wall clock via utils.timeout — on expiry the worker abandons
+the check thread (check_safe semantics) and every member request
+reports per-key "unknown".  A non-positive budget is already expired
+and short-circuits deterministically.  The WGL degradation ladder
+(ops/degrade.py) runs inside the cohort check as usual; captured steps
+ride back in each request's result metadata.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Any, Optional
+
+from .. import telemetry
+from ..checker.core import merge_valid
+from ..utils import timeout as _timeout
+
+_BLOWN = object()
+
+#: Done requests linger for late polls; a sweep drops them after this.
+_RESULT_TTL_S = 600.0
+#: Hard cap on remembered tickets (done ones evict oldest-first).
+_MAX_TICKETS = 4096
+
+
+class Request:
+    """One run's submitted history: per-key subhistories (ops mode)
+    and/or packed tensors (packed mode), plus check parameters."""
+
+    def __init__(
+        self,
+        *,
+        run: str,
+        model_spec: dict,
+        algorithm: str = "wgl-tpu",
+        n_keys: int = 0,
+        budget_s: Optional[float] = None,
+        time_limit_s: Optional[float] = None,
+        subs: Optional[dict[int, Any]] = None,
+        packs: Optional[dict[int, Any]] = None,
+    ):
+        from .protocol import canonical_spec
+
+        self.run = run
+        self.model_spec = model_spec
+        self.algorithm = algorithm
+        self.n_keys = n_keys
+        self.budget_s = budget_s
+        self.time_limit_s = time_limit_s
+        self.subs = subs or {}
+        self.packs = packs or {}
+        #: Cohort-compatibility key: requests merge iff this matches.
+        self.compat = canonical_spec({
+            "model": canonical_spec(model_spec),
+            "algorithm": algorithm,
+            "budget-s": budget_s,
+            "time-limit-s": time_limit_s,
+        })
+        self.ticket: str = ""
+        self.state = "new"  # queued | running | done
+        self.result: Optional[dict] = None
+        self.submitted_t = 0.0
+        self.started_t = 0.0
+        self.done_t = 0.0
+
+
+class Scheduler:
+    def __init__(
+        self,
+        *,
+        batch_window_s: float = 0.05,
+        max_budget_s: Optional[float] = None,
+        bound: Optional[int] = None,
+    ):
+        self.batch_window_s = batch_window_s
+        self.max_budget_s = max_budget_s
+        self.bound = bound
+        self._cond = threading.Condition()
+        self._queue: list[Request] = []
+        self._tickets: dict[str, Request] = {}
+        #: canonical model spec -> live Model instance.  THE warm path:
+        #: one instance per spec for the daemon's lifetime means one
+        #: XLA compile, one interner, and digest-stable settle-memo
+        #: keys across every run that ever submits that model.
+        self._models: dict[str, Any] = {}
+        self._stop = False
+        self._t0 = time.monotonic()
+        self._busy_s = 0.0
+        self.n_requests = 0
+        self.n_keys_total = 0
+        self.n_cohorts = 0
+        self.n_cohorts_merged = 0
+        self.n_requests_merged = 0
+        self._lat_count = 0
+        self._lat_total = 0.0
+        self._lat_max = 0.0
+        self._lat_last = 0.0
+        self._runs: dict[str, dict[str, Any]] = {}
+        self._thread = threading.Thread(
+            target=self._loop, name="checkerd-worker", daemon=True
+        )
+        self._thread.start()
+
+    # -- admission ----------------------------------------------------------
+
+    def submit(self, req: Request) -> str:
+        now = time.monotonic()
+        with self._cond:
+            req.ticket = uuid.uuid4().hex[:12]
+            req.submitted_t = now
+            req.state = "queued"
+            self._sweep_locked(now)
+            self._tickets[req.ticket] = req
+            self._queue.append(req)
+            self.n_requests += 1
+            self.n_keys_total += req.n_keys
+            r = self._run_entry_locked(req.run)
+            r["submitted"] += 1
+            self._cond.notify_all()
+        if telemetry.enabled():
+            telemetry.count("checkerd.requests")
+            telemetry.count("checkerd.keys", req.n_keys)
+        return req.ticket
+
+    def poll(self, ticket: str) -> dict:
+        """A POLL reply payload: PENDING-shaped while queued/running,
+        the RESULT payload once done, or an error marker."""
+        with self._cond:
+            req = self._tickets.get(ticket)
+            if req is None:
+                return {"_error": f"unknown ticket {ticket!r}"}
+            if req.state == "done" and req.result is not None:
+                return dict(req.result)
+            return {
+                "_pending": True,
+                "state": req.state,
+                "queue-depth": len(self._queue),
+            }
+
+    def model_for(self, spec: dict) -> Any:
+        """The daemon-wide model instance for a spec (building it on
+        first sight — which also validates the spec for the submitter's
+        ERROR frame)."""
+        from .protocol import canonical_spec, model_from_spec
+
+        key = canonical_spec(spec)
+        with self._cond:
+            m = self._models.get(key)
+            if m is None:
+                m = model_from_spec(spec)
+                self._models[key] = m
+            return m
+
+    def queue_depth(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stop = True
+            self._cond.notify_all()
+        self._thread.join(timeout=5.0)
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _run_entry_locked(self, run: str) -> dict[str, Any]:
+        r = self._runs.get(run)
+        if r is None:
+            r = self._runs[run] = {
+                "submitted": 0, "done": 0, "merged": 0,
+                "last-latency-s": None,
+            }
+        return r
+
+    def _sweep_locked(self, now: float) -> None:
+        dead = [
+            t for t, r in self._tickets.items()
+            if r.state == "done" and now - r.done_t > _RESULT_TTL_S
+        ]
+        for t in dead:
+            del self._tickets[t]
+        while len(self._tickets) >= _MAX_TICKETS:
+            victim = next(
+                (t for t, r in self._tickets.items() if r.state == "done"),
+                None,
+            )
+            if victim is None:
+                break  # all live; admission still proceeds
+            del self._tickets[victim]
+
+    def stats(self) -> dict:
+        """JSON-able fleet stats for STATS frames and the /fleet page."""
+        with self._cond:
+            now = time.monotonic()
+            uptime = max(now - self._t0, 1e-9)
+            queued: dict[str, int] = {}
+            running: dict[str, int] = {}
+            for r in self._queue:
+                queued[r.run] = queued.get(r.run, 0) + 1
+            for r in self._tickets.values():
+                if r.state == "running":
+                    running[r.run] = running.get(r.run, 0) + 1
+            runs = {}
+            for run, d in self._runs.items():
+                runs[run] = {
+                    **d,
+                    "queued": queued.get(run, 0),
+                    "running": running.get(run, 0),
+                }
+            out = {
+                "uptime-s": round(uptime, 3),
+                "queue-depth": len(self._queue),
+                "requests": self.n_requests,
+                "keys": self.n_keys_total,
+                "cohorts": self.n_cohorts,
+                "cohorts-merged": self.n_cohorts_merged,
+                "requests-merged": self.n_requests_merged,
+                "merge-ratio": round(
+                    self.n_requests_merged / self.n_requests, 4
+                ) if self.n_requests else 0.0,
+                "busy-s": round(self._busy_s, 3),
+                "utilization": round(self._busy_s / uptime, 4),
+                "verdict-latency": {
+                    "count": self._lat_count,
+                    "mean-s": round(
+                        self._lat_total / self._lat_count, 4
+                    ) if self._lat_count else None,
+                    "max-s": round(self._lat_max, 4),
+                    "last-s": round(self._lat_last, 4),
+                },
+                "models-cached": len(self._models),
+                "runs": runs,
+            }
+        out["devices"] = _device_info()
+        return out
+
+    # -- the worker ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._cond:
+                while not self._queue and not self._stop:
+                    self._cond.wait(0.5)
+                if self._stop:
+                    return
+            if self.batch_window_s > 0:
+                # The merge window: concurrent runs submitting "at the
+                # same time" land in one cohort instead of racing the
+                # worker's pop.
+                time.sleep(self.batch_window_s)
+            with self._cond:
+                if not self._queue:
+                    continue
+                head = self._queue[0]
+                group = [r for r in self._queue if r.compat == head.compat]
+                taken = set(id(r) for r in group)
+                self._queue = [r for r in self._queue if id(r) not in taken]
+                now = time.monotonic()
+                for r in group:
+                    r.state = "running"
+                    r.started_t = now
+            t_run = time.monotonic()
+            try:
+                self._check_group(group)
+            except Exception as e:  # noqa: BLE001 — a cohort crash must
+                # not kill the daemon; every member degrades to unknown.
+                err = {
+                    "valid": "unknown",
+                    "error": f"checkerd cohort failed: {e!r}",
+                }
+                for r in group:
+                    if r.result is None:
+                        r.result = {
+                            "valid": "unknown",
+                            "key-results": [dict(err)] * r.n_keys,
+                            "checkerd": {"error": err["error"]},
+                        }
+            dt = time.monotonic() - t_run
+            with self._cond:
+                self._busy_s += dt
+                self.n_cohorts += 1
+                if len({r.run for r in group}) > 1:
+                    self.n_cohorts_merged += 1
+                    self.n_requests_merged += len(group)
+                now = time.monotonic()
+                for r in group:
+                    r.state = "done"
+                    r.done_t = now
+                    lat = now - r.submitted_t
+                    self._lat_count += 1
+                    self._lat_total += lat
+                    self._lat_max = max(self._lat_max, lat)
+                    self._lat_last = lat
+                    e = self._run_entry_locked(r.run)
+                    e["done"] += 1
+                    e["last-latency-s"] = round(lat, 4)
+                    if len(group) > 1:
+                        e["merged"] += 1
+                self._cond.notify_all()
+            if telemetry.enabled():
+                telemetry.count("checkerd.cohorts")
+                if len(group) > 1:
+                    telemetry.count("checkerd.cohorts-merged")
+
+    def _check_group(self, group: list[Request]) -> None:
+        from ..checker.linearizable import Linearizable
+        from ..ops import degrade
+        from ..parallel.independent import IndependentChecker
+
+        head = group[0]
+        model = self.model_for(head.model_spec)
+        budget = head.budget_s
+        if budget is not None and self.max_budget_s is not None:
+            budget = min(budget, self.max_budget_s)
+        elif budget is None:
+            budget = self.max_budget_s
+
+        merged_subs = {
+            (r.ticket, i): h for r in group for i, h in r.subs.items()
+        }
+        merged_packs = {
+            (r.ticket, i): p for r in group for i, p in r.packs.items()
+        }
+
+        lin = Linearizable(
+            model, head.algorithm, time_limit_s=head.time_limit_s,
+        )
+        chk = IndependentChecker(lin, bound=self.bound)
+        test = {"model": model}
+
+        def run_cohort() -> tuple[dict, list]:
+            out: dict[Any, dict] = {}
+            with degrade.capture() as steps:
+                if merged_subs:
+                    out.update(
+                        chk._check_linearizable(test, merged_subs, {})
+                    )
+                if merged_packs:
+                    out.update(_settle_packs(
+                        merged_packs, model, lin,
+                        deadline=None if budget is None
+                        else time.monotonic() + budget,
+                    ))
+            return out, list(steps)
+
+        blown = False
+        merged: dict[Any, dict] = {}
+        steps: list = []
+        t_check = time.monotonic()
+        if budget is not None and budget <= 0:
+            blown = True
+        elif budget is not None:
+            got = _timeout(budget * 1000.0, run_cohort, default=_BLOWN)
+            if got is _BLOWN:
+                blown = True
+            else:
+                merged, steps = got
+        else:
+            merged, steps = run_cohort()
+        check_s = time.monotonic() - t_check
+        if blown and telemetry.enabled():
+            telemetry.count("checkerd.budget-exceeded")
+
+        unknown = {
+            "valid": "unknown",
+            "error": f"checkerd: {budget} s request budget exhausted; "
+                     f"cohort abandoned (checker_budget semantics)",
+        }
+        merged_runs = len({r.run for r in group})
+        cohort_keys = sum(r.n_keys for r in group)
+        for r in group:
+            krs = []
+            for i in range(r.n_keys):
+                kr = merged.get((r.ticket, i))
+                krs.append(dict(unknown) if kr is None and blown
+                           else kr if kr is not None
+                           else {"valid": "unknown",
+                                 "error": "checkerd: key missing from "
+                                          "cohort result"})
+            meta = {
+                "ticket": r.ticket,
+                "merged-runs": merged_runs,
+                "cohort-requests": len(group),
+                "cohort-keys": cohort_keys,
+                "queue-wait-s": round(r.started_t - r.submitted_t, 4),
+                "check-s": round(check_s, 4),
+            }
+            if blown:
+                meta["budget-exceeded"] = True
+            if steps:
+                meta["degradations"] = steps
+            r.result = {
+                "valid": merge_valid(k.get("valid") for k in krs)
+                if krs else True,
+                "key-results": krs,
+                "checkerd": meta,
+            }
+
+
+def _settle_packs(
+    packs: dict[Any, Any], model: Any, lin: Any,
+    deadline: Optional[float],
+) -> dict[Any, dict]:
+    """The settling ladder for wire-packed submissions, which skip
+    re-encoding: cohort-wide stream witness, then per-pack settle memo,
+    refutation screen, and exact CPU engine.  (No batched-BFS tier:
+    packed submissions are the bulk-transport path and the stream +
+    screen + memo trio decides the common families; survivors go
+    straight to the exact engine, still sound.)"""
+    from ..checker.refute import check_refute
+    from ..ops.wgl_stream import check_wgl_witness_stream
+    from ..parallel import independent as pind
+
+    pm = model.packed()
+
+    def left() -> Optional[float]:
+        if deadline is None:
+            return None
+        return max(1.0, deadline - time.monotonic())
+
+    out: dict[Any, dict] = {}
+    live = []
+    for k, p in packs.items():
+        if p.n == 0:
+            out[k] = {"valid": True, "algorithm": "empty"}
+        else:
+            live.append(k)
+    if not live:
+        return out
+    try:
+        stream_v = check_wgl_witness_stream(
+            [packs[k] for k in live], pm, time_limit_s=left(),
+        )
+    except Exception:  # noqa: BLE001 — sound fallback below
+        stream_v = [None] * len(live)
+    rest = []
+    for k, v in zip(live, stream_v):
+        if v is True:
+            out[k] = {
+                "valid": True,
+                "algorithm": "wgl-tpu-stream",
+                "configs-explored": int(packs[k].n_ok),
+            }
+        else:
+            rest.append(k)
+    for k in rest:
+        p = packs[k]
+        digest = pind._settle_digest(p, pm)
+        hit = pind._memo_get(digest)
+        if hit is not None:
+            hit["memo-hit"] = True
+            out[k] = hit
+            continue
+        ref = None
+        try:
+            b = left()
+            ref = check_refute(
+                p, pm, time_limit_s=30.0 if b is None else min(b, 30.0),
+            )
+        except Exception:  # noqa: BLE001 — screens may not veto
+            ref = None
+        if ref is not None:
+            res, engine = ref, "refute-screen"
+        else:
+            res, engine = lin._cpu_exact(p, pm, "auto", time_limit_s=left())
+        r: dict[str, Any] = {
+            "valid": res.valid,
+            "algorithm": engine,
+            "configs-explored": int(res.configs_explored),
+        }
+        if res.valid == "unknown" and res.reason:
+            r["reason"] = res.reason
+        pind._memo_put(digest, r)
+        out[k] = r
+    return out
+
+
+def _device_info() -> dict:
+    """Platform + count of the devices this daemon owns; never raises
+    (stats must work even mid-backend-initialization)."""
+    try:
+        import jax
+
+        devs = jax.devices()
+        return {"count": len(devs), "platform": devs[0].platform}
+    except Exception as e:  # noqa: BLE001
+        return {"count": 0, "platform": None, "error": repr(e)}

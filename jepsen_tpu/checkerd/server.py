@@ -1,0 +1,256 @@
+"""The checkerd TCP server: frames in, verdicts out.
+
+One handler thread per connection parses frames (protocol.py) and talks
+to the shared Scheduler; the scheduler's single worker thread owns the
+devices.  Submissions are connection-scoped state machines
+(SUBMIT -> CHUNK*/PACKED* -> COMMIT -> TICKET), polls and stats are
+stateless, and any per-connection failure answers with an ERROR frame
+instead of touching the daemon.
+"""
+
+from __future__ import annotations
+
+import logging
+import socketserver
+from typing import Any, Optional
+
+from . import DEFAULT_PORT
+from .protocol import (
+    F_CHUNK,
+    F_COMMIT,
+    F_ERROR,
+    F_PACKED,
+    F_PENDING,
+    F_POLL,
+    F_RESULT,
+    F_STATS,
+    F_STATS_REPLY,
+    F_SUBMIT,
+    F_TICKET,
+    ProtocolError,
+    read_frame,
+    unpack_key_frame,
+    write_frame,
+)
+from .scheduler import Request, Scheduler
+
+log = logging.getLogger(__name__)
+
+
+class _Submission:
+    """Connection-local accumulation of one SUBMIT conversation."""
+
+    def __init__(self, meta: dict):
+        self.meta = meta
+        self.n_keys = int(meta.get("n-keys") or 0)
+        if not 0 <= self.n_keys <= 1_000_000:
+            raise ProtocolError(f"implausible n-keys {self.n_keys}")
+        self.ops: dict[int, list] = {}
+        self.packs: dict[int, Any] = {}
+
+    def _check_key(self, i: Any) -> int:
+        i = int(i)
+        if not 0 <= i < self.n_keys:
+            raise ProtocolError(
+                f"key index {i} outside 0..{self.n_keys - 1}"
+            )
+        return i
+
+    def add_chunk(self, payload: dict) -> None:
+        i = self._check_key(payload.get("key"))
+        ops = payload.get("ops")
+        if not isinstance(ops, list):
+            raise ProtocolError("CHUNK without an ops list")
+        self.ops.setdefault(i, []).extend(ops)
+
+    def add_packed(self, data: bytes) -> None:
+        from ..history.packed import packed_from_bytes
+
+        i, body = unpack_key_frame(data)
+        i = self._check_key(i)
+        try:
+            self.packs[i] = packed_from_bytes(body)
+        except ValueError as e:
+            raise ProtocolError(f"key {i}: {e}") from e
+
+    def build(self, scheduler: Scheduler) -> Request:
+        from ..history.core import History
+
+        meta = self.meta
+        spec = meta.get("model")
+        if not isinstance(spec, dict):
+            raise ProtocolError("SUBMIT without a model spec")
+        # Validates the spec (unknown type -> ValueError -> ERROR frame)
+        # and warms the daemon-wide instance before the queue sees it.
+        scheduler.model_for(spec)
+        subs = {
+            # Ops arrive as to_dict() dicts with their original indices;
+            # reindex=False keeps them, so per-key certificates cite
+            # positions in the submitting run's full history.
+            i: History(ops, reindex=False)
+            for i, ops in self.ops.items()
+        }
+        return Request(
+            run=str(meta.get("run") or "anonymous"),
+            model_spec=spec,
+            algorithm=str(meta.get("algorithm") or "wgl-tpu"),
+            n_keys=self.n_keys,
+            budget_s=meta.get("budget-s"),
+            time_limit_s=meta.get("time-limit-s"),
+            subs=subs,
+            packs=self.packs,
+        )
+
+
+class _Handler(socketserver.StreamRequestHandler):
+    def handle(self) -> None:
+        sched: Scheduler = self.server.scheduler  # type: ignore[attr-defined]
+        sub: Optional[_Submission] = None
+        while True:
+            try:
+                fr = read_frame(self.rfile)
+            except ProtocolError as e:
+                self._reply(F_ERROR, {"error": str(e)})
+                return  # stream desynced: close
+            if fr is None:
+                return
+            ftype, payload = fr
+            try:
+                if ftype == F_SUBMIT:
+                    sub = _Submission(payload)
+                elif ftype == F_CHUNK:
+                    self._need(sub, "CHUNK").add_chunk(payload)
+                elif ftype == F_PACKED:
+                    self._need(sub, "PACKED").add_packed(payload)
+                elif ftype == F_COMMIT:
+                    req = self._need(sub, "COMMIT").build(sched)
+                    sub = None
+                    ticket = sched.submit(req)
+                    self._reply(F_TICKET, {
+                        "ticket": ticket,
+                        "queue-depth": sched.queue_depth(),
+                    })
+                elif ftype == F_POLL:
+                    r = sched.poll(str(payload.get("ticket")))
+                    if "_error" in r:
+                        self._reply(F_ERROR, {"error": r["_error"]})
+                    elif r.pop("_pending", None):
+                        self._reply(F_PENDING, r)
+                    else:
+                        self._reply(F_RESULT, r)
+                elif ftype == F_STATS:
+                    self._reply(F_STATS_REPLY, sched.stats())
+                else:
+                    self._reply(F_ERROR, {
+                        "error": f"unexpected frame type {ftype}",
+                    })
+            except (ProtocolError, ValueError) as e:
+                sub = None
+                self._reply(F_ERROR, {"error": str(e)})
+            except BrokenPipeError:
+                return
+            except Exception as e:  # noqa: BLE001 — per-connection wall
+                log.exception("checkerd handler error")
+                sub = None
+                self._reply(F_ERROR, {"error": repr(e)})
+
+    def _need(self, sub: Optional[_Submission], what: str) -> _Submission:
+        if sub is None:
+            raise ProtocolError(f"{what} before SUBMIT")
+        return sub
+
+    def _reply(self, ftype: int, payload: Any) -> None:
+        try:
+            write_frame(self.wfile, ftype, payload)
+            self.wfile.flush()
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+
+
+class CheckerdServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+    scheduler: Scheduler
+
+
+def make_server(
+    host: str = "127.0.0.1",
+    port: int = DEFAULT_PORT,
+    *,
+    batch_window_s: float = 0.05,
+    max_budget_s: Optional[float] = None,
+    bound: Optional[int] = None,
+) -> CheckerdServer:
+    srv = CheckerdServer((host, port), _Handler)
+    srv.scheduler = Scheduler(
+        batch_window_s=batch_window_s,
+        max_budget_s=max_budget_s,
+        bound=bound,
+    )
+    return srv
+
+
+def serve(
+    host: str = "0.0.0.0",
+    port: int = DEFAULT_PORT,
+    *,
+    batch_window_s: float = 0.05,
+    max_budget_s: Optional[float] = None,
+) -> None:
+    """Blocking entrypoint for `jepsen checkerd`."""
+    srv = make_server(
+        host, port,
+        batch_window_s=batch_window_s, max_budget_s=max_budget_s,
+    )
+    bound_port = srv.server_address[1]
+    log.info("checkerd serving on %s:%d", host, bound_port)
+    print(f"checkerd serving on {host}:{bound_port} "
+          f"(batch window {batch_window_s}s)")
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.scheduler.stop()
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="jepsen-tpu-checkerd",
+        description="long-lived linearizability-checker daemon",
+    )
+    p.add_argument("--host", "-b", default="0.0.0.0")
+    p.add_argument("--port", "-p", type=int, default=DEFAULT_PORT)
+    p.add_argument(
+        "--batch-window", type=float, default=0.05, metavar="S",
+        help="seconds to linger after the first queued request so "
+        "concurrent runs merge into one cohort (default 0.05)",
+    )
+    p.add_argument(
+        "--max-budget", type=float, default=None, metavar="S",
+        help="clamp every request's checker budget to this many "
+        "seconds, protecting the pool from pathological histories",
+    )
+    p.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu"],
+        help="pin the JAX backend before the first device touch",
+    )
+    opts = p.parse_args(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format="%(asctime)s %(levelname)s [%(threadName)s] "
+               "%(name)s: %(message)s",
+    )
+    if opts.platform:
+        import jax
+
+        jax.config.update("jax_platforms", opts.platform)
+    serve(
+        opts.host, opts.port,
+        batch_window_s=opts.batch_window, max_budget_s=opts.max_budget,
+    )
+    return 0

@@ -83,6 +83,12 @@ def add_standard_opts(p: argparse.ArgumentParser) -> None:
         "the survivors, aborting only below min_nodes",
     )
     p.add_argument(
+        "--remote", default=None, metavar="HOST:PORT",
+        help="route linearizable checking through a checkerd daemon "
+        "(`jepsen checkerd`); falls back to in-process checking when "
+        "the daemon is unreachable",
+    )
+    p.add_argument(
         "--platform", default=None, choices=["cpu", "tpu"],
         help="pin the JAX backend for the device checkers (use cpu "
         "when no healthy accelerator is attached; site configs can "
@@ -107,14 +113,14 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
         "nodes", "nodes_csv", "nodes_file", "concurrency", "time_limit",
         "test_count", "username", "password", "private_key_path",
         "ssh_port", "dummy_ssh", "leave_db_running", "store_dir", "seed",
-        "command", "test_dir", "platform",
+        "command", "test_dir", "platform", "remote",
     }
     extra = {
         k.replace("_", "-"): v
         for k, v in vars(opts).items()
         if k not in consumed and not k.startswith("_")
     }
-    return {
+    out = {
         **extra,
         "nodes": nodes,
         "concurrency": opts.concurrency,
@@ -130,6 +136,12 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
         },
         "seed": opts.seed,
     }
+    # "remote" the CLI flag is the checkerd address; test["remote"] is
+    # the control-plane Remote object — different keys on purpose.
+    # Only set when given, so a suite's own "checkerd" survives.
+    if getattr(opts, "remote", None):
+        out["checkerd"] = opts.remote
+    return out
 
 
 def validity_exit(results: Optional[dict]) -> int:
@@ -209,6 +221,29 @@ def single_test_cmd(
     s.add_argument("--host", "-b", default="0.0.0.0")
     s.add_argument("--store-dir", default="store")
     s.set_defaults(_run=_run_serve)
+
+    from .checkerd import DEFAULT_PORT as _CHECKERD_PORT
+
+    cd = sub.add_parser(
+        "checkerd",
+        help="run the long-lived checker daemon (serves --remote runs)",
+    )
+    cd.add_argument("--port", "-p", type=int, default=_CHECKERD_PORT)
+    cd.add_argument("--host", "-b", default="0.0.0.0")
+    cd.add_argument(
+        "--batch-window", type=float, default=0.05, metavar="S",
+        help="seconds to linger after the first queued request so "
+        "concurrent runs merge into one cohort (default 0.05)",
+    )
+    cd.add_argument(
+        "--max-budget", type=float, default=None, metavar="S",
+        help="clamp every request's checker budget (pool protection)",
+    )
+    cd.add_argument(
+        "--platform", default=None, choices=["cpu", "tpu"],
+        help="pin the JAX backend for the daemon's devices",
+    )
+    cd.set_defaults(_run=_run_checkerd)
 
     return parser
 
@@ -349,6 +384,19 @@ def _run_serve(opts) -> int:
     from .web import serve
 
     serve(opts.store_dir, host=opts.host, port=opts.port)
+    return EXIT_VALID
+
+
+def _run_checkerd(opts) -> int:
+    """`jepsen checkerd`: the shared checker pool.  Blocks until
+    interrupted.  (--platform is applied by `run` before dispatch.)"""
+    from .checkerd.server import serve as serve_checkerd
+
+    serve_checkerd(
+        opts.host, opts.port,
+        batch_window_s=opts.batch_window,
+        max_budget_s=opts.max_budget,
+    )
     return EXIT_VALID
 
 

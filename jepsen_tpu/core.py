@@ -155,6 +155,16 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
     checker = test.get("checker")
     if checker is None:
         return {"valid": True, "note": "no checker"}
+    # Checker-as-a-service routing: with a daemon address (test map
+    # "checkerd", set by --remote, or the JEPSEN_CHECKERD env var) the
+    # linearizable pieces of the checker tree ship their work to the
+    # shared pool; everything falls back in-process if it's down.
+    addr = test.get("checkerd") or os.environ.get("JEPSEN_CHECKERD")
+    if addr:
+        from .checkerd.client import wrap_remote
+
+        run_id = f"{test.get('name') or 'run'}@{os.getpid()}"
+        checker = wrap_remote(checker, str(addr), run_id=run_id)
     opts: dict[str, Any] = {"history-key": None}
     if dir is not None:
         opts["dir"] = dir
@@ -344,6 +354,10 @@ def rerun_analysis(test_dir: str, test: dict) -> dict:
         for k in store.NONSERIALIZABLE_KEYS:
             if k in test:
                 merged[k] = test[k]
+        # `analyze --remote` must beat whatever address (or absence)
+        # the original run recorded.
+        if "checkerd" in test:
+            merged["checkerd"] = test["checkerd"]
         history = tf.history()
         # Artifacts go next to the file actually being analyzed, not a
         # path recomputed from CLI options.

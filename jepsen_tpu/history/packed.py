@@ -70,6 +70,66 @@ class Interner:
         return len(self.values)
 
 
+#: Column order + dtypes of the binary PackedOps serialization.  The
+#: wire/rest format is just these arrays little-endian, concatenated
+#: after a magic + u64 row count — no per-element framing, so a packed
+#: history round-trips at memcpy speed (checkerd ships these frames).
+PACKED_COLUMNS: tuple[tuple[str, Any], ...] = (
+    ("inv", np.int64),
+    ("ret", np.int64),
+    ("process", np.int32),
+    ("status", np.int32),
+    ("f", np.int32),
+    ("a0", np.int32),
+    ("a1", np.int32),
+    ("src_index", np.int64),
+    ("preds", np.int64),
+    ("horizon", np.int64),
+)
+
+PACKED_MAGIC = b"JPKD1\n"
+
+
+def packed_to_bytes(p: "PackedOps") -> bytes:
+    """Serializes a PackedOps to the columnar binary form."""
+    parts = [PACKED_MAGIC, np.int64(p.n).tobytes()]
+    for name, dtype in PACKED_COLUMNS:
+        col = np.ascontiguousarray(getattr(p, name), dtype=dtype)
+        if col.shape != (p.n,):
+            raise ValueError(
+                f"column {name}: shape {col.shape} != ({p.n},)"
+            )
+        parts.append(col.tobytes())
+    return b"".join(parts)
+
+
+def packed_from_bytes(buf: bytes) -> "PackedOps":
+    """Inverse of packed_to_bytes.  Validates magic and total length so
+    a torn or foreign frame raises instead of mis-slicing columns."""
+    if buf[: len(PACKED_MAGIC)] != PACKED_MAGIC:
+        raise ValueError("not a packed-ops frame (bad magic)")
+    off = len(PACKED_MAGIC)
+    n = int(np.frombuffer(buf, dtype=np.int64, count=1, offset=off)[0])
+    if n < 0:
+        raise ValueError(f"packed-ops frame: negative row count {n}")
+    off += 8
+    want = off + sum(n * np.dtype(dt).itemsize for _, dt in PACKED_COLUMNS)
+    if len(buf) != want:
+        raise ValueError(
+            f"packed-ops frame: {len(buf)} bytes, want {want} for n={n}"
+        )
+    cols = {}
+    for name, dtype in PACKED_COLUMNS:
+        # .copy(): frombuffer views are read-only and pin the source
+        # buffer; the checker mutates nothing but numpy ops want
+        # writable, owned arrays.
+        cols[name] = np.frombuffer(
+            buf, dtype=dtype, count=n, offset=off
+        ).copy()
+        off += n * np.dtype(dtype).itemsize
+    return PackedOps(**cols)
+
+
 #: An encoder maps (invocation, completion|None) to packed
 #: (f_code, a0, a1) int32 triple, or None to drop the op entirely (e.g.
 #: indeterminate reads, which can never affect model state).

@@ -93,6 +93,21 @@ def _encode(payload: Any) -> bytes:
         ).encode()
 
 
+def frame(block_type: int, payload: Any) -> bytes:
+    """One self-contained `[u32 len][u32 crc32][u8 type][payload]`
+    block as bytes — the store's block layout doubling as the checker
+    daemon's wire frame (checkerd/protocol.py), so histories ship over
+    the socket in exactly the encoding they rest in on disk."""
+    data = _encode(payload)
+    return _HEADER.pack(len(data), zlib.crc32(data), block_type) + data
+
+
+def raw_frame(block_type: int, data: bytes) -> bytes:
+    """`frame` for payloads that are already bytes (packed-column
+    tensors): CRC-checked like every block, but not JSON."""
+    return _HEADER.pack(len(data), zlib.crc32(data), block_type) + data
+
+
 class BlockWriter:
     """Appends typed, CRC32-checked blocks to a file.  Reopening a file
     with a torn tail (crashed writer) truncates back to the end of the

@@ -258,6 +258,10 @@ RESILIENCE_COUNTER_PREFIXES = (
     "net.",
     # Per-worker client open failures against a dead/dying node.
     "client.open.",
+    # Remote checking degraded to in-process (checkerd unreachable or
+    # refusing the request) and server-side blown request budgets.
+    "checkerd.fallback",
+    "checkerd.budget-exceeded",
 )
 
 

@@ -79,7 +79,9 @@ class Handler(http.server.BaseHTTPRequestHandler):
         self.wfile.write(body)
 
     def do_GET(self) -> None:  # noqa: N802 (stdlib API)
-        path = urllib.parse.unquote(self.path.split("?", 1)[0])
+        raw_path, _, raw_query = self.path.partition("?")
+        path = urllib.parse.unquote(raw_path)
+        self._query = urllib.parse.parse_qs(raw_query)
         try:
             if path in ("/", ""):
                 self._index()
@@ -89,6 +91,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._zip(path[len("/zip/"):])
             elif path.startswith("/telemetry/"):
                 self._telemetry(path[len("/telemetry/"):])
+            elif path.rstrip("/") == "/fleet":
+                self._fleet()
             else:
                 self._send(404, _page("404", "<p>not found</p>"))
         except BrokenPipeError:
@@ -118,12 +122,85 @@ class Handler(http.server.BaseHTTPRequestHandler):
                     f"<td><a href='/zip/{q}'>zip</a></td></tr>"
                 )
         body = (
+            "<p><a href='/fleet'>checker fleet</a></p>"
             "<table><tr><th>test</th><th>time</th><th>valid?</th>"
             "<th></th><th></th></tr>"
             + "".join(rows)
             + "</table>"
         )
         self._send(200, _page("jepsen-tpu store", body))
+
+    def _fleet(self) -> None:
+        """Live stats of a checkerd daemon (checkerd/scheduler.py
+        stats()): per-run queue depth, cohort merge ratio, device
+        utilization, verdict latency.  The daemon address comes from
+        ?addr=host:port, the JEPSEN_CHECKERD env var, or the default
+        port on localhost."""
+        from .checkerd import ADDR_ENV, DEFAULT_PORT
+
+        addr = (
+            (self._query.get("addr") or [None])[0]
+            or os.environ.get(ADDR_ENV)
+            or f"127.0.0.1:{DEFAULT_PORT}"
+        )
+        hint = (
+            "<p>point this page elsewhere with <code>?addr=host:port"
+            "</code>; start a daemon with <code>jepsen checkerd</code>"
+            " and route runs through it with <code>--remote</code></p>"
+        )
+        try:
+            from .checkerd.client import fetch_stats
+
+            stats = fetch_stats(addr, timeout=2.0)
+        except Exception as e:  # noqa: BLE001 — render, don't 500
+            self._send(200, _page(
+                "checker fleet",
+                f"<p>checkerd at <code>{html.escape(addr)}</code> "
+                f"is unreachable: <code>{html.escape(repr(e))}</code>"
+                f"</p>" + hint,
+            ))
+            return
+        devs = stats.get("devices") or {}
+        lat = stats.get("verdict-latency") or {}
+        overview = [
+            ("daemon", addr),
+            ("uptime s", stats.get("uptime-s")),
+            ("devices", f"{devs.get('count')} x {devs.get('platform')}"),
+            ("device utilization", stats.get("utilization")),
+            ("queue depth", stats.get("queue-depth")),
+            ("requests", stats.get("requests")),
+            ("keys", stats.get("keys")),
+            ("cohorts", stats.get("cohorts")),
+            ("cohorts merged (>1 run)", stats.get("cohorts-merged")),
+            ("merge ratio", stats.get("merge-ratio")),
+            ("models cached", stats.get("models-cached")),
+            ("verdict latency mean s", lat.get("mean-s")),
+            ("verdict latency max s", lat.get("max-s")),
+        ]
+        orows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td>{html.escape(str(v))}</td></tr>"
+            for k, v in overview
+        )
+        rrows = ""
+        for run, d in sorted((stats.get("runs") or {}).items()):
+            rrows += (
+                f"<tr><td>{html.escape(str(run))}</td>"
+                f"<td>{d.get('queued')}</td><td>{d.get('running')}</td>"
+                f"<td>{d.get('submitted')}</td><td>{d.get('done')}</td>"
+                f"<td>{d.get('merged')}</td>"
+                f"<td>{d.get('last-latency-s')}</td></tr>"
+            )
+        runs_tbl = (
+            "<h2>runs</h2><table><tr><th>run</th><th>queued</th>"
+            "<th>running</th><th>submitted</th><th>done</th>"
+            "<th>merged</th><th>last latency s</th></tr>"
+            + rrows + "</table>"
+        ) if rrows else "<p>no runs have submitted yet</p>"
+        self._send(200, _page(
+            "checker fleet",
+            f"<table>{orows}</table>" + runs_tbl + hint,
+        ))
 
     def _telemetry(self, rel: str) -> None:
         """Renders a run's telemetry.json (written by a

@@ -1,0 +1,153 @@
+#!/usr/bin/env python
+"""CI smoke for checker-as-a-service (tier1.yml step).
+
+Starts a real `jepsen_tpu.checkerd` daemon as a subprocess, points two
+concurrent runs at it through RemoteChecker, and asserts
+
+  * both remote verdicts are identical to an in-process
+    IndependentChecker over the same histories (per key, not just the
+    top-level bool);
+  * the two runs were merged into one settle cohort (cohorts-merged
+    counter > 0 and each result's merged-runs == 2) — the cross-run
+    amortization the daemon exists for.
+
+Exit 0 + "PASS" on success, exit 1 with a reason otherwise.  CPU-only:
+the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.checkerd.client import (  # noqa: E402
+    CheckerdClient,
+    RemoteChecker,
+)
+from jepsen_tpu.history.core import History  # noqa: E402
+from jepsen_tpu.models.registers import Register  # noqa: E402
+from jepsen_tpu.parallel.independent import (  # noqa: E402
+    KV,
+    IndependentChecker,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def history(prefix: str) -> History:
+    """One good register key and one that reads a never-written value."""
+    ops = []
+
+    def add(process, f, key, value):
+        i = len(ops)
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": f, "value": KV(key, None if f == "read" else value),
+                    "time": i})
+        ops.append({"index": i + 1, "type": "ok", "process": process,
+                    "f": f, "value": KV(key, value), "time": i + 1})
+
+    add(0, "write", f"{prefix}-good", 1)
+    add(0, "read", f"{prefix}-good", 1)
+    add(1, "write", f"{prefix}-bad", 1)
+    add(1, "read", f"{prefix}-bad", 9)
+    return History(ops)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    port = free_port()
+    addr = f"127.0.0.1:{port}"
+    # Wide batch window so both runs land in one cohort despite CI jitter.
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.checkerd",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--batch-window", "1.0", "--platform", "cpu"],
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1):
+                    break
+            except OSError:
+                if daemon.poll() is not None:
+                    fail(f"daemon exited early rc={daemon.returncode}")
+                if time.monotonic() > deadline:
+                    fail("daemon never started listening")
+                time.sleep(0.2)
+
+        runs = {"run-a": history("a"), "run-b": history("b")}
+        expected = {
+            name: IndependentChecker(Linearizable(Register())).check(
+                {"name": name}, h, {})
+            for name, h in runs.items()
+        }
+        results: dict = {}
+        barrier = threading.Barrier(len(runs))
+
+        def submit(name: str, h: History) -> None:
+            rc = RemoteChecker(
+                IndependentChecker(Linearizable(Register())),
+                addr, run_id=name, fallback=False)
+            barrier.wait()
+            results[name] = rc.check({"name": name}, h, {})
+
+        threads = [threading.Thread(target=submit, args=(n, h))
+                   for n, h in runs.items()]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+
+        for name, exp in expected.items():
+            got = results.get(name)
+            if got is None:
+                fail(f"{name}: no remote result")
+            if "fallback" in got.get("checkerd", {}):
+                fail(f"{name}: fell back in-process: {got['checkerd']}")
+            if got["valid"] != exp["valid"]:
+                fail(f"{name}: valid {got['valid']} != {exp['valid']}")
+            for k, kr in exp["results"].items():
+                if got["results"][k]["valid"] != kr["valid"]:
+                    fail(f"{name}/{k}: {got['results'][k]['valid']} "
+                         f"!= {kr['valid']}")
+            merged = got["checkerd"].get("merged-runs")
+            if merged != 2:
+                fail(f"{name}: merged-runs {merged} != 2")
+
+        with CheckerdClient(addr) as c:
+            stats = c.stats()
+        if stats["cohorts-merged"] < 1:
+            fail(f"cohorts-merged {stats['cohorts-merged']} < 1")
+        if not (stats["merge-ratio"] > 0):
+            fail(f"merge-ratio {stats['merge-ratio']} not > 0")
+
+        print(f"PASS: 2 runs, verdicts match in-process, "
+              f"cohorts-merged={stats['cohorts-merged']}, "
+              f"merge-ratio={stats['merge-ratio']}")
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
+if __name__ == "__main__":
+    main()
