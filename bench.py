@@ -459,6 +459,132 @@ def run_scale() -> int:
         return 1
 
 
+def run_scale_online() -> int:
+    """Online scale-point child (JEPSEN_BENCH_SCALE_ONLINE_CHILD=1):
+    the streaming counterpart of run_scale.  Instead of one post-hoc
+    decision over a finished pack, the history is consumed as it
+    "arrives" — the packed stream is replayed in stable-prefix slices
+    through streaming.FrontierCarry, so the witness search overlaps the
+    run — and the headline number is the VERDICT LAG: wall time from
+    the last op landing to the verdict.  Emits one JSON line,
+
+      {"metric": "scale_ops_to_verdict_online", "ops": N,
+       "verdict_lag_s": s, "elapsed_s": s, "ops_per_s": r,
+       "lag_fraction": lag/elapsed, ...}
+
+    embedded under "scale_online" in the main line by the parent.  The
+    acceptance shape (ISSUE 7) is lag_fraction < 0.10: online checking
+    must deliver the verdict within 10% of the run length after the
+    run ends.
+
+    A slice boundary at row k with stable bound s = inv[k] is exactly a
+    PackedBuilder snapshot: every prefix row has inv < s (rows are
+    inv-sorted) and every later completion has ret > inv >= s, which is
+    the precondition FrontierCarry.advance documents — so this replay
+    exercises the identical consumption rule as a live run, minus the
+    client threads."""
+    budget = float(os.environ.get("JEPSEN_BENCH_SCALE_BUDGET", "300"))
+    target = int(os.environ.get("JEPSEN_BENCH_SCALE_ONLINE_OPS",
+                                "2000000"))
+    rate_hint = float(os.environ.get("JEPSEN_BENCH_RATE_HINT", "0"))
+    wall = float(os.environ.get("JEPSEN_BENCH_SCALE_WALL", "300"))
+    slices = max(4, int(os.environ.get("JEPSEN_BENCH_SCALE_ONLINE_SLICES",
+                                       "24")))
+    try:
+        platform = init_backend()
+        if rate_hint > 0:
+            # Same fit rule as run_scale, with a harder haircut: each
+            # advance replans the prefix (O(n log n) host numpy), so
+            # the online loop carries ~slices/2 extra plan passes.
+            fit = int(rate_hint * max(30.0, wall - 60.0) * 0.4)
+            target = min(target, max(200_000, fit))
+
+        import numpy as np
+
+        from jepsen_tpu.history.packed import PackedOps
+        from jepsen_tpu.models import cas_register
+        from jepsen_tpu.streaming.frontier import FrontierCarry
+        from jepsen_tpu.utils.histgen import random_register_packed
+
+        pm = cas_register().packed()
+        packed = random_register_packed(
+            target,
+            procs=int(knob("JEPSEN_BENCH_PROCS")),
+            info_rate=float(knob("JEPSEN_BENCH_INFO")),
+            seed=45100, model=pm,
+        )
+        n = packed.n
+        zeros = np.zeros(0, dtype=packed.preds.dtype)
+
+        def prefix(k: int) -> PackedOps:
+            # Witness-only view of the first k rows; preds/horizon are
+            # BFS-only columns the frontier never reads.
+            z = np.zeros(k, dtype=packed.preds.dtype) if k else zeros
+            return PackedOps(
+                inv=packed.inv[:k], ret=packed.ret[:k],
+                process=packed.process[:k], status=packed.status[:k],
+                f=packed.f[:k], a0=packed.a0[:k], a1=packed.a1[:k],
+                src_index=packed.src_index[:k], preds=z, horizon=z,
+            )
+
+        # Warm the chunk-fn compile outside the measured window with a
+        # small same-model stream (width buckets may still differ on
+        # the big stream; any residual compile lands in elapsed_s, not
+        # in the lag tail, because it hits the first advance).
+        warm = random_register_packed(
+            50_000, procs=int(knob("JEPSEN_BENCH_PROCS")),
+            info_rate=float(knob("JEPSEN_BENCH_INFO")),
+            seed=7, model=pm,
+        )
+        fw = FrontierCarry(pm)
+        fw.finalize(warm)
+
+        fr = FrontierCarry(pm)
+        t0 = time.monotonic()
+        step = max(1, n // slices)
+        for k in range(step, n, step):
+            fr.advance(prefix(k), int(packed.inv[k]))
+            if time.monotonic() - t0 > budget:
+                break
+        t_last = time.monotonic()  # the "run" ends: last op has landed
+        valid = fr.finalize(packed)
+        t_end = time.monotonic()
+        lag = t_end - t_last
+        total = t_end - t0
+        rec = {
+            "metric": "scale_ops_to_verdict_online",
+            "ops": int(n),
+            "valid": valid,
+            "verdict_lag_s": round(lag, 3),
+            "elapsed_s": round(total, 2),
+            "ops_per_s": round(n / total) if total > 0 else 0,
+            "lag_fraction": round(lag / total, 4) if total > 0 else None,
+            "slices": slices,
+            "budget_s": budget,
+            "platform": platform,
+            "frontier": {
+                "blocks": fr.blocks_done,
+                "bars": fr.bars_done,
+                "chunks": fr.chunks,
+                "device_s": round(fr.device_s, 2),
+                **({"dead": fr.dead_reason} if fr.dead else {}),
+            },
+        }
+        if valid is not True:
+            rec["error"] = f"frontier could not prove: {fr.dead_reason}"
+        print(json.dumps(rec))
+        return 0 if valid is True else 1
+    except Exception as e:  # noqa: BLE001 — the JSON line must print
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "scale_ops_to_verdict_online", "ops": 0,
+            "valid": None, "error": f"{type(e).__name__}: {e}",
+        }))
+        return 1
+
+
 def run_mixed() -> int:
     """Invalid-heavy independent-checking child
     (JEPSEN_BENCH_MIXED_CHILD=1): 200 keys x 100 ops with ~15% of keys
@@ -564,51 +690,26 @@ def record_scale_last_good(rec: dict) -> None:
 
 
 def probe_chip(timeout_s: float = 90.0) -> str:
-    """Pre-flight chip health: one tiny matmul in a subprocess under a
-    short timeout.  Returns "ok", "wedged" (hang/timeout), or "absent"
-    (no accelerator backend).  90 s covers a cold first compile
-    (~20-40 s observed) with slack; a wedged tunnel hangs for hours, so
-    the two are cleanly separable."""
-    import subprocess
+    """Pre-flight chip health; the implementation moved to
+    jepsen_tpu.ops.degrade so the in-process degradation ladder's
+    chip-recovery rung and the bench watchdog share one probe.  Returns
+    "ok", "wedged" (hang/timeout), or "absent" (no accelerator
+    backend).  degrade is import-light (no jax at module scope), so
+    this stays safe to call before init_backend()."""
+    from jepsen_tpu.ops import degrade
 
-    code = (
-        "import jax\n"
-        "x = jax.numpy.ones((8, 8))\n"
-        "(x @ x).block_until_ready()\n"
-        "print(jax.devices()[0].platform)\n"
-    )
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", code],
-            timeout=timeout_s, capture_output=True,
-        )
-    except subprocess.TimeoutExpired:
-        return "wedged"
-    if proc.returncode != 0:
-        return "absent"
-    platform = proc.stdout.decode(errors="replace").strip()
-    return "ok" if platform == "tpu" else "absent"
+    return degrade.probe_chip(timeout_s=timeout_s)
 
 
 def reset_chip() -> str:
-    """Best-effort chip unwedge between probe and CPU fallback: a stale
-    libtpu lockfile left by a killed process is the one wedge cause
-    that's recoverable from userspace (the runtime spins waiting on it).
-    Removes /tmp/libtpu_lockfile*, settles briefly, and returns a note
-    describing what was done for the bench JSON."""
-    import glob
+    """Best-effort chip unwedge between probe and CPU fallback (stale
+    libtpu lockfiles are the one wedge cause recoverable from
+    userspace).  Delegates to jepsen_tpu.ops.degrade.reset_chip — the
+    same rung the checker's degradation ladder runs in-process —
+    and returns its note for the bench JSON."""
+    from jepsen_tpu.ops import degrade
 
-    removed = []
-    for path in glob.glob("/tmp/libtpu_lockfile*"):
-        try:
-            os.remove(path)
-            removed.append(path)
-        except OSError:
-            pass
-    time.sleep(2.0)
-    if removed:
-        return f"removed {len(removed)} stale libtpu lockfile(s)"
-    return "no stale lockfiles found"
+    return degrade.reset_chip()
 
 
 def record_last_good(stdout: str) -> None:
@@ -678,6 +779,8 @@ def main() -> int:
 
     if os.environ.get("JEPSEN_BENCH_SCALE_CHILD"):
         return run_scale()
+    if os.environ.get("JEPSEN_BENCH_SCALE_ONLINE_CHILD"):
+        return run_scale_online()
     if os.environ.get("JEPSEN_BENCH_MIXED_CHILD"):
         return run_mixed()
     if os.environ.get("JEPSEN_BENCH_NO_WATCHDOG"):
@@ -739,6 +842,12 @@ def main() -> int:
                 # a 20M-row run, MemoryError, ...) leaves the already
                 # measured primary line untouched.
                 print(f"# scale point failed: {e!r}", file=sys.stderr)
+            try:
+                out = _with_scale_online_point(out, env, t_start,
+                                               wall_cap)
+            except Exception as e:  # noqa: BLE001
+                print(f"# online scale point failed: {e!r}",
+                      file=sys.stderr)
         sys.stdout.write(out)
         return proc.returncode
     except subprocess.TimeoutExpired as e:
@@ -897,6 +1006,55 @@ def _with_scale_point(out: str, env: dict, t_start: float,
                 main_rec["scale_tpu_last_good"] = json.load(f)
         except (OSError, ValueError):
             pass
+    lines[main_i] = json.dumps(main_rec)
+    return "\n".join(lines) + "\n"
+
+
+def _with_scale_online_point(out: str, env: dict, t_start: float,
+                             wall_cap: float) -> str:
+    """Runs the ONLINE scale child (streaming verdict-lag metric,
+    ISSUE 7) inside what's left of the wall cap and embeds its record
+    under "scale_online" next to "scale" in the main JSON line.  Same
+    hostage rule as the other side metrics: any failure leaves the
+    main line untouched."""
+    import subprocess
+
+    if os.environ.get("JEPSEN_BENCH_SCALE_ONLINE_OPS", "") == "0":
+        return out
+    lines = out.splitlines()
+    main_i, main_rec = _last_json_line(out)
+    if main_rec is None or main_rec.get("value", 0) <= 0:
+        return out
+    wall_left = wall_cap - (time.monotonic() - t_start)
+    if wall_left < 70.0:
+        main_rec["scale_online"] = {"skipped": "wall budget exhausted"}
+    else:
+        env2 = dict(
+            env,
+            JEPSEN_BENCH_SCALE_ONLINE_CHILD="1",
+            JEPSEN_BENCH_RATE_HINT=str(main_rec["value"]),
+            JEPSEN_BENCH_SCALE_WALL=str(wall_left - 20.0),
+            JEPSEN_BENCH_SCALE_BUDGET=str(
+                min(180.0, max(40.0, wall_left - 50.0))
+            ),
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                timeout=wall_left - 10.0, env=env2, capture_output=True,
+            )
+            sys.stderr.write(proc.stderr.decode(errors="replace"))
+            _, rec = _last_json_line(
+                proc.stdout.decode(errors="replace")
+            )
+            if rec is None:
+                rec = {"skipped": f"online scale child "
+                                  f"rc={proc.returncode}, no JSON"}
+            main_rec["scale_online"] = rec
+        except subprocess.TimeoutExpired:
+            main_rec["scale_online"] = {
+                "skipped": "online scale child hit the wall deadline"
+            }
     lines[main_i] = json.dumps(main_rec)
     return "\n".join(lines) + "\n"
 

@@ -322,15 +322,30 @@ class RemoteChecker(Checker):
         if budget is not None or lin.time_limit_s is not None:
             deadline = (budget or 0.0) + (lin.time_limit_s or 0.0) + 300.0
 
+        # A streaming session may have shipped this exact submission
+        # CHUNK-by-CHUNK while the run was still going (streaming/
+        # remote.py); consume its ticket instead of re-uploading.
+        ticket = None
+        sess = (test or {}).get("streaming-session")
+        if independent and sess is not None:
+            ticket = sess.remote_ticket(
+                self.addr, keys, spec, lin.algorithm, budget,
+                lin.time_limit_s,
+            )
+            if ticket is not None:
+                telemetry.count("checkerd.stream-ticket")
+                log.info("consuming streamed checkerd ticket %s", ticket)
+
         with CheckerdClient(
             self.addr, connect_timeout=self.connect_timeout,
         ) as c:
-            ticket = c.submit_ops(
-                run, spec, subs_ops,
-                algorithm=lin.algorithm,
-                budget_s=budget,
-                time_limit_s=lin.time_limit_s,
-            )
+            if ticket is None:
+                ticket = c.submit_ops(
+                    run, spec, subs_ops,
+                    algorithm=lin.algorithm,
+                    budget_s=budget,
+                    time_limit_s=lin.time_limit_s,
+                )
             payload = c.wait(ticket, deadline_s=deadline)
 
         krs = payload.get("key-results") or []

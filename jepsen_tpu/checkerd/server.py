@@ -45,16 +45,35 @@ class _Submission:
         self.n_keys = int(meta.get("n-keys") or 0)
         if not 0 <= self.n_keys <= 1_000_000:
             raise ProtocolError(f"implausible n-keys {self.n_keys}")
+        #: A streamed submission (streaming/remote.py) opens with a
+        #: DEFERRED key count: chunks grow it as keys first appear and
+        #: COMMIT's payload finalizes it.
+        self.streaming = bool(meta.get("streaming"))
         self.ops: dict[int, list] = {}
         self.packs: dict[int, Any] = {}
 
     def _check_key(self, i: Any) -> int:
         i = int(i)
+        if self.streaming and self.n_keys <= i < 1_000_000:
+            self.n_keys = i + 1
         if not 0 <= i < self.n_keys:
             raise ProtocolError(
                 f"key index {i} outside 0..{self.n_keys - 1}"
             )
         return i
+
+    def finalize_keys(self, payload: dict) -> None:
+        """Applies COMMIT's `n-keys` override (streamed submissions
+        declare the count only once the run ends)."""
+        n = payload.get("n-keys") if isinstance(payload, dict) else None
+        if n is None:
+            return
+        n = int(n)
+        if not self.n_keys <= n <= 1_000_000:
+            raise ProtocolError(
+                f"COMMIT n-keys {n} below the {self.n_keys} keys seen"
+            )
+        self.n_keys = n
 
     def add_chunk(self, payload: dict) -> None:
         i = self._check_key(payload.get("key"))
@@ -123,7 +142,9 @@ class _Handler(socketserver.StreamRequestHandler):
                 elif ftype == F_PACKED:
                     self._need(sub, "PACKED").add_packed(payload)
                 elif ftype == F_COMMIT:
-                    req = self._need(sub, "COMMIT").build(sched)
+                    s = self._need(sub, "COMMIT")
+                    s.finalize_keys(payload)
+                    req = s.build(sched)
                     sub = None
                     ticket = sched.submit(req)
                     self._reply(F_TICKET, {

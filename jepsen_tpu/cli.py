@@ -94,6 +94,13 @@ def add_standard_opts(p: argparse.ArgumentParser) -> None:
         "when no healthy accelerator is attached; site configs can "
         "override the JAX_PLATFORMS env var, this flag cannot be)",
     )
+    p.add_argument(
+        "--streaming", action="store_true",
+        help="check the history online, while the run generates it "
+        "(jepsen_tpu/streaming/): the verdict lands seconds after the "
+        "last op instead of after a full post-hoc pass.  Also enabled "
+        "by JEPSEN_STREAMING=1",
+    )
 
 
 def test_opts_to_map(opts: argparse.Namespace) -> dict:
@@ -113,7 +120,7 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
         "nodes", "nodes_csv", "nodes_file", "concurrency", "time_limit",
         "test_count", "username", "password", "private_key_path",
         "ssh_port", "dummy_ssh", "leave_db_running", "store_dir", "seed",
-        "command", "test_dir", "platform", "remote",
+        "command", "test_dir", "platform", "remote", "streaming",
     }
     extra = {
         k.replace("_", "-"): v
@@ -141,6 +148,10 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
     # Only set when given, so a suite's own "checkerd" survives.
     if getattr(opts, "remote", None):
         out["checkerd"] = opts.remote
+    # Only set when given, so a suite's own "streaming" (or the
+    # JEPSEN_STREAMING env var, read at run time) survives.
+    if getattr(opts, "streaming", None):
+        out["streaming"] = True
     return out
 
 

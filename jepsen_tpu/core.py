@@ -165,6 +165,16 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
 
         run_id = f"{test.get('name') or 'run'}@{os.getpid()}"
         checker = wrap_remote(checker, str(addr), run_id=run_id)
+    # Online checking: close the run's streaming session (drains the
+    # last buffer, runs the final proofs, measures verdict lag) BEFORE
+    # the checkers run, so they find its verdicts ready to consume.
+    sess = test.get("streaming-session")
+    if sess is not None and not sess.finished:
+        try:
+            sess.finish()
+        except Exception:  # noqa: BLE001 — fail-open: post-hoc covers it
+            log.warning("streaming session finish failed; checking "
+                        "post-hoc", exc_info=True)
     opts: dict[str, Any] = {"history-key": None}
     if dir is not None:
         opts["dir"] = dir
@@ -188,6 +198,8 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
         resil["nodes"] = hm.summary()
     if resil and isinstance(results, dict):
         results.setdefault("resilience", resil)
+    if sess is not None and isinstance(results, dict):
+        results.setdefault("streaming", sess.stats())
     return results
 
 
@@ -244,13 +256,26 @@ def _run_prepared(test: dict) -> dict:
                 # failure signal: no thread, no probes, no overhead on
                 # a healthy run (same lazy contract as the ledger).
                 test["node-health"] = health.HealthMonitor(test)
+                # Online checking (--streaming / JEPSEN_STREAMING): tee
+                # the journal into a checking session that proves keys
+                # WHILE the run generates them (jepsen_tpu/streaming/).
+                writer = hw.append
+                from .streaming import maybe_session, streaming_enabled
+                if streaming_enabled(test):
+                    sess = maybe_session(test)
+                    if sess is not None:
+                        test["streaming-session"] = sess
+
+                        def writer(op, _hw=hw.append, _sess=sess):
+                            _hw(op)  # durability first, checking second
+                            _sess.feed(op)
                 with with_sessions(test):
                     try:
                         with telemetry.span("lifecycle.os-setup"):
                             oses.setup(test)
                         with telemetry.span("lifecycle.db-cycle"):
                             jdb.cycle(test)
-                        history = run_case(test, history_writer=hw.append)
+                        history = run_case(test, history_writer=writer)
                         test["history"] = history
                         with telemetry.span("lifecycle.save"):
                             st.save_1(test, history)

@@ -182,6 +182,209 @@ class PackedOps:
         }
 
 
+class PackedBuilder:
+    """Incremental `pack_history`: ops append one at a time (the
+    interpreter's journal order) and chunks encode without re-packing
+    the prefix — the streaming checker's ingest primitive
+    (jepsen_tpu/streaming/).
+
+    Equivalence contract (tested byte-for-byte in
+    tests/test_histgen_packed.py): for any history h,
+
+        b = PackedBuilder(encode)
+        for o in h: b.append(o)
+        packed_to_bytes(b.finish()) == packed_to_bytes(pack_history(h, encode))
+
+    The emit/pairing logic below is a line-for-line transcription of
+    pack_history's — same client filter, same dense event enumeration,
+    same FAIL/None-encode drops, same double-invoke and unfinished-op
+    indeterminates — only driven one op at a time instead of over a
+    complete list.  Keep the two in lockstep.
+
+    Mid-run, `snapshot()` returns the STABLE ROW PREFIX: rows whose
+    invocation event index is < s, where s = min invocation index over
+    in-flight ops (ops invoked but not yet completed).  Every future
+    row either belongs to an in-flight op (inv >= s) or to an op not
+    yet invoked (inv >= the current event counter >= s), so it sorts
+    AFTER the prefix — prefix row indices, contents and order are
+    final.  That stability is what lets the frontier consumer
+    (streaming/frontier.py) carry device state across chunks.
+    """
+
+    __slots__ = ("encode", "_e", "_pending", "_rows", "_stable", "_finished")
+
+    def __init__(self, encode: OpEncoderFn):
+        self.encode = encode
+        #: next dense event index over CLIENT ops (pack_history's e).
+        self._e = 0
+        #: process -> (inv_e, invoke Op), exactly pack_history's pending.
+        self._pending: dict[Any, tuple[int, Op]] = {}
+        #: every emitted row tuple, in EMIT order (finish() sorts, so
+        #: this matches pack_history's pre-sort rows list exactly).
+        self._rows: list[tuple[int, int, int, int, int, int, int, int]] = []
+        #: inv-sorted prefix of rows proven stable by a past snapshot().
+        self._stable: list[tuple[int, int, int, int, int, int, int, int]] = []
+        self._finished = False
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def n_events(self) -> int:
+        """Client events consumed so far."""
+        return self._e
+
+    @property
+    def n_rows(self) -> int:
+        """Rows emitted so far (more may follow until finish())."""
+        return len(self._rows) + len(self._stable)
+
+    @property
+    def in_flight(self) -> int:
+        """Ops invoked but not yet completed."""
+        return len(self._pending)
+
+    def stable_bound(self) -> int:
+        """s: the event index below which rows are final.  Equals the
+        minimum in-flight invocation index, or the event counter when
+        nothing is in flight (everything so far is stable)."""
+        if not self._pending:
+            return self._e
+        return min(inv_e for inv_e, _ in self._pending.values())
+
+    # -- ingest -------------------------------------------------------------
+
+    def _emit(self, inv_e: int, invoke_op: Op, ret_e: int,
+              comp: Optional[Op]) -> None:
+        # Mirror of pack_history's emit() — keep in lockstep.
+        if comp is not None and comp.type == FAIL:
+            return  # certainly never happened
+        status = ST_OK if (comp is not None and comp.type == OK) else ST_INFO
+        enc = self.encode(invoke_op, comp)
+        if enc is None:
+            return
+        fc, a0, a1 = enc
+        self._rows.append(
+            (
+                inv_e,
+                ret_e if status == ST_OK else NO_RET,
+                invoke_op.process,
+                status,
+                fc,
+                a0,
+                a1,
+                invoke_op.index,
+            )
+        )
+
+    def append(self, o: Op) -> None:
+        """Feeds one op in journal order.  Non-client ops are ignored
+        without consuming an event index (pack_history's client
+        filter)."""
+        if self._finished:
+            raise RuntimeError("PackedBuilder already finished")
+        if not o.is_client_op:
+            return
+        e = self._e
+        self._e = e + 1
+        if o.type == INVOKE:
+            prev = self._pending.get(o.process)
+            if prev is not None:
+                # Double invoke without completion (torn history): the
+                # earlier op is indeterminate, like core pairing keeps it.
+                self._emit(prev[0], prev[1], -1, None)
+            self._pending[o.process] = (e, o)
+        else:
+            inv = self._pending.pop(o.process, None)
+            if inv is None:
+                return  # completion without invocation: tolerate
+            inv_e, inv_op = inv
+            self._emit(inv_e, inv_op, e, o)
+
+    def extend(self, ops: "Any") -> None:
+        """Feeds a chunk of ops (may be empty)."""
+        for o in ops:
+            self.append(o)
+
+    # -- snapshots & finish -------------------------------------------------
+
+    def _advance_stable(self, s: int) -> None:
+        """Moves rows with inv < s from the unsorted tail into the
+        inv-sorted stable prefix.  Sound because every previously
+        stable row has inv < the previous s <= every newly stable
+        row's inv: sorting the batch and appending keeps the whole
+        prefix sorted."""
+        if not self._rows:
+            return
+        fresh = [r for r in self._rows if r[0] < s]
+        if not fresh:
+            return
+        self._rows = [r for r in self._rows if r[0] >= s]
+        fresh.sort(key=lambda r: r[0])
+        self._stable.extend(fresh)
+
+    def snapshot(self) -> tuple["PackedOps", int]:
+        """(stable-prefix PackedOps, s).  The pack covers exactly the
+        rows with inv < s and is WITNESS-ONLY: preds/horizon are left
+        zero (the witness event walk never reads them; a full pack
+        comes from finish())."""
+        s = self.stable_bound()
+        self._advance_stable(s)
+        return _rows_to_packed(self._stable, with_preds=False), s
+
+    def finish(self) -> "PackedOps":
+        """Closes the builder: unfinished invocations become
+        indeterminate, rows sort by invocation, preds/horizon are
+        computed — byte-identical to pack_history on the same ops."""
+        if self._finished:
+            raise RuntimeError("PackedBuilder already finished")
+        self._finished = True
+        # Unfinished invocations are indeterminate (pending dict order,
+        # matching pack_history's final loop).
+        for inv_e, inv_op in self._pending.values():
+            self._emit(inv_e, inv_op, -1, None)
+        self._pending.clear()
+        rows = self._stable + self._rows
+        rows.sort(key=lambda r: r[0])
+        return _rows_to_packed(rows, with_preds=True)
+
+
+def _rows_to_packed(rows: list, *, with_preds: bool) -> "PackedOps":
+    """Shared row-tuples -> PackedOps tail of pack_history.  `rows`
+    must already be inv-sorted.  with_preds=False leaves preds/horizon
+    zero for witness-only snapshots."""
+    if rows:
+        arr = np.array(rows, dtype=np.int64)
+    else:
+        arr = np.zeros((0, 8), dtype=np.int64)
+
+    inv = arr[:, 0]
+    ret = arr[:, 1]
+    n = arr.shape[0]
+
+    if with_preds:
+        ret_sorted = np.sort(ret)
+        preds = np.searchsorted(ret_sorted, inv, side="left").astype(np.int64)
+        inv_before_ret = np.searchsorted(inv, ret, side="left").astype(np.int64)
+        horizon = inv_before_ret - 1
+        horizon = np.minimum(horizon, n - 1)
+    else:
+        preds = np.zeros(n, dtype=np.int64)
+        horizon = np.zeros(n, dtype=np.int64)
+
+    return PackedOps(
+        inv=inv.astype(np.int64),
+        ret=ret,
+        process=arr[:, 2].astype(np.int32),
+        status=arr[:, 3].astype(np.int32),
+        f=arr[:, 4].astype(np.int32),
+        a0=arr[:, 5].astype(np.int32),
+        a1=arr[:, 6].astype(np.int32),
+        src_index=arr[:, 7].astype(np.int64),
+        preds=preds,
+        horizon=horizon,
+    )
+
+
 def pack_history(h: History, encode: OpEncoderFn) -> PackedOps:
     """Packs the client portion of a history into columnar arrays.
 

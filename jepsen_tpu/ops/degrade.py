@@ -133,3 +133,99 @@ def record(tier: str, action: str, error: Optional[Any] = None) -> None:
                 error, BaseException
             ) else str(error)
         events.append(ev)
+
+
+# ---------------------------------------------------------------------------
+# Chip recovery — the rung between "retry smaller" and "surrender to CPU"
+# ---------------------------------------------------------------------------
+
+#: Set JEPSEN_CHIP_RESET=0 to disable the reset rung (shared hosts where
+#: another process may legitimately hold the libtpu lockfile).
+CHIP_RESET_ENV = "JEPSEN_CHIP_RESET"
+
+#: The one wedge cause recoverable from userspace: a stale libtpu
+#: lockfile left by a killed process (the runtime spins waiting on it).
+LOCKFILE_GLOB = "/tmp/libtpu_lockfile*"
+
+_chip_reset_lock = threading.Lock()
+_chip_reset_tried = False
+
+
+def reset_chip(pattern: str = LOCKFILE_GLOB) -> str:
+    """Best-effort chip unwedge: removes stale libtpu lockfiles,
+    settles briefly, and returns a note describing what was done
+    (bench.py records it in its JSON)."""
+    import glob
+    import time
+
+    removed = []
+    for path in glob.glob(pattern):
+        try:
+            os.remove(path)
+            removed.append(path)
+        except OSError:
+            pass
+    time.sleep(2.0)
+    if removed:
+        return f"removed {len(removed)} stale libtpu lockfile(s)"
+    return "no stale lockfiles found"
+
+
+def probe_chip(timeout_s: float = 90.0) -> str:
+    """Chip health probe: one tiny matmul in a subprocess under a short
+    timeout.  Returns "ok", "wedged" (hang/timeout), or "absent" (no
+    accelerator backend).  90 s covers a cold first compile (~20-40 s
+    observed) with slack; a wedged tunnel hangs for hours, so the two
+    are cleanly separable."""
+    import subprocess
+    import sys
+
+    code = (
+        "import jax\n"
+        "x = jax.numpy.ones((8, 8))\n"
+        "(x @ x).block_until_ready()\n"
+        "print(jax.devices()[0].platform)\n"
+    )
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            timeout=timeout_s, capture_output=True,
+        )
+    except subprocess.TimeoutExpired:
+        return "wedged"
+    if proc.returncode != 0:
+        return "absent"
+    platform = proc.stdout.decode(errors="replace").strip()
+    return "ok" if platform == "tpu" else "absent"
+
+
+def try_chip_reset(error: Optional[BaseException] = None) -> bool:
+    """The degradation ladder's chip-recovery rung: when a resource
+    error looks like a WEDGED CHIP rather than a too-big program, clear
+    stale libtpu lockfiles and re-probe ONCE per process before the
+    ladder surrenders the device to CPU.  True means the probe came
+    back healthy — retry the device tier; False means stay on the
+    fall-through path (already tried, disabled, non-TPU backend, or the
+    chip stayed wedged)."""
+    global _chip_reset_tried
+    if os.environ.get(CHIP_RESET_ENV, "") in ("0", "false", "no"):
+        return False
+    with _chip_reset_lock:
+        if _chip_reset_tried:
+            return False
+        _chip_reset_tried = True
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception:  # noqa: BLE001 — no backend at all
+        return False
+    if platform != "tpu":
+        return False
+    note = reset_chip()
+    ok = probe_chip() == "ok"
+    telemetry.count("wgl.degrade.chip-reset")
+    record("chip-reset", "recovered" if ok else "still-wedged",
+           f"{note}; probe {'ok' if ok else 'failed'}"
+           + (f" (after {type(error).__name__})" if error else ""))
+    return ok

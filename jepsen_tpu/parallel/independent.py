@@ -92,6 +92,30 @@ def _settle_digest(p, pm) -> str:
     return h.hexdigest()
 
 
+def _online_digest(sess, pm, sub) -> Optional[str]:
+    """Digest of one key's subhistory in the STREAMING SESSION's code
+    space, for `sess.consume`.  Register encoders intern values in
+    first-seen order, so a freshly compiled PackedModel assigns
+    different codes than the session's (which interned in journal
+    order) — the caller's own pack can never match the digest the
+    session recorded.  Re-packing with the session's encoder INSTANCE
+    reuses its interner, reproducing the exact byte stream the proof
+    was recorded against.  Returns None (never consume) when the
+    session checked a different model shape, or the re-pack fails."""
+    spm = sess.pm
+    if (spm.name != pm.name
+            or tuple(int(v) for v in spm.init_state)
+            != tuple(int(v) for v in pm.init_state)
+            or spm.state_width != pm.state_width):
+        return None
+    try:
+        from ..history.packed import pack_history
+
+        return _settle_digest(pack_history(sub, spm.encode), spm)
+    except Exception:  # noqa: BLE001 — fail closed to the post-hoc path
+        return None
+
+
 def _sanitize_settle(res: dict) -> dict:
     """A memo-shareable copy of a settle result: verdict and metadata,
     minus the positional certificate fields."""
@@ -113,6 +137,19 @@ def clear_settle_memo() -> None:
     ladder (screens + search), not a memo replay."""
     with _settle_memo_lock:
         _settle_memo.clear()
+
+
+def invalidate_settle_memo(digest: str) -> None:
+    """Evicts ONE digest's memoized verdict.  The streaming checker
+    (jepsen_tpu/streaming/) memoizes a key's proof the moment the key
+    goes quiet; when the key later takes more ops, that entry describes
+    a mid-run prefix that no finished history will ever equal — it is
+    dead weight at best and, if a recheck re-records the key, a stale
+    twin of the live verdict.  Eviction is keyed so an online recheck
+    drops exactly its own superseded entry instead of dumping every
+    other run's cohort (which a full clear_settle_memo would)."""
+    with _settle_memo_lock:
+        _settle_memo.pop(digest, None)
 
 
 def _memo_put(digest: str, res: dict) -> None:
@@ -182,9 +219,14 @@ class IndependentChecker(Checker):
     checker runs per-key under bounded_pmap, like the reference.
     """
 
-    def __init__(self, base: Checker, *, bound: Optional[int] = None):
+    def __init__(self, base: Checker, *, bound: Optional[int] = None,
+                 streaming: bool = True):
         self.base = base
         self.bound = bound
+        #: Consume online verdicts from a run's StreamingSession
+        #: (jepsen_tpu/streaming/) when one is present in the test map.
+        #: Off means every key settles post-hoc even on streamed runs.
+        self.streaming = streaming
 
     def check(self, test: dict, history: History, opts: dict) -> dict:
         subs = subhistories(history)
@@ -356,6 +398,26 @@ class IndependentChecker(Checker):
             keys = [k for k in keys if k in all_packs]
             if not keys:
                 return results_unpack
+        # Online verdicts first: a streaming session (jepsen_tpu/
+        # streaming/) may have proven keys while the run was still
+        # generating ops.  A verdict is consumed only when the key's
+        # re-packed digest equals the one recorded at proof time, so a
+        # key that changed after its proof settles from scratch here.
+        results_online: dict[Any, dict] = {}
+        sess = (test or {}).get("streaming-session")
+        if self.streaming and sess is not None:
+            for k in keys:
+                d = _online_digest(sess, pm, subs[k])
+                r = sess.consume(k, d) if d is not None else None
+                if r is not None:
+                    results_online[k] = r
+            if results_online:
+                keys = [k for k in keys if k not in results_online]
+                if telemetry.enabled():
+                    telemetry.count("wgl.settle.online-proven",
+                                    len(results_online))
+            if not keys:
+                return {**results_unpack, **results_online}
         # Long keys skip the batched kernel entirely: its compile/pad
         # cost scales with the LONGEST key, and the single-history
         # witness-first path (check_wgl_device) is built for length.
@@ -378,7 +440,8 @@ class IndependentChecker(Checker):
             )
             results_long = dict(zip(long_keys, rs))
             if not keys:
-                return {**results_unpack, **results_long}
+                return {**results_unpack, **results_online,
+                        **results_long}
 
         # Stream-witness first (ops/wgl_stream.py): ALL keys ride one
         # concatenated barrier stream through the witness engine —
@@ -427,10 +490,12 @@ class IndependentChecker(Checker):
             telemetry.count("wgl.settle.stream-proven",
                             len(results_stream))
         if not keys:
-            return {**results_unpack, **results_long, **results_stream}
+            return {**results_unpack, **results_online, **results_long,
+                    **results_stream}
 
         results: dict[Any, dict] = {
-            **results_unpack, **results_long, **results_stream,
+            **results_unpack, **results_online, **results_long,
+            **results_stream,
         }
         results.update(self._settle_cohort(
             keys, all_packs, subs, model, pm, lin, test, opts,

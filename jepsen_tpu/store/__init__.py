@@ -42,6 +42,9 @@ NONSERIALIZABLE_KEYS = (
     # form is results["resilience"]["nodes"].
     "node-health",
     "health-probe",
+    # Live StreamingSession (jepsen_tpu/streaming/); its durable form
+    # is results["streaming"].
+    "streaming-session",
     # Run outputs saved in their own blocks, not inside the test map:
     "history",
     "results",

@@ -1,0 +1,65 @@
+"""Online (streaming) checking: consume the history while the run is
+still generating it.
+
+The interpreter's journal tees each op into a `StreamingSession`
+(pipeline.py): a double-buffered ingest where one buffer fills on the
+interpreter threads while the checker thread drains the other into
+per-key appendable packed builders (history/packed.py PackedBuilder)
+and advances device-side witness work — either an incremental
+`FrontierCarry` (frontier.py) over a single stream, or batched
+stream-witness passes over keys that have gone quiet.  By the time the
+run ends, most keys already carry a proven verdict; `analyze` consumes
+them by packed-digest match and only the remainder pays the post-hoc
+ladder — verdict latency decouples from run length.
+
+Enable with `--streaming` / `JEPSEN_STREAMING=1`.  See design.md
+"Online checking" for the pipeline diagram and soundness argument.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional
+
+from .pipeline import StreamingSession
+
+log = logging.getLogger(__name__)
+
+__all__ = ["StreamingSession", "maybe_session", "streaming_enabled"]
+
+
+def streaming_enabled(test: dict) -> bool:
+    """Whether this run asked for online checking (--streaming flag or
+    the JEPSEN_STREAMING env var)."""
+    if test.get("streaming"):
+        return True
+    env = os.environ.get("JEPSEN_STREAMING", "")
+    return env not in ("", "0", "false", "no")
+
+
+def maybe_session(test: dict) -> Optional[StreamingSession]:
+    """Builds a StreamingSession for this run, or None when the test
+    has no packable model (online checking needs the packed/device
+    form; host-only models stay post-hoc)."""
+    model = test.get("model")
+    if model is None:
+        log.info("streaming requested but the test has no model; "
+                 "checking stays post-hoc")
+        return None
+    try:
+        pm = model.packed()
+    except (NotImplementedError, AttributeError):
+        log.info("streaming requested but model %s has no packed form; "
+                 "checking stays post-hoc", type(model).__name__)
+        return None
+    remote = None
+    addr = test.get("checkerd") or os.environ.get("JEPSEN_CHECKERD")
+    if addr:
+        try:
+            from .remote import remote_feed_for
+            remote = remote_feed_for(str(addr), test, model)
+        except Exception as e:  # noqa: BLE001
+            log.info("streaming remote feed unavailable: %s", e)
+    run_id: Any = test.get("name") or "run"
+    return StreamingSession(pm, remote=remote, run_id=str(run_id))

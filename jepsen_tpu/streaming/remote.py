@@ -1,0 +1,234 @@
+"""Streamed checkerd upload: ship the history to the daemon WHILE the
+run generates it.
+
+Reuses the existing SUBMIT/CHUNK/COMMIT frames (checkerd/protocol.py)
+on one long-lived connection: SUBMIT goes out with `streaming: true`
+and a deferred key count, routed per-key op dicts ride CHUNK frames as
+the run produces them, and COMMIT at finish() carries the final
+`n-keys` — by which time the daemon already holds the whole history,
+so the ticket is poll-ready almost immediately.  RemoteChecker then
+consumes the ticket at analyze (`ticket_for`) instead of re-uploading,
+iff the submission it WOULD have made matches what was streamed (same
+address, keys, model spec, algorithm, budget); any mismatch or feed
+death just means the ordinary post-hoc submission happens — streaming
+the upload can cost bandwidth, never the verdict.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Optional
+
+from .. import telemetry
+from ..history.core import Op
+
+log = logging.getLogger(__name__)
+
+#: Ops accumulated before a CHUNK frame goes out (smaller than the
+#: client's bulk CHUNK_OPS: mid-run frames should flow, not pool).
+FLUSH_OPS = 1024
+#: ...and at least this often while ops trickle.
+FLUSH_INTERVAL_S = 0.25
+
+
+def remote_feed_for(addr: str, test: dict, model: Any) -> Optional["RemoteFeed"]:
+    """A RemoteFeed mirroring exactly the submission RemoteChecker
+    would make for this test, or None when the checker tree has no
+    per-key remotable piece (then there is nothing to stream)."""
+    from ..checker.core import Compose
+    from ..checker.linearizable import Linearizable
+    from ..checkerd.protocol import model_to_spec
+    from ..parallel.independent import IndependentChecker
+
+    def find_lin(c: Any) -> Optional[Linearizable]:
+        if isinstance(c, IndependentChecker) and \
+                isinstance(c.base, Linearizable):
+            return c.base
+        if isinstance(c, Compose):
+            for child in c.checkers.values():
+                lin = find_lin(child)
+                if lin is not None:
+                    return lin
+        return None
+
+    lin = find_lin(test.get("checker"))
+    if lin is None:
+        return None
+    spec = model_to_spec(lin.model or model)
+    if spec is None:
+        return None
+    return RemoteFeed(
+        addr,
+        run=str(test.get("name") or "run"),
+        model_spec=spec,
+        algorithm=lin.algorithm,
+        budget_s=test.get("checker_budget"),
+        time_limit_s=lin.time_limit_s,
+    )
+
+
+class RemoteFeed:
+    """One streamed submission.  `put(key, op)` from the session's
+    checker thread; `commit(keys)` once at finish; `ticket_for(...)`
+    from RemoteChecker at analyze."""
+
+    def __init__(self, addr: str, *, run: str, model_spec: dict,
+                 algorithm: str, budget_s: Optional[float],
+                 time_limit_s: Optional[float]):
+        self.addr = addr
+        self.run = run
+        self.model_spec = model_spec
+        self.algorithm = algorithm
+        self.budget_s = budget_s
+        self.time_limit_s = time_limit_s
+
+        self.dead: Optional[str] = None
+        self.ticket: Optional[str] = None
+        self.ops_sent = 0
+
+        self._client = None
+        self._keys: list = []            # first-seen order == key index
+        self._index: dict = {}
+        self._lock = threading.Lock()
+        self._queue: list = []           # (key index, op dict)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="streaming-remote", daemon=True
+        )
+        self._thread.start()
+
+    # -- session side --------------------------------------------------------
+
+    def put(self, key: Any, op: Op) -> None:
+        """Enqueues one routed per-key op for upload."""
+        if self.dead:
+            return
+        i = self._index.get(key)
+        if i is None:
+            i = self._index[key] = len(self._keys)
+            self._keys.append(key)
+        with self._lock:
+            self._queue.append((i, op.to_dict()))
+            if len(self._queue) >= FLUSH_OPS:
+                self._wake.set()
+
+    def commit(self, keys: list) -> None:
+        """Drains the queue, finalizes the key count, collects the
+        ticket.  `keys` is the session's first-seen key order — it must
+        match what was streamed or the upload is abandoned."""
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=60.0)
+        if self.dead:
+            return
+        if keys != self._keys:
+            self._die("key order diverged from the session's")
+            return
+        try:
+            self._flush()
+            if self._client is None:
+                self._die("nothing was streamed")
+                return
+            from ..checkerd.protocol import F_COMMIT, F_TICKET
+            self._client._send(F_COMMIT, {"n-keys": len(self._keys)})
+            ftype, payload = self._client._recv()
+            if ftype != F_TICKET:
+                raise RuntimeError(f"expected TICKET, got {ftype}")
+            self.ticket = payload["ticket"]
+            telemetry.count("wgl.online.remote-committed")
+            log.info("streamed %d ops / %d keys to %s (ticket %s)",
+                     self.ops_sent, len(self._keys), self.addr, self.ticket)
+        except Exception as e:  # noqa: BLE001
+            self._die(f"{type(e).__name__}: {e}")
+
+    def ticket_for(self, addr: str, keys: list, model_spec: dict,
+                   algorithm: str, budget_s: Any,
+                   time_limit_s: Any) -> Optional[str]:
+        """The ticket, iff this feed streamed the submission the caller
+        is about to make."""
+        if self.ticket is None:
+            return None
+        if (addr, keys, model_spec, algorithm, budget_s, time_limit_s) != \
+                (self.addr, self._keys, self.model_spec, self.algorithm,
+                 self.budget_s, self.time_limit_s):
+            return None
+        return self.ticket
+
+    def stats(self) -> dict:
+        out: dict = {"addr": self.addr, "ops-sent": self.ops_sent,
+                     "keys": len(self._keys)}
+        if self.ticket is not None:
+            out["ticket"] = self.ticket
+        if self.dead:
+            out["dead"] = self.dead
+        return out
+
+    # -- uploader thread -----------------------------------------------------
+
+    def _die(self, reason: str) -> None:
+        self.dead = reason
+        telemetry.count("wgl.online.remote-dead")
+        log.info("streaming upload abandoned (post-hoc submit will "
+                 "cover it): %s", reason)
+        with self._lock:
+            self._queue = []
+        if self._client is not None:
+            self._client.close()
+            self._client = None
+
+    def _ensure_client(self) -> None:
+        if self._client is not None:
+            return
+        from ..checkerd.client import CheckerdClient
+        from ..checkerd.protocol import F_SUBMIT
+
+        c = CheckerdClient(self.addr)
+        c._send(F_SUBMIT, {
+            "run": self.run,
+            "model": self.model_spec,
+            "algorithm": self.algorithm,
+            "n-keys": 0,
+            "packed": False,
+            "streaming": True,
+            "budget-s": self.budget_s,
+            "time-limit-s": self.time_limit_s,
+        })
+        c.wf.flush()
+        self._client = c
+
+    def _flush(self) -> None:
+        from ..checkerd.protocol import F_CHUNK
+
+        with self._lock:
+            batch, self._queue = self._queue, []
+        if not batch:
+            return
+        self._ensure_client()
+        # Coalesce runs of same-key ops into one CHUNK frame each.
+        i0, ops = batch[0][0], []
+        runs = []
+        for i, od in batch:
+            if i != i0:
+                runs.append((i0, ops))
+                i0, ops = i, []
+            ops.append(od)
+        runs.append((i0, ops))
+        for i, ops in runs:
+            self._client._send(F_CHUNK, {"key": i, "ops": ops})
+        self._client.wf.flush()
+        self.ops_sent += len(batch)
+        telemetry.count("wgl.online.remote-ops", len(batch))
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(FLUSH_INTERVAL_S)
+            self._wake.clear()
+            if self.dead:
+                return
+            try:
+                self._flush()
+            except Exception as e:  # noqa: BLE001
+                self._die(f"{type(e).__name__}: {e}")
+                return

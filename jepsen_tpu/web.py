@@ -251,16 +251,20 @@ class Handler(http.server.BaseHTTPRequestHandler):
         # by the health monitor when any node went suspect): state plus
         # the quarantine/re-admission timeline.
         node_health: dict = {}
+        streaming: dict = {}
         try:
             tf = store.load(run_dir)
             try:
+                results = tf.results or {}
                 node_health = (
-                    (tf.results or {}).get("resilience") or {}
+                    results.get("resilience") or {}
                 ).get("nodes") or {}
+                streaming = results.get("streaming") or {}
             finally:
                 tf.close()
         except Exception:  # noqa: BLE001 — no stored results: skip
             node_health = {}
+            streaming = {}
         if node_health:
             nrows = ""
             for n, d in sorted(node_health.items()):
@@ -282,6 +286,29 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 "<h2>node availability</h2><table><tr><th>node</th>"
                 "<th>state</th><th>signals</th><th>probes ok/fail</th>"
                 "<th>timeline</th></tr>" + nrows + "</table>"
+            )
+        # Online-checking panel (results["streaming"], written by a
+        # --streaming run): how far behind the run the verdict was.
+        # Verdict lag is the subsystem's whole point, so it leads.
+        if streaming:
+            lag = streaming.get("verdict-lag-s")
+            lag_txt = "?" if lag is None else f"{lag:.3f} s"
+            keys = streaming.get("keys") or 0
+            proven = streaming.get("proven-online") or 0
+            srows = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(json.dumps(v))}</td></tr>"
+                for k, v in sorted(streaming.items())
+                if k != "verdict-lag-s"
+            )
+            extras.append(
+                "<h2>online checking</h2>"
+                f"<p><b>verdict lag: {lag_txt}</b> — "
+                f"{proven}/{keys} keys proven online"
+                + (" · <b>broken:</b> "
+                   + html.escape(str(streaming.get("broken")))
+                   if streaming.get("broken") else "")
+                + f"</p><table>{srows}</table>"
             )
         for title, d in (("resilience", resil),
                          ("counters", counters),

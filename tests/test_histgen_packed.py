@@ -146,6 +146,91 @@ def test_concurrency_shape(pm):
     assert 2.0 < mean_depth < 64.0, mean_depth
 
 
+class TestPackedBuilderChunked:
+    """The streaming ingest primitive (history/packed.py
+    PackedBuilder): feeding the same ops in chunks — any chunking,
+    including empty and single-op chunks — must produce a pack
+    BYTE-IDENTICAL (packed_to_bytes) to one-shot pack_history."""
+
+    def _oneshot(self, h, pm):
+        from jepsen_tpu.history.packed import pack_history, packed_to_bytes
+
+        return packed_to_bytes(pack_history(h, pm.encode))
+
+    def _chunked(self, h, pm, sizes, snapshots=False):
+        from jepsen_tpu.history.packed import PackedBuilder, packed_to_bytes
+
+        b = PackedBuilder(pm.encode)
+        ops = list(h)
+        i = si = 0
+        while i < len(ops):
+            size = sizes[si % len(sizes)]
+            si += 1
+            b.extend(ops[i: i + size])  # size 0 = explicit empty chunk
+            i += size
+            if snapshots:
+                b.snapshot()  # mid-run snapshots must not perturb finish
+        return packed_to_bytes(b.finish())
+
+    @pytest.mark.parametrize("sizes", [
+        [1],            # single-op chunks
+        [7, 0, 3],      # empty chunks interleaved
+        [100],          # big chunks
+        [1, 50, 0, 2],  # ragged mix
+    ])
+    def test_chunked_equals_oneshot(self, pm, sizes):
+        from jepsen_tpu.utils.histgen import random_register_history
+
+        h = random_register_history(600, procs=8, info_rate=0.1, seed=23)
+        assert self._chunked(h, pm, sizes) == self._oneshot(h, pm)
+
+    def test_snapshots_do_not_perturb_finish(self, pm):
+        from jepsen_tpu.utils.histgen import random_register_history
+
+        h = random_register_history(600, procs=8, info_rate=0.1, seed=29)
+        assert self._chunked(h, pm, [37], snapshots=True) \
+            == self._oneshot(h, pm)
+
+    def test_empty_builder(self, pm):
+        from jepsen_tpu.history.core import History
+        from jepsen_tpu.history.packed import PackedBuilder, packed_to_bytes
+
+        b = PackedBuilder(pm.encode)
+        b.extend([])
+        assert packed_to_bytes(b.finish()) == self._oneshot(History([]), pm)
+
+    def test_unfinished_ops_match_pack_history(self, pm):
+        """A history ending with in-flight invocations: the builder's
+        finish() must emit the same indeterminate rows pack_history
+        does."""
+        from jepsen_tpu.history.core import Op, history
+
+        h = history([
+            Op(type="invoke", f="write", value=1, process=0),
+            Op(type="ok", f="write", value=1, process=0),
+            Op(type="invoke", f="write", value=2, process=1),
+            Op(type="invoke", f="read", value=None, process=2),
+        ])
+        assert self._chunked(h, pm, [1]) == self._oneshot(h, pm)
+
+    def test_roundtrip_through_bytes(self, pm):
+        from jepsen_tpu.history.packed import (
+            PACKED_COLUMNS,
+            PackedBuilder,
+            packed_from_bytes,
+            packed_to_bytes,
+        )
+        from jepsen_tpu.utils.histgen import random_register_history
+
+        h = random_register_history(300, procs=4, info_rate=0.05, seed=31)
+        b = PackedBuilder(pm.encode)
+        b.extend(h)
+        p = b.finish()
+        q = packed_from_bytes(packed_to_bytes(p))
+        for name, _ in PACKED_COLUMNS:
+            assert (getattr(q, name) == getattr(p, name)).all(), name
+
+
 def test_generation_speed_floor(pm):
     """The reason this generator exists: much faster than the
     Op-level path's ~60k events/s.  Adaptive best-of-reps
