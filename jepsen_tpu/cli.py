@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import sys
 import traceback
 from typing import Any, Callable, Optional, Sequence
@@ -121,6 +122,9 @@ def test_opts_to_map(opts: argparse.Namespace) -> dict:
         "test_count", "username", "password", "private_key_path",
         "ssh_port", "dummy_ssh", "leave_db_running", "store_dir", "seed",
         "command", "test_dir", "platform", "remote", "streaming",
+        # `jepsen search` knobs: search-loop configuration, not test map.
+        "budget", "search_families", "max_iterations", "min_nodes",
+        "iteration_deadline", "shrink_attempts",
     }
     extra = {
         k.replace("_", "-"): v
@@ -226,6 +230,45 @@ def single_test_cmd(
         help="stored test dir with a fault ledger (default: latest run)",
     )
     r.set_defaults(_run=lambda opts: _run_repair(test_fn, opts))
+
+    se = sub.add_parser(
+        "search",
+        help="coverage-guided fault schedule search: breed nemesis "
+        "schedules under a wall-clock budget, shrink anything "
+        "interesting to a minimal reproducer",
+    )
+    add_standard_opts(se)
+    if extra_opts:
+        extra_opts(se)
+    se.add_argument(
+        "--budget", type=float, default=60.0, metavar="S",
+        help="wall-clock seconds to search (default 60)",
+    )
+    se.add_argument(
+        "--search-families", default=None, metavar="F1,F2",
+        help="comma-separated fault families to draw from (default: "
+        "every family whose compensator is replayable — "
+        "partition,kill,pause,packet,clock)",
+    )
+    se.add_argument(
+        "--max-iterations", type=int, default=None,
+        help="stop after this many runs even with budget left",
+    )
+    se.add_argument(
+        "--min-nodes", type=int, default=None,
+        help="survivable-minimum floor override (default: derived "
+        "from --node-loss-policy)",
+    )
+    se.add_argument(
+        "--iteration-deadline", type=float, default=60.0, metavar="S",
+        help="per-iteration hang deadline (default 60)",
+    )
+    se.add_argument(
+        "--shrink-attempts", type=int, default=12,
+        help="max extra runs spent minimizing one reproducer "
+        "(default 12)",
+    )
+    se.set_defaults(_run=lambda opts: _run_search(test_fn, opts))
 
     s = sub.add_parser("serve", help="browse stored tests over HTTP")
     s.add_argument("--port", "-p", type=int, default=8080)
@@ -389,6 +432,71 @@ def _run_repair(test_fn, opts) -> int:
     residue = report.get("residue") or {}
     print(f"    residue clean={residue.get('clean')}")
     return EXIT_VALID if report["clean"] else EXIT_UNKNOWN
+
+
+def _run_search(test_fn, opts) -> int:
+    """`jepsen search`: the coverage-guided fault fuzzer.  Each
+    iteration is a full run in its own store dir under
+    <store-dir>/<name>-search/runs/; the suite's test map provides the
+    cluster, client, and checker, while the search installs the
+    compiled nemesis + scripted generator.  The search dir is stable
+    across invocations, so corpus and coverage resume — and the
+    leading heal sweep repairs whatever a SIGKILLed predecessor left
+    mid-fault."""
+    from . import telemetry
+    from .nemesis import search as nsearch
+
+    base = _build_test(test_fn, opts)
+    name = base.get("name") or "jepsen"
+    search_dir = os.path.join(opts.store_dir, f"{name}-search")
+    n_nodes = len(base.get("nodes") or [])
+    if n_nodes < 2:
+        print("search needs >= 2 nodes", file=sys.stderr)
+        return EXIT_USAGE
+    min_nodes = opts.min_nodes or nsearch.floor_from_test(base)
+    families = tuple(
+        f.strip() for f in (opts.search_families or "").split(",")
+        if f.strip()
+    ) or nsearch.DEFAULT_FAMILIES
+
+    runner = nsearch.CoreRunner(
+        lambda: _build_test(test_fn, opts), search_dir,
+        {
+            "iteration-deadline": opts.iteration_deadline,
+            "node-loss-policy": base.get("node-loss-policy"),
+        },
+    )
+    was_enabled = telemetry.enabled()
+    telemetry.enable(True)
+    try:
+        out = nsearch.run_search(
+            runner,
+            search_dir=search_dir,
+            n_nodes=n_nodes,
+            budget_s=opts.budget,
+            seed=opts.seed or 0,
+            families=families,
+            min_nodes=min_nodes,
+            max_iterations=opts.max_iterations,
+            shrink_attempts=opts.shrink_attempts,
+            repair_template=base,
+        )
+    finally:
+        telemetry.enable(was_enabled)
+    stats = out["stats"]
+    print(f"==> search {search_dir}")
+    print(
+        f"    iterations={stats['iterations']} "
+        f"coverage={out['coverage']} corpus={out['corpus']} "
+        f"interesting={stats['interesting']} cells={len(out['cells'])}"
+    )
+    for cell in out["cells"]:
+        print(
+            f"    cell {cell['name']}: {cell['events']} event(s), "
+            f"shrunk from {cell['from_events']} in "
+            f"{cell['shrink_runs']} runs"
+        )
+    return EXIT_VALID
 
 
 def _run_serve(opts) -> int:

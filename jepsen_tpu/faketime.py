@@ -70,3 +70,110 @@ def rand_factor(factor: float, rng: Optional[random.Random] = None) -> float:
     hi = 2 / (1 + 1 / factor)
     lo = hi / factor
     return lo + rng.random() * (hi - lo)
+
+
+def available(sess: Session) -> bool:
+    """Whether the faketime binary exists on a node.  Dummy remotes
+    return empty output for everything, which reads as absent — the
+    nemesis then skips the node cleanly."""
+    res = sess.exec_star("sh", "-c", "command -v faketime >/dev/null "
+                                     "2>&1 && echo yes")
+    return "yes" in (res.get("out") or "")
+
+
+def faketime_package(opts: dict) -> Optional[dict]:
+    """Nemesis package ({"faults": {"faketime", ...}}): wraps the DB
+    binary named by opts["faketime"]["binary"] so its processes see
+    time passing at a different rate per node, and unwraps it on heal.
+    Capability-guarded twice: without a configured binary the package
+    is skipped entirely (returns None), and a node without the
+    faketime executable is skipped at invoke time.
+
+    The wrap takes effect when the DB next (re)starts the binary —
+    compose it with the kill fault for a mid-run rate change.  Every
+    wrap journals a fault-ledger intent whose ``faketime-unwrap``
+    compensator is data-replayable, so `jepsen repair` can restore the
+    displaced binary after a control-plane crash."""
+    faults = opts.get("faults") or set()
+    if "faketime" not in faults:
+        return None
+    fopts = opts.get("faketime") or {}
+    cmd = fopts.get("binary")
+    if not cmd:
+        return None
+    from .control import on_nodes
+    from .generator.core import cycle, sleep as gen_sleep
+    from .history import Op
+    from .nemesis import ledger as fault_ledger
+    from .nemesis.core import Nemesis
+    from .nemesis.faults import _pick_nodes
+
+    factor = float(fopts.get("factor", 5.0))
+
+    class FaketimeNemesis(Nemesis):
+        def invoke(self, test: dict, op: Op) -> Op:
+            if op.f == "start-faketime":
+                v = op.value if isinstance(op.value, dict) else {}
+                nodes = _pick_nodes(test, v.get("nodes"))
+                rate = float(v.get("rate") or rand_factor(factor))
+                fault_ledger.intent(
+                    test, "process", nodes=[str(n) for n in nodes],
+                    params={"f": "faketime", "cmd": cmd, "rate": rate},
+                    compensator={"type": "faketime-unwrap", "cmd": cmd,
+                                 "nodes": [str(n) for n in nodes]},
+                    tag="faketime",
+                )
+
+                def act(sess: Session, node: str):
+                    if not available(sess):
+                        return "skipped: no faketime binary"
+                    with sess.su():
+                        wrap(sess, cmd, rate=rate)
+                    return {"wrapped": cmd, "rate": rate}
+
+                return op.replace(value=on_nodes(test, act, nodes))
+            if op.f == "stop-faketime":
+                if fault_ledger.heal_guard():
+                    return op.replace(value="heal abandoned")
+
+                def undo(sess: Session, node: str):
+                    with sess.su():
+                        unwrap(sess, cmd)
+                    return "unwrapped"
+
+                nodes = _pick_nodes(test, op.value)
+                res = on_nodes(test, undo, nodes)
+                fault_ledger.healed(test, tag="faketime")
+                return op.replace(value=res)
+            raise ValueError(f"unknown faketime f {op.f!r}")
+
+        def teardown(self, test: dict) -> None:
+            if fault_ledger.heal_guard():
+                return
+            try:
+                on_nodes(
+                    test,
+                    lambda sess, node: unwrap(sess, cmd),
+                    list((test.get("sessions") or {}).keys()),
+                )
+                fault_ledger.healed(test, tag="faketime", by="teardown")
+            except Exception:  # noqa: BLE001 — ledger keeps the record
+                pass
+
+        def fs(self) -> set:
+            return {"start-faketime", "stop-faketime"}
+
+    interval = opts.get("interval", 10.0)
+    return {
+        "nemesis": FaketimeNemesis(),
+        "generator": cycle([
+            gen_sleep(interval),
+            {"type": "info", "f": "start-faketime", "value": None},
+            gen_sleep(interval),
+            {"type": "info", "f": "stop-faketime", "value": None},
+        ]),
+        "final-generator": {"type": "info", "f": "stop-faketime",
+                            "value": None},
+        "perf": [{"name": "faketime", "start": {"start-faketime"},
+                  "stop": {"stop-faketime"}}],
+    }

@@ -4,7 +4,7 @@ Equivalent of /root/reference/jepsen/src/jepsen/nemesis.clj plus the
 nemesis/ subtree (combined packages, clock faults, membership churn).
 """
 
-from . import ledger
+from . import ledger, search
 from .core import (
     Compose,
     FMap,
@@ -52,6 +52,7 @@ __all__ = [
     "partition_random_halves",
     "partition_random_node",
     "partitioner",
+    "search",
     "split_one",
     "timeout",
 ]

@@ -294,24 +294,61 @@ def compose_packages(packages: Sequence[dict]) -> dict:
     )
 
 
+def _membership_package(opts: dict) -> Optional[dict]:
+    from .membership import membership_package
+    return membership_package(opts)
+
+
+def _lazyfs_package(opts: dict) -> Optional[dict]:
+    from ..lazyfs import lazyfs_package
+    return lazyfs_package(opts)
+
+
+def _faketime_package(opts: dict) -> Optional[dict]:
+    from ..faketime import faketime_package
+    return faketime_package(opts)
+
+
+#: The family registry: name -> (faults served, constructor), in
+#: composition order.  One constructor may serve several fault names
+#: (kill and pause share one DBNemesis — building it twice would race
+#: two nemeses over the same processes).  Constructors stay
+#: capability-guarded: each may return None when its faults are absent
+#: from opts["faults"] or a capability is missing (no corruption file
+#: path, no FUSE for lazyfs, no faketime binary), and callers drop the
+#: Nones.  Membership and friends import lazily to keep fault-free
+#: startup cheap and cycle-free.
+FAMILY_PACKAGES: dict = {
+    "partition": ({"partition"}, partition_package),
+    "db": ({"kill", "pause"}, db_package),
+    "packet": ({"packet"}, packet_package),
+    "clock": ({"clock"}, clock_package),
+    "file-corruption": ({"file-corruption"}, file_corruption_package),
+    "membership": ({"membership"}, _membership_package),
+    "lazyfs": ({"lazyfs"}, _lazyfs_package),
+    "faketime": ({"faketime"}, _faketime_package),
+}
+
+
+def registry_packages(opts: Optional[dict] = None) -> list:
+    """Instantiates every registered package whose served faults
+    intersect opts["faults"], in registry order.  Entries may be None
+    (capability-guarded constructors); `compose_packages` drops them."""
+    opts = opts or {}
+    faults = set(opts.get("faults") or set())
+    return [
+        ctor(opts)
+        for served, ctor in FAMILY_PACKAGES.values()
+        if faults & served
+    ]
+
+
 def nemesis_package(opts: Optional[dict] = None) -> dict:
     """The one-stop constructor (combined.clj:508-568): opts["faults"]
-    from {"partition", "kill", "pause", "packet", "clock",
-    "file-corruption", "membership", "lazyfs"} (membership needs
-    opts["membership"]["state"], see nemesis/membership.py)."""
-    from ..lazyfs import lazyfs_package
-    from .membership import membership_package
-
+    from the FAMILY_PACKAGES registry — {"partition", "kill", "pause",
+    "packet", "clock", "file-corruption", "membership", "lazyfs",
+    "faketime"} (membership needs opts["membership"]["state"], lazyfs
+    needs FUSE, faketime needs opts["faketime"]["binary"])."""
     opts = opts or {}
     opts.setdefault("faults", {"partition"})
-    return compose_packages(
-        [
-            partition_package(opts),
-            db_package(opts),
-            packet_package(opts),
-            clock_package(opts),
-            file_corruption_package(opts),
-            membership_package(opts),
-            lazyfs_package(opts),
-        ]
-    )
+    return compose_packages(registry_packages(opts))

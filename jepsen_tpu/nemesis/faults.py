@@ -403,6 +403,18 @@ class TruncateFile(Nemesis):
         spec = op.value
         if not isinstance(spec, dict) or "file" in spec:
             spec = {n: spec for n in test.get("nodes") or []}
+        fault_ledger.intent(
+            test, "file", nodes=[str(n) for n in spec],
+            params={"f": "truncate",
+                    "files": sorted({str(s.get("file")) for s in
+                                     spec.values() if isinstance(s, dict)})},
+            compensator={
+                "type": "unreplayable",
+                "note": "file truncation is unrecoverable — restore the "
+                        "file from backup or reprovision the node",
+            },
+            tag="truncate",
+        )
 
         def trunc(sess: Session, node: str):
             s = spec[node]
@@ -429,6 +441,18 @@ class Bitflip(Nemesis):
         spec = op.value
         if not isinstance(spec, dict) or "file" in spec:
             spec = {n: spec for n in test.get("nodes") or []}
+        fault_ledger.intent(
+            test, "file", nodes=[str(n) for n in spec],
+            params={"f": "bitflip",
+                    "files": sorted({str(s.get("file")) for s in
+                                     spec.values() if isinstance(s, dict)})},
+            compensator={
+                "type": "unreplayable",
+                "note": "bitflip corruption is unrecoverable — restore the "
+                        "file from backup or reprovision the node",
+            },
+            tag="bitflip",
+        )
 
         def flip(sess: Session, node: str):
             s = spec[node]

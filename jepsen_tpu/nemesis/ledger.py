@@ -429,6 +429,13 @@ def run_compensator(test: dict, entry: dict) -> dict:
             if db is None:
                 raise RuntimeError("no live db object; pass one to repair")
             db.resume(test, sess, node)
+        elif ctype == "faketime-unwrap":
+            from .. import faketime
+            cmd = comp.get("cmd")
+            if not cmd:
+                raise RuntimeError("faketime-unwrap without a cmd")
+            with sess.su():
+                faketime.unwrap(sess, cmd)
         else:
             raise RuntimeError(f"unknown compensator type {ctype!r}")
 

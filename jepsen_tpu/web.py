@@ -91,6 +91,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._zip(path[len("/zip/"):])
             elif path.startswith("/telemetry/"):
                 self._telemetry(path[len("/telemetry/"):])
+            elif path.startswith("/search/"):
+                self._search(path[len("/search/"):])
             elif path.rstrip("/") == "/fleet":
                 self._fleet()
             else:
@@ -102,8 +104,26 @@ class Handler(http.server.BaseHTTPRequestHandler):
             self._send(500, _page("error", f"<pre>{html.escape(repr(e))}</pre>"))
 
     def _index(self) -> None:
+        # Fault-search dirs (`jepsen search` state under
+        # <store>/<name>-search/) get their own coverage-panel links
+        # and stay out of the test-run table — their subdirs are
+        # corpus/cells/runs, not timestamped runs.
+        search_names = set()
+        root = self.store_dir
+        if os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                d = os.path.join(root, name)
+                if os.path.isfile(os.path.join(d, "search.json")):
+                    search_names.add(name)
+        searches = [
+            f"<li><a href='/search/{urllib.parse.quote(n)}'>"
+            f"{html.escape(n)}</a></li>"
+            for n in sorted(search_names)
+        ]
         rows = []
         for name, runs in sorted(store.tests(self.store_dir).items()):
+            if name in search_names:
+                continue
             for t, d in sorted(runs.items(), reverse=True):
                 v = _validity(d)
                 rel = os.path.relpath(d, self.store_dir)
@@ -123,7 +143,11 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 )
         body = (
             "<p><a href='/fleet'>checker fleet</a></p>"
-            "<table><tr><th>test</th><th>time</th><th>valid?</th>"
+            + (
+                "<h2>fault searches</h2><ul>" + "".join(searches)
+                + "</ul>" if searches else ""
+            )
+            + "<table><tr><th>test</th><th>time</th><th>valid?</th>"
             "<th></th><th></th></tr>"
             + "".join(rows)
             + "</table>"
@@ -334,6 +358,87 @@ class Handler(http.server.BaseHTTPRequestHandler):
             + rows + "</table>" + "".join(extras)
         )
         self._send(200, _page(f"telemetry: {rel}", body))
+
+    def _search(self, rel: str) -> None:
+        """Coverage-growth panel for a `jepsen search` dir: the
+        search.json checkpoint's per-iteration coverage as inline
+        bars, the nemesis.search.* counters, and the shrunk
+        reproducer cells with links into corpus/cells files."""
+        root = os.path.realpath(self.store_dir)
+        search_dir = os.path.realpath(os.path.join(root, rel.strip("/")))
+        spath = os.path.join(search_dir, "search.json")
+        if not (search_dir.startswith(root + os.sep)
+                and os.path.isfile(spath)):
+            self._send(404, _page("404", "<p>no search state here</p>"))
+            return
+        try:
+            with open(spath) as f:
+                state = json.load(f)
+        except (OSError, ValueError) as e:
+            self._send(500, _page("error",
+                                  f"<pre>{html.escape(repr(e))}</pre>"))
+            return
+        q = urllib.parse.quote(rel.strip("/"))
+        iters = state.get("iterations") or []
+        peak = max((h.get("coverage") or 0 for h in iters), default=1)
+        irows = ""
+        for h in iters:
+            cov = h.get("coverage") or 0
+            width = int(300 * cov / max(1, peak))
+            why = ", ".join(h.get("interesting") or []) or "-"
+            irows += (
+                f"<tr><td>{h.get('i')}</td>"
+                f"<td>{html.escape(str(h.get('label')))}</td>"
+                f"<td>{h.get('events')}</td>"
+                f"<td>{html.escape(','.join(h.get('families') or []))}"
+                f"</td><td>+{h.get('new_features')}</td>"
+                f"<td><div style='background:#47a;height:0.8em;"
+                f"width:{width}px;display:inline-block'></div> "
+                f"{cov}</td>"
+                f"<td>{html.escape(why)}</td></tr>"
+            )
+        crows = "".join(
+            f"<tr><td><a href='/files/{q}/cells/"
+            f"{urllib.parse.quote(c.get('name', ''))}.json'>"
+            f"{html.escape(str(c.get('name')))}</a></td>"
+            f"<td>{html.escape(str(c.get('reason')))}</td>"
+            f"<td>{c.get('events')}</td><td>{c.get('from_events')}</td>"
+            f"<td>{c.get('shrink_runs')}</td></tr>"
+            for c in state.get("cells") or []
+        )
+        counters = state.get("counters") or {}
+        overview = [
+            ("families", ", ".join(state.get("families") or [])),
+            ("seed", state.get("seed")),
+            ("nodes / floor",
+             f"{state.get('n_nodes')} / {state.get('min_nodes')}"),
+            ("budget s", state.get("budget_s")),
+            ("coverage features", state.get("coverage")),
+            ("corpus entries", len(state.get("corpus") or [])),
+        ] + sorted(counters.items())
+        orows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td>{html.escape(str(v))}</td></tr>"
+            for k, v in overview
+        )
+        body = (
+            f"<p><a href='/files/{q}/search.json'>search.json</a> · "
+            f"<a href='/files/{q}/corpus/'>corpus</a> · "
+            f"<a href='/files/{q}/cells/'>cells</a> · "
+            f"<a href='/files/{q}/runs/'>runs</a></p>"
+            f"<table>{orows}</table>"
+            + (
+                "<h2>shrunk reproducers</h2><table><tr><th>cell</th>"
+                "<th>reason</th><th>events</th><th>from</th>"
+                "<th>shrink runs</th></tr>" + crows + "</table>"
+                if crows else "<p>no reproducer cells yet</p>"
+            )
+            + "<h2>coverage growth</h2><table><tr><th>#</th>"
+              "<th>label</th><th>events</th><th>families</th>"
+              "<th>new</th><th>coverage</th><th>interesting</th></tr>"
+            + irows + "</table>"
+        )
+        self._send(200, _page(f"fault search: {rel}", body))
 
     def _zip(self, rel: str) -> None:
         """Streams a test dir as a zip (web.clj's zip download).  Built

@@ -1,0 +1,303 @@
+#!/usr/bin/env python
+"""Coverage-guided search smoke: seeded `jepsen search` end-to-end.
+
+Runs the real search loop — CoreRunner, full core.run per iteration,
+corpus, shrinker, checkpoint — against an in-process dummy cluster
+with a PLANTED multi-fault bug: the register loses its acknowledged
+writes only when a process kill lands while a partition is open (the
+amnesia models a node dropping unsynced state exactly when it cannot
+re-replicate it).  Single-family schedules stay valid; only the
+composition is anomalous, so the search has something real to find
+and the shrinker something real to minimize.
+
+Asserts:
+
+  1. coverage strictly grows across the seed round (every seed
+     iteration contributes novel features);
+  2. the search discovers the planted anomaly and shrinks it to a
+     reproducer cell that still composes kill + partition in at most
+     three events;
+  3. every corpus entry replays deterministically — the replay's
+     stable features (verdicts, ledger outcomes, hang/error classes;
+     timing-bucketed counters excluded) match the recorded signature,
+     and its interesting-reasons match exactly;
+  4. nothing is left for `jepsen repair`: a post-hoc
+     heal_crashed_iterations sweep over the search dir finds no
+     outstanding ledger entries.
+
+Usage: JAX_PLATFORMS=cpu python tools/nemesis_search_smoke.py [budget_s]
+
+`run()` is importable so a slow-marked pytest test can exercise the
+same smoke CI runs.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from jepsen_tpu import net as jnet  # noqa: E402
+from jepsen_tpu.checker import core as chk_core  # noqa: E402
+
+from fault_matrix import _KillableDB, _MortalRegister  # noqa: E402
+
+NODES = ["n1", "n2", "n3"]
+#: Families under search: just the two whose composition is the bug.
+FAMILIES = ("partition", "kill")
+SEED = 3
+
+
+class _RecordingNet(jnet.IptablesNet):
+    """jnet.iptables (command no-ops on dummy remotes) that also keeps
+    a shared partition-open flag the amnesia DB reads."""
+
+    def __init__(self, cut: dict):
+        super().__init__()
+        self.cut = cut
+
+    def drop_all(self, test, grudge):
+        self.cut["active"] = True
+        super().drop_all(test, grudge)
+
+    def heal(self, test):
+        self.cut["active"] = False
+        super().heal(test)
+
+
+class _AmnesiaDB(_KillableDB):
+    """The planted bug: a kill inside an open partition rolls the
+    register back to None and leaves the store stale — acknowledged
+    writes are lost (a replica restarting from a torn log while it
+    cannot re-replicate).  Kills outside a partition are harmless."""
+
+    def __init__(self, dead: dict, cut: dict, state: dict):
+        super().__init__(dead)
+        self.cut = cut
+        self.state = state
+
+    def kill(self, test, sess, node):
+        if self.cut.get("active"):
+            self.state["v"] = None
+            self.state["stale"] = True
+        super().kill(test, sess, node)
+
+
+class _LostWriteChecker(chk_core.Checker):
+    """Writes are monotonically increasing, so under linearizability a
+    read may never observe a value below the highest write acknowledged
+    before the read began (None counts as below everything once any
+    write is acked).  A violating read IS a lost acknowledged write,
+    regardless of interleaving."""
+
+    def check(self, test, history, opts):
+        acked_max = None
+        floor: dict = {}  # process -> acked_max at that read's invoke
+        lost = []
+        for i, op in enumerate(history):
+            if not op.is_client_op:
+                continue
+            if op.f == "write":
+                if op.type == "ok" and (acked_max is None
+                                        or op.value > acked_max):
+                    acked_max = op.value
+            elif op.f == "read":
+                if op.is_invoke:
+                    floor[op.process] = acked_max
+                else:
+                    fl = floor.pop(op.process, None)
+                    if (op.type == "ok" and fl is not None
+                            and (op.value is None or op.value < fl)):
+                        lost.append(i)
+        if lost:
+            return {"valid": False,
+                    "anomaly-types": ["lost-write"],
+                    "lost-reads": lost[:8],
+                    "count": len(lost)}
+        return {"valid": True, "count": 0}
+
+
+class _AmnesiaRegister(_MortalRegister):
+    """_MortalRegister over a monotonic store: writes carry strictly
+    increasing values and the register rejects any write at or below
+    its current value (a worker delayed by a kill may retry a stale
+    value late — without the guard that's a legal regression and the
+    checker's floor rule would false-positive on it).  Once the
+    amnesia wipe hit, writes are refused entirely: the lost state
+    stays lost, so the planted anomaly is observable for the rest of
+    the run."""
+
+    def open(self, test, node):
+        if self.dead.get(node):
+            raise ConnectionRefusedError(f"{node} is dead")
+        return _AmnesiaRegister(self.state, self.lock, self.dead, node)
+
+    def invoke(self, test, op):
+        from jepsen_tpu.history import FAIL, OK
+
+        if op.f == "write":
+            if self.dead.get(self.node):
+                raise ConnectionResetError(f"{self.node} died mid-op")
+            with self.lock:
+                v = self.state["v"]
+                if (self.state.get("stale")
+                        or (v is not None and op.value <= v)):
+                    return op.complete(FAIL)
+                self.state["v"] = op.value
+                return op.complete(OK)
+        return super().invoke(test, op)
+
+
+def _factory(ignored_store: str):
+    """Fresh base test map per iteration: shared register + amnesia DB
+    + recording net, per-iteration state so runs don't contaminate
+    each other."""
+    def make() -> dict:
+        import itertools
+
+        from jepsen_tpu import checker as chk
+        from jepsen_tpu import generator as gen
+
+        state = {"v": None}
+        lock = threading.Lock()
+        dead: dict = {}
+        cut = {"active": False}
+        counter = itertools.count(1)
+        return {
+            "name": "search-smoke",
+            "nodes": list(NODES),
+            "concurrency": 3,
+            "store-dir": ignored_store,  # CoreRunner redirects to runs/
+            "ssh": {"dummy?": True},
+            "net": _RecordingNet(cut),
+            "db": _AmnesiaDB(dead, cut, state),
+            "client": _AmnesiaRegister(state, lock, dead=dead),
+            "generator": gen.stagger(0.02, gen.mix([
+                gen.FnGen(lambda: {"f": "read"}),
+                gen.FnGen(lambda: {"f": "write",
+                                   "value": next(counter)}),
+            ])),
+            "checker": chk.compose({
+                "stats": chk.Stats(),
+                "lost-write": _LostWriteChecker(),
+            }),
+            "node-loss-policy": "tolerate:1",
+        }
+    return make
+
+
+def _stable(sig) -> frozenset:
+    """Signature minus the timing-bucketed `c:` counter features —
+    what a deterministic replay must reproduce exactly."""
+    return frozenset(f for f in sig if not f.startswith("c:"))
+
+
+def run(budget_s: float = 60.0, max_iterations=None) -> int:
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.nemesis import search
+
+    tmp = tempfile.mkdtemp(prefix="jepsen-search-smoke-")
+    search_dir = os.path.join(tmp, "search")
+    runner = search.CoreRunner(
+        _factory(os.path.join(tmp, "store")), search_dir,
+        {"iteration-deadline": 30.0, "interval": 0.05},
+    )
+    telemetry.enable(True)
+    try:
+        out = search.run_search(
+            runner,
+            search_dir=search_dir,
+            n_nodes=len(NODES),
+            budget_s=budget_s,
+            seed=SEED,
+            families=FAMILIES,
+            min_nodes=2,
+            max_iterations=max_iterations,
+            shrink_attempts=8,
+        )
+    finally:
+        telemetry.enable(False)
+
+    history = out["history"]
+    assert len(history) >= len(FAMILIES), (
+        f"search ran only {len(history)} iteration(s)"
+    )
+
+    # 1. Coverage strictly grows across the seed round.
+    seed_round = history[:len(FAMILIES)]
+    for h in seed_round:
+        assert h["new_features"] > 0, (
+            f"seed iteration {h['label']} added no coverage: {h}"
+        )
+    covs = [h["coverage"] for h in seed_round]
+    assert covs == sorted(covs) and len(set(covs)) == len(covs), (
+        f"coverage did not strictly grow over the seed round: {covs}"
+    )
+
+    # 2. The planted kill-in-partition anomaly was found and shrunk
+    #    to a small composed reproducer.
+    anomaly = [c for c in out["cells"] if c["reason"] == "anomaly"]
+    assert anomaly, (
+        f"no anomaly reproducer found in {len(history)} iterations; "
+        f"cells={[c['name'] for c in out['cells']]}"
+    )
+    cell = anomaly[0]
+    sched = search.Schedule.from_json(cell["schedule"])
+    assert {"kill", "partition"} <= sched.families, (
+        f"reproducer lost the composition: {sorted(sched.families)}"
+    )
+    assert len(sched.events) <= 3, (
+        f"shrinker left {len(sched.events)} events"
+    )
+
+    # 3. Deterministic replay: every corpus entry reproduces its
+    #    recorded stable signature and reasons.
+    state = search.load_state(search_dir)
+    assert state is not None and state["coverage"] == out["coverage"]
+    corpus = search.Corpus(os.path.join(search_dir, "corpus"))
+    assert corpus.entries, "corpus is empty"
+    replayed = 0
+    for entry in corpus.entries:
+        got = search.replay(entry, runner)
+        want_sig = _stable(frozenset(entry["signature"]))
+        got_sig = _stable(search.signature(got))
+        assert got_sig == want_sig, (
+            f"corpus {entry['id']} replay diverged:\n"
+            f"  missing: {sorted(want_sig - got_sig)}\n"
+            f"  extra:   {sorted(got_sig - want_sig)}"
+        )
+        assert search.reasons(got) == list(entry["interesting"]), (
+            f"corpus {entry['id']} reasons changed on replay"
+        )
+        replayed += 1
+
+    # 4. Crash-safety: the whole search dir is repair-clean.
+    assert search.heal_crashed_iterations(search_dir) == {}, (
+        "search left outstanding ledger entries behind"
+    )
+
+    print(json.dumps({
+        "iterations": out["stats"]["iterations"],
+        "coverage": out["coverage"],
+        "corpus": out["corpus"],
+        "cells": [c["name"] for c in out["cells"]],
+        "reproducer-events": len(sched.events),
+        "replayed": replayed,
+        "search-dir": search_dir,
+    }, indent=2))
+    return 0
+
+
+def main(argv) -> int:
+    budget = float(argv[1]) if len(argv) > 1 else 60.0
+    return run(budget_s=budget)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv))
