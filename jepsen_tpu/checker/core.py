@@ -20,6 +20,7 @@ from collections import defaultdict
 from typing import Any, Callable, Iterable, Optional
 
 from .. import telemetry
+from ..telemetry import flight
 from ..history.core import INFO, INVOKE, OK, History, Op
 from ..utils import bounded_pmap, fraction
 
@@ -116,6 +117,9 @@ def check_safe(
         res = _timeout(budget_s * 1000.0, go, default=_BUDGET_BLOWN)
         if res is _BUDGET_BLOWN:
             telemetry.count("checker.budget-exceeded")
+            flight.note("checker-budget-exceeded",
+                        checker=type(c).__name__, budget_s=budget_s)
+            flight.dump("checker-budget-exceeded")
             return {
                 "valid": UNKNOWN,
                 "error": f"checker {checker_name(c)} exceeded its "

@@ -20,6 +20,7 @@ from __future__ import annotations
 from typing import Any, Optional
 
 from ..history.core import History
+from ..telemetry import profile
 from ..history.packed import pack_history
 from ..models.base import Model, PackedModel
 from .core import Checker
@@ -339,21 +340,30 @@ class Linearizable(Checker):
         from .wgl_event import check_wgl_event
 
         limit = self.time_limit_s if time_limit_s is None else time_limit_s
-        if algorithm == "event" or (
-            algorithm != "wgl" and packed.n > packed.n_ok
-        ):
-            return check_wgl_event(
-                packed,
-                pm,
-                max_configs=self.max_configs,
-                time_limit_s=limit,
-            ), "event"
-        return check_wgl_cpu(
-            packed,
-            pm,
-            max_configs=self.max_configs,
-            time_limit_s=limit,
-        ), "wgl"
+        with profile.capture(
+            "exact-cpu", ops=int(packed.n), ok=int(packed.n_ok),
+        ) as _pc:
+            _pc.knob(max_configs=self.max_configs, time_limit_s=limit)
+            if algorithm == "event" or (
+                algorithm != "wgl" and packed.n > packed.n_ok
+            ):
+                res, engine = check_wgl_event(
+                    packed,
+                    pm,
+                    max_configs=self.max_configs,
+                    time_limit_s=limit,
+                ), "event"
+            else:
+                res, engine = check_wgl_cpu(
+                    packed,
+                    pm,
+                    max_configs=self.max_configs,
+                    time_limit_s=limit,
+                ), "wgl"
+            _pc.knob(engine=engine)
+            _pc.outcome = res.valid
+            _pc.feature(explored=int(res.configs_explored))
+        return res, engine
 
     def _render(
         self,

@@ -129,9 +129,12 @@ class CheckerdClient:
         algorithm: str = "wgl-tpu",
         budget_s: Optional[float] = None,
         time_limit_s: Optional[float] = None,
+        trace: Optional[dict] = None,
     ) -> str:
         """Submits per-key op-dict lists (submit order = reply order);
-        returns the poll ticket."""
+        returns the poll ticket.  `trace` is the submitting run's
+        telemetry.trace_context(); daemon-side spans for this request
+        are stamped with it so they nest under the run's analyze span."""
         self._send(F_SUBMIT, {
             "run": run,
             "model": model_spec,
@@ -140,6 +143,7 @@ class CheckerdClient:
             "packed": False,
             "budget-s": budget_s,
             "time-limit-s": time_limit_s,
+            "trace": trace,
         })
         for i, ops in enumerate(subs_ops):
             for lo in range(0, len(ops), CHUNK_OPS) or (0,):
@@ -161,6 +165,7 @@ class CheckerdClient:
         algorithm: str = "wgl-tpu",
         budget_s: Optional[float] = None,
         time_limit_s: Optional[float] = None,
+        trace: Optional[dict] = None,
     ) -> str:
         """Submits already-packed columnar histories (history/packed.py
         PackedOps) as binary frames — the bulk-transport path."""
@@ -174,6 +179,7 @@ class CheckerdClient:
             "packed": True,
             "budget-s": budget_s,
             "time-limit-s": time_limit_s,
+            "trace": trace,
         })
         for i, p in enumerate(packs):
             self._send(F_PACKED, pack_key_frame(i, packed_to_bytes(p)))
@@ -345,6 +351,8 @@ class RemoteChecker(Checker):
                     algorithm=lin.algorithm,
                     budget_s=budget,
                     time_limit_s=lin.time_limit_s,
+                    trace=telemetry.trace_context()
+                    if telemetry.enabled() else None,
                 )
             payload = c.wait(ticket, deadline_s=deadline)
 
@@ -356,6 +364,11 @@ class RemoteChecker(Checker):
             )
         meta = payload.get("checkerd") or {}
         meta["addr"] = self.addr
+        # Adopt the daemon's spans for this request into our trace, so
+        # the run's trace.json (and tools/trace_merge.py) shows the
+        # cohort/settle work under the daemon's own pid.
+        telemetry.adopt_remote_events(meta.get("spans"),
+                                      pid=meta.get("pid"))
         if not independent:
             res = dict(krs[0])
             res["checkerd"] = meta

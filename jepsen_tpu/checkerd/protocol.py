@@ -13,7 +13,8 @@ can never be mistaken for an on-disk block.
 A submit conversation, client -> server:
 
     SUBMIT {"run", "model", "algorithm", "n-keys", "packed",
-            "budget-s", "time-limit-s"}
+            "budget-s", "time-limit-s",
+            "trace": {"trace-id", "parent-span"} | null}
     CHUNK  {"key": i, "ops": [op dicts...]}        (repeatable, ops mode)
     PACKED <u32 key-index><packed bytes>           (one per key, packed mode)
     COMMIT {}
@@ -23,6 +24,14 @@ A submit conversation, client -> server:
                                              "checkerd": {...meta}}
                                    | ERROR {"error"}
     STATS {}                      <- STATS_REPLY {...fleet stats...}
+
+The optional SUBMIT "trace" field is the submitting run's telemetry
+trace context (telemetry.trace_context()).  The daemon stamps the
+cohort's span events with it and ships them back in RESULT meta
+("spans" + "pid"), so the run's trace — and tools/trace_merge.py —
+can nest daemon-side work under the run's analyze span.  Absent or
+null means the submitter doesn't want span transport (older clients
+remain wire-compatible: unknown SUBMIT fields are ignored).
 
 Key identity never crosses the wire: the client submits subhistories in
 key order and the server replies with `key-results` in the same order,

@@ -22,6 +22,7 @@ ride back in each request's result metadata.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 import uuid
@@ -29,6 +30,7 @@ from typing import Any, Optional
 
 from .. import telemetry
 from ..checker.core import merge_valid
+from ..telemetry import flight, profile
 from ..utils import timeout as _timeout
 
 _BLOWN = object()
@@ -54,6 +56,7 @@ class Request:
         time_limit_s: Optional[float] = None,
         subs: Optional[dict[int, Any]] = None,
         packs: Optional[dict[int, Any]] = None,
+        trace: Optional[dict] = None,
     ):
         from .protocol import canonical_spec
 
@@ -65,6 +68,11 @@ class Request:
         self.time_limit_s = time_limit_s
         self.subs = subs or {}
         self.packs = packs or {}
+        #: The submitting run's trace context ({"trace-id",
+        #: "parent-span"}) — deliberately NOT part of `compat`: a
+        #: cohort merges requests from different traces, and each
+        #: request's copy of the cohort spans is stamped with its own.
+        self.trace = trace if isinstance(trace, dict) else None
         #: Cohort-compatibility key: requests merge iff this matches.
         self.compat = canonical_spec({
             "model": canonical_spec(model_spec),
@@ -87,10 +95,16 @@ class Scheduler:
         batch_window_s: float = 0.05,
         max_budget_s: Optional[float] = None,
         bound: Optional[int] = None,
+        profile_dir: Optional[str] = None,
     ):
         self.batch_window_s = batch_window_s
         self.max_budget_s = max_budget_s
         self.bound = bound
+        if profile_dir:
+            # The daemon's own fleet-wide profile store + postmortem
+            # dir: every cohort's pass records aggregate here.
+            profile.set_store(profile_dir)
+            flight.set_dir(profile_dir)
         self._cond = threading.Condition()
         self._queue: list[Request] = []
         self._tickets: dict[str, Request] = {}
@@ -248,6 +262,15 @@ class Scheduler:
                 "runs": runs,
             }
         out["devices"] = _device_info()
+        # Observability surface: the degrade ladder's last chip probe
+        # verdict and the fleet-wide profile-store aggregate (the
+        # daemon's store accumulates a record per pass across every
+        # run that ever submitted — the ROADMAP-3 training set).
+        from ..ops import degrade
+
+        out["chip-health"] = degrade.chip_state()
+        out["profile-records"] = profile.count_records()
+        out["profile-by-pass"] = profile.by_pass()
         return out
 
     # -- the worker ---------------------------------------------------------
@@ -362,20 +385,42 @@ class Scheduler:
         blown = False
         merged: dict[Any, dict] = {}
         steps: list = []
+        merged_runs_pre = len({r.run for r in group})
+        # Span capture window: everything the cohort records between
+        # mark and the capture below ships back to each member request
+        # (stamped with ITS trace context) so the submitting run's
+        # trace shows the daemon-side work.  The single worker thread
+        # serializes cohorts, so the global window is cohort-exact.
+        mark = telemetry.event_mark()
         t_check = time.monotonic()
-        if budget is not None and budget <= 0:
-            blown = True
-        elif budget is not None:
-            got = _timeout(budget * 1000.0, run_cohort, default=_BLOWN)
-            if got is _BLOWN:
+        with telemetry.span(
+            "checkerd.cohort",
+            runs=merged_runs_pre, requests=len(group),
+            keys=sum(r.n_keys for r in group),
+        ):
+            if budget is not None and budget <= 0:
                 blown = True
+            elif budget is not None:
+                got = _timeout(budget * 1000.0, run_cohort,
+                               default=_BLOWN)
+                if got is _BLOWN:
+                    blown = True
+                else:
+                    merged, steps = got
             else:
-                merged, steps = got
-        else:
-            merged, steps = run_cohort()
+                merged, steps = run_cohort()
         check_s = time.monotonic() - t_check
-        if blown and telemetry.enabled():
-            telemetry.count("checkerd.budget-exceeded")
+        cohort_spans = telemetry.events_between(mark)
+        # A long-lived daemon must not saturate the trace-event cap:
+        # each cohort's events are shipped then dropped.
+        telemetry.trim_events(mark)
+        if blown:
+            flight.note("checkerd-budget-exceeded",
+                        budget_s=budget,
+                        runs=[r.run for r in group])
+            flight.dump("checkerd-budget-exceeded")
+            if telemetry.enabled():
+                telemetry.count("checkerd.budget-exceeded")
 
         unknown = {
             "valid": "unknown",
@@ -401,6 +446,25 @@ class Scheduler:
                 "queue-wait-s": round(r.started_t - r.submitted_t, 4),
                 "check-s": round(check_s, 4),
             }
+            if cohort_spans:
+                # Each request gets its own stamped copy: the spans
+                # carry the SUBMITTER's trace id / parent span, so the
+                # client adopts them straight into its trace and
+                # trace_merge.py nests them under its analyze span.
+                spans = []
+                for ev in cohort_spans:
+                    e = dict(ev)
+                    attrs = dict(e.get("attrs") or {})
+                    if r.trace:
+                        if r.trace.get("trace-id"):
+                            attrs["trace_id"] = r.trace["trace-id"]
+                        if r.trace.get("parent-span"):
+                            attrs["parent_span"] = r.trace["parent-span"]
+                    if attrs:
+                        e["attrs"] = attrs
+                    spans.append(e)
+                meta["spans"] = spans
+                meta["pid"] = os.getpid()
             if blown:
                 meta["budget-exceeded"] = True
             if steps:

@@ -12,6 +12,8 @@ from __future__ import annotations
 
 import logging
 import socketserver
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Optional
 
 from . import DEFAULT_PORT
@@ -118,6 +120,7 @@ class _Submission:
             time_limit_s=meta.get("time-limit-s"),
             subs=subs,
             packs=self.packs,
+            trace=meta.get("trace"),
         )
 
 
@@ -201,14 +204,68 @@ def make_server(
     batch_window_s: float = 0.05,
     max_budget_s: Optional[float] = None,
     bound: Optional[int] = None,
+    profile_dir: Optional[str] = None,
 ) -> CheckerdServer:
     srv = CheckerdServer((host, port), _Handler)
     srv.scheduler = Scheduler(
         batch_window_s=batch_window_s,
         max_budget_s=max_budget_s,
         bound=bound,
+        profile_dir=profile_dir,
     )
     return srv
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    """Prometheus-text scrape endpoint for the daemon: process
+    telemetry plus scheduler gauges (queue depth, utilization,
+    profile-record count) and the one-hot chip_health family."""
+
+    scheduler: Scheduler  # class attribute bound by make_metrics_server
+
+    def do_GET(self) -> None:  # noqa: N802 — BaseHTTPRequestHandler API
+        if self.path.split("?", 1)[0] not in ("/metrics", "/metrics/"):
+            self.send_error(404)
+            return
+        from .. import telemetry
+        from ..ops import degrade
+
+        try:
+            st = self.scheduler.stats()
+            extra = {
+                "checkerd.queue-depth": st.get("queue-depth", 0),
+                "checkerd.utilization": st.get("utilization", 0.0),
+                "checkerd.uptime-s": st.get("uptime-s", 0.0),
+                "checkerd.requests": st.get("requests", 0),
+                "checkerd.cohorts": st.get("cohorts", 0),
+                "checkerd.merge-ratio": st.get("merge-ratio", 0.0),
+                "checkerd.profile-records": st.get("profile-records", 0),
+            }
+            body = telemetry.prometheus_text(
+                extra_gauges=extra, chip_state=degrade.chip_state(),
+            ).encode()
+        except Exception as e:  # noqa: BLE001 — a scrape must not 500
+            # the daemon into a restart loop; answer degraded instead.
+            body = f"# metrics error: {e!r}\n".encode()
+        self.send_response(200)
+        self.send_header("Content-Type",
+                         "text/plain; version=0.0.4; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args: Any) -> None:
+        log.debug("metrics: " + fmt, *args)
+
+
+def make_metrics_server(
+    scheduler: Scheduler, host: str = "127.0.0.1", port: int = 0,
+) -> ThreadingHTTPServer:
+    """A /metrics HTTP listener bound to `scheduler` (port 0 = ephemeral
+    for tests); the caller runs serve_forever in a daemon thread."""
+    handler = type("BoundMetrics", (_MetricsHandler,),
+                   {"scheduler": scheduler})
+    return ThreadingHTTPServer((host, port), handler)
 
 
 def serve(
@@ -217,13 +274,25 @@ def serve(
     *,
     batch_window_s: float = 0.05,
     max_budget_s: Optional[float] = None,
+    metrics_port: Optional[int] = None,
+    profile_dir: Optional[str] = None,
 ) -> None:
     """Blocking entrypoint for `jepsen checkerd`."""
     srv = make_server(
         host, port,
         batch_window_s=batch_window_s, max_budget_s=max_budget_s,
+        profile_dir=profile_dir,
     )
     bound_port = srv.server_address[1]
+    msrv = None
+    if metrics_port is not None:
+        msrv = make_metrics_server(srv.scheduler, host, metrics_port)
+        threading.Thread(
+            target=msrv.serve_forever, name="checkerd-metrics",
+            daemon=True,
+        ).start()
+        log.info("checkerd /metrics on %s:%d",
+                 host, msrv.server_address[1])
     log.info("checkerd serving on %s:%d", host, bound_port)
     print(f"checkerd serving on {host}:{bound_port} "
           f"(batch window {batch_window_s}s)")
@@ -235,6 +304,9 @@ def serve(
         srv.shutdown()
         srv.server_close()
         srv.scheduler.stop()
+        if msrv is not None:
+            msrv.shutdown()
+            msrv.server_close()
 
 
 def main(argv: Optional[list[str]] = None) -> int:
@@ -260,6 +332,17 @@ def main(argv: Optional[list[str]] = None) -> int:
         "--platform", default=None, choices=["cpu", "tpu"],
         help="pin the JAX backend before the first device touch",
     )
+    p.add_argument(
+        "--metrics-port", type=int, default=DEFAULT_PORT + 1,
+        metavar="P",
+        help="HTTP port for the Prometheus /metrics scrape surface "
+        f"(default {DEFAULT_PORT + 1}; -1 disables)",
+    )
+    p.add_argument(
+        "--profile-dir", default=None, metavar="DIR",
+        help="directory for the fleet-wide per-pass cost-profile "
+        "store (profiles.jsonl) and postmortem dumps",
+    )
     opts = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -273,5 +356,7 @@ def main(argv: Optional[list[str]] = None) -> int:
     serve(
         opts.host, opts.port,
         batch_window_s=opts.batch_window, max_budget_s=opts.max_budget,
+        metrics_port=None if opts.metrics_port < 0 else opts.metrics_port,
+        profile_dir=opts.profile_dir,
     )
     return 0

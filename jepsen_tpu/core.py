@@ -24,6 +24,7 @@ from typing import Any, Optional
 
 from . import db as jdb
 from . import interpreter, oses, store, telemetry
+from .telemetry import flight, profile
 from .checker.core import check_safe
 from .control import Session, health, with_sessions
 from .history import History
@@ -183,8 +184,18 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
             opts["dir"] = store.test_dir(test)
         except ValueError:
             pass
-    with telemetry.span("lifecycle.analyze"):
-        results = check_safe(checker, test, history, opts)
+    # The analyze span anchors cross-process nesting: its span id
+    # becomes the parent of every span done FOR this run elsewhere
+    # (checkerd cohorts, streaming commits), carried by the trace
+    # context the wire protocol propagates.
+    analyze_sid = telemetry.new_span_id()
+    telemetry.set_parent_span(analyze_sid)
+    try:
+        with telemetry.span("lifecycle.analyze", span_id=analyze_sid,
+                            trace_id=telemetry.trace_id()):
+            results = check_safe(checker, test, history, opts)
+    finally:
+        telemetry.set_parent_span(None)
     # Surface robustness events (op timeouts, blown checker budgets,
     # degradation-ladder steps) next to the verdicts they shaped, so a
     # report reader can tell a clean "valid" from a degraded one.
@@ -219,21 +230,38 @@ def run(test: dict) -> dict:
     "history" and "results" added.
 
     With JEPSEN_TELEMETRY=1 the run is a telemetry scope: the registry
-    is reset on entry, every lifecycle phase is spanned, and on exit
-    telemetry.json + trace.json land in the run's store dir with the
-    top-5 spans logged (telemetry/__init__.py)."""
-    telemetry.reset()
+    is reset on entry (scoped — fleet counters like nemesis.search.*
+    survive, telemetry/__init__.py FLEET_COUNTER_PREFIXES), every
+    lifecycle phase is spanned, and on exit telemetry.json +
+    trace.json land in the run's store dir with the top-5 spans
+    logged.  The scope also seeds the run's trace context (adopting
+    test["trace-parent"] when a search loop or parent run propagated
+    one), points the per-pass profile store and the flight recorder at
+    the store dir, and dumps a postmortem when the run crashes."""
+    telemetry.scoped_reset()
+    telemetry.seed_trace(test.get("trace-parent"))
+    flight.reset()
     with telemetry.span("lifecycle.prepare"):
         test = prepare_test(test)
         test = store.make_test_dir(test)
+    run_dir = store.test_dir(test)
+    profile.set_store(run_dir)
+    flight.set_dir(run_dir)
     try:
         return _run_prepared(test)
+    except BaseException as e:
+        flight.note("run-crashed", error=f"{type(e).__name__}: {e}",
+                    test=test.get("name"))
+        flight.dump("run-crashed")
+        raise
     finally:
         # Export in a finally: a crashed run is exactly the one whose
         # phase profile matters.
         if telemetry.enabled():
-            telemetry.export(store.test_dir(test))
+            telemetry.export(run_dir)
             telemetry.log_top_spans(log)
+        profile.set_store(None)
+        flight.set_dir(None)
 
 
 def _run_prepared(test: dict) -> dict:

@@ -30,6 +30,7 @@ from typing import Any, Callable, Optional
 
 from . import client as jepsen_client
 from . import telemetry
+from .telemetry import flight
 from .client import Client
 from .control import health
 from .control.core import RemoteDisconnected
@@ -397,6 +398,9 @@ def run(
                             thread, op.f, op_timeout,
                         )
                         telemetry.count("interpreter.op-timeouts")
+                        flight.note("op-timeout", thread=thread,
+                                    f=str(op.f), timeout_s=op_timeout)
+                        flight.dump("op-timeout")
                         stuck_node = getattr(workers[thread], "node", None)
                         if stuck_node is not None:
                             health.signal(test, stuck_node, "op-timeout")

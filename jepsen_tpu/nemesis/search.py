@@ -834,6 +834,11 @@ class CoreRunner:
 
         was_enabled = telemetry.enabled()
         telemetry.enable(True)
+        # The child run resets telemetry (core.run scoped_reset) but
+        # re-seeds from this: every iteration's spans share the search
+        # process's trace_id, so trace_merge can stitch a whole search
+        # into one timeline.
+        test["trace-parent"] = telemetry.trace_context()
         hang = False
         error = None
         run_dir = None
@@ -914,7 +919,13 @@ def heal_crashed_iterations(search_dir: str,
 def _count_preserving(stats: dict) -> None:
     """Re-emits the search's cumulative counters into the (run-reset)
     telemetry registry so `resilience_counters()` reflects the search
-    regardless of how many core.run resets happened since."""
+    regardless of how many core.run resets happened since.
+
+    core.run's telemetry.scoped_reset() already preserves
+    `nemesis.search.*` counters across iterations (see
+    telemetry.FLEET_COUNTER_PREFIXES), so under normal flow this is a
+    no-op; it remains as a backstop for externally-driven runners that
+    reset telemetry wholesale between iterations."""
     if not telemetry.enabled():
         return
     current = telemetry.resilience_counters()

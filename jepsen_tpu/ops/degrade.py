@@ -21,6 +21,7 @@ import threading
 from typing import Any, Optional
 
 from .. import telemetry
+from ..telemetry import flight
 
 #: Comma-separated tier names ("witness", "stream", "batched", "device"),
 #: or "all": each named tier raises a synthetic RESOURCE_EXHAUSTED at its
@@ -125,6 +126,7 @@ def record(tier: str, action: str, error: Optional[Any] = None) -> None:
     """Records one degradation step: a `wgl.degrade.<tier>.<action>`
     telemetry counter plus an event in the active capture (if any)."""
     telemetry.count(f"wgl.degrade.{tier}.{action}")
+    flight.note(f"degrade.{tier}.{action}")
     events = getattr(_tls, "events", None)
     if events is not None:
         ev = {"tier": tier, "action": action}
@@ -149,6 +151,25 @@ LOCKFILE_GLOB = "/tmp/libtpu_lockfile*"
 
 _chip_reset_lock = threading.Lock()
 _chip_reset_tried = False
+
+#: Last observed chip health, exported on /metrics as a one-hot
+#: `jepsen_chip_health{state=...}` gauge and on the web fleet page.
+#: "unprobed" until the first probe_chip()/try_chip_reset() call;
+#: "ok-after-reset" distinguishes a chip that needed the lockfile rung
+#: from one that was healthy all along.
+_chip_state = "unprobed"
+
+
+def chip_state() -> str:
+    """Returns the last observed chip health: one of
+    telemetry.CHIP_HEALTH_STATES ("unprobed", "ok", "wedged",
+    "ok-after-reset", "absent")."""
+    return _chip_state
+
+
+def _set_chip_state(state: str) -> None:
+    global _chip_state
+    _chip_state = state
 
 
 def reset_chip(pattern: str = LOCKFILE_GLOB) -> str:
@@ -192,11 +213,15 @@ def probe_chip(timeout_s: float = 90.0) -> str:
             timeout=timeout_s, capture_output=True,
         )
     except subprocess.TimeoutExpired:
+        _set_chip_state("wedged")
         return "wedged"
     if proc.returncode != 0:
+        _set_chip_state("absent")
         return "absent"
     platform = proc.stdout.decode(errors="replace").strip()
-    return "ok" if platform == "tpu" else "absent"
+    state = "ok" if platform == "tpu" else "absent"
+    _set_chip_state(state)
+    return state
 
 
 def try_chip_reset(error: Optional[BaseException] = None) -> bool:
@@ -224,8 +249,11 @@ def try_chip_reset(error: Optional[BaseException] = None) -> bool:
         return False
     note = reset_chip()
     ok = probe_chip() == "ok"
+    if ok:
+        _set_chip_state("ok-after-reset")
     telemetry.count("wgl.degrade.chip-reset")
     record("chip-reset", "recovered" if ok else "still-wedged",
            f"{note}; probe {'ok' if ok else 'failed'}"
            + (f" (after {type(error).__name__})" if error else ""))
+    flight.note("chip-reset", recovered=ok, detail=note)
     return ok

@@ -40,6 +40,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import profile
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
@@ -456,20 +457,27 @@ def check_wgl_device(
             r = remaining()
             return r is not None and r <= 0
 
-        with telemetry.span("wgl.witness"):
-            wres = check_wgl_witness(
-                packed, pm, info_window=NARROW_INFO_WINDOW,
-                time_limit_s=remaining(), width_hint=width_hint,
-                checkpoint_dir=checkpoint_dir,
-            )
-            if wres is None and not timed_out() and plan_drops(
-                packed, info_window=NARROW_INFO_WINDOW
-            ):
+        with profile.capture(
+            "witness", ops=int(N), ok=int(packed.n_ok),
+        ) as _pw:
+            _pw.knob(info_window=NARROW_INFO_WINDOW,
+                     width_hint=width_hint)
+            with telemetry.span("wgl.witness"):
                 wres = check_wgl_witness(
-                    packed, pm, info_window=WIDE_INFO_WINDOW,
+                    packed, pm, info_window=NARROW_INFO_WINDOW,
                     time_limit_s=remaining(), width_hint=width_hint,
                     checkpoint_dir=checkpoint_dir,
                 )
+                if wres is None and not timed_out() and plan_drops(
+                    packed, info_window=NARROW_INFO_WINDOW
+                ):
+                    _pw.knob(info_window=WIDE_INFO_WINDOW)
+                    wres = check_wgl_witness(
+                        packed, pm, info_window=WIDE_INFO_WINDOW,
+                        time_limit_s=remaining(), width_hint=width_hint,
+                        checkpoint_dir=checkpoint_dir,
+                    )
+            _pw.outcome = "hit" if wres is not None else "miss"
         if wres is not None:
             telemetry.count("wgl.witness.hit")
             return wres
@@ -482,206 +490,224 @@ def check_wgl_device(
                 elapsed_s=time.monotonic() - t0,
             )
 
-    SW = pm.state_width
-    n0 = 0
-    B = _bucket(beam, lo=256)
-    prev_active: Optional[np.ndarray] = None
-    member = None  # device (B, W) bool
-    states = None  # device (B, SW) i32
-    alive = None   # device (B,) bool
-    explored_total = 0
-    soft_incomplete = False  # gave up on exactness somewhere
-    device_retried = False   # one halved-beam retry on resource errors
+    def _bfs() -> WGLResult:
+        SW = pm.state_width
+        n0 = 0
+        B = _bucket(beam, lo=256)
+        prev_active: Optional[np.ndarray] = None
+        member = None  # device (B, W) bool
+        states = None  # device (B, SW) i32
+        alive = None   # device (B,) bool
+        explored_total = 0
+        soft_incomplete = False  # gave up on exactness somewhere
+        device_retried = False   # one halved-beam retry on resource errors
 
-    while n0 < N:
-        win = _window_tables(packed, n0, block, max_window)
-        if win is None:
-            return WGLResult(
-                valid="unknown",
-                configs_explored=explored_total,
-                reason="window-overflow",
-                elapsed_s=time.monotonic() - t0,
-            )
-        active, W, tables = win
-        h1v, h2v, sh1v, sh2v = _hash_vectors(W, SW)
+        while n0 < N:
+            win = _window_tables(packed, n0, block, max_window)
+            if win is None:
+                return WGLResult(
+                    valid="unknown",
+                    configs_explored=explored_total,
+                    reason="window-overflow",
+                    elapsed_s=time.monotonic() - t0,
+                )
+            active, W, tables = win
+            h1v, h2v, sh1v, sh2v = _hash_vectors(W, SW)
 
-        # Re-gather frontier bits from the previous window layout.
-        if prev_active is None:
-            base_member = np.zeros((B, W), dtype=bool)
-            base_states = np.tile(
-                np.asarray(pm.init_state, dtype=np.int32), (B, 1)
-            )
-            base_alive = np.zeros(B, dtype=bool)
-            base_alive[0] = True
-            member = jnp.asarray(base_member)
-            states = jnp.asarray(base_states)
-            alive = jnp.asarray(base_alive)
-        else:
-            # Host-side re-gather: device gathers here recompile per
-            # distinct (old, new) window shape pair and dominate runtime.
-            perm, present = window_regather(prev_active, active)
-            member_np = np.asarray(member)
-            Bcur = member_np.shape[0]
-            new_member = np.zeros((Bcur, W), dtype=bool)
-            new_member[:, : len(active)] = np.where(
-                present[None, :], member_np[:, perm], False
-            )
-            member = jnp.asarray(new_member)
+            # Re-gather frontier bits from the previous window layout.
+            if prev_active is None:
+                base_member = np.zeros((B, W), dtype=bool)
+                base_states = np.tile(
+                    np.asarray(pm.init_state, dtype=np.int32), (B, 1)
+                )
+                base_alive = np.zeros(B, dtype=bool)
+                base_alive[0] = True
+                member = jnp.asarray(base_member)
+                states = jnp.asarray(base_states)
+                alive = jnp.asarray(base_alive)
+            else:
+                # Host-side re-gather: device gathers here recompile per
+                # distinct (old, new) window shape pair and dominate runtime.
+                perm, present = window_regather(prev_active, active)
+                member_np = np.asarray(member)
+                Bcur = member_np.shape[0]
+                new_member = np.zeros((Bcur, W), dtype=bool)
+                new_member[:, : len(active)] = np.where(
+                    present[None, :], member_np[:, perm], False
+                )
+                member = jnp.asarray(new_member)
 
-        iters = min(block, N - n0)
-        # Snapshot for beam-overflow retry.
-        snap = (member, states, alive)
+            iters = min(block, N - n0)
+            # Snapshot for beam-overflow retry.
+            snap = (member, states, alive)
 
-        while True:
-            Cmax = cand_factor * B
-            # The step fn itself keys the cache (strong ref): an
-            # id() key can collide after GC address reuse and serve
-            # the wrong model's transition kernel.
-            key = (B, W, SW, Cmax, pm.jax_step, mesh)
-            fn = _block_fn_cache.get(key)
-            fresh_fn = fn is None
-            if fn is None:
-                if mesh is not None:
-                    fn = _make_block_fn_sharded(
-                        B, W, SW, Cmax, pm.jax_step, mesh
+            while True:
+                Cmax = cand_factor * B
+                # The step fn itself keys the cache (strong ref): an
+                # id() key can collide after GC address reuse and serve
+                # the wrong model's transition kernel.
+                key = (B, W, SW, Cmax, pm.jax_step, mesh)
+                fn = _block_fn_cache.get(key)
+                fresh_fn = fn is None
+                if fn is None:
+                    if mesh is not None:
+                        fn = _make_block_fn_sharded(
+                            B, W, SW, Cmax, pm.jax_step, mesh
+                        )
+                    else:
+                        fn = _make_block_fn(B, W, SW, Cmax, pm.jax_step)
+                    _block_fn_cache[key] = fn
+                targs = [
+                    jnp.asarray(tables["ret_w"]),
+                    jnp.asarray(tables["inv_w"]),
+                    jnp.asarray(tables["f_w"]),
+                    jnp.asarray(tables["a0_w"]),
+                    jnp.asarray(tables["a1_w"]),
+                    jnp.asarray(tables["ok_w"]),
+                    jnp.asarray(tables["fmin1"]),
+                    jnp.asarray(tables["f_has_ok"]),
+                    jnp.asarray(h1v),
+                    jnp.asarray(h2v),
+                    jnp.asarray(sh1v),
+                    jnp.asarray(sh2v),
+                ]
+                if telemetry.enabled():
+                    # Fresh cache entries pay jit trace+compile inside the
+                    # first call — "wgl.bfs.compile" vs "wgl.bfs.block" is
+                    # the compile/execute split the phase profile reports.
+                    telemetry.count(
+                        "wgl.h2d-bytes",
+                        int(sum(a.nbytes for a in tables.values()
+                                if hasattr(a, "nbytes"))),
+                    )
+                    telemetry.gauge("wgl.bfs.beam", B)
+                    telemetry.gauge("wgl.bfs.window", W)
+                    sp = telemetry.span(
+                        "wgl.bfs.compile" if fresh_fn else "wgl.bfs.block"
                     )
                 else:
-                    fn = _make_block_fn(B, W, SW, Cmax, pm.jax_step)
-                _block_fn_cache[key] = fn
-            targs = [
-                jnp.asarray(tables["ret_w"]),
-                jnp.asarray(tables["inv_w"]),
-                jnp.asarray(tables["f_w"]),
-                jnp.asarray(tables["a0_w"]),
-                jnp.asarray(tables["a1_w"]),
-                jnp.asarray(tables["ok_w"]),
-                jnp.asarray(tables["fmin1"]),
-                jnp.asarray(tables["f_has_ok"]),
-                jnp.asarray(h1v),
-                jnp.asarray(h2v),
-                jnp.asarray(sh1v),
-                jnp.asarray(sh2v),
-            ]
-            if telemetry.enabled():
-                # Fresh cache entries pay jit trace+compile inside the
-                # first call — "wgl.bfs.compile" vs "wgl.bfs.block" is
-                # the compile/execute split the phase profile reports.
-                telemetry.count(
-                    "wgl.h2d-bytes",
-                    int(sum(a.nbytes for a in tables.values()
-                            if hasattr(a, "nbytes"))),
-                )
-                telemetry.gauge("wgl.bfs.beam", B)
-                telemetry.gauge("wgl.bfs.window", W)
-                sp = telemetry.span(
-                    "wgl.bfs.compile" if fresh_fn else "wgl.bfs.block"
-                )
-            else:
-                sp = telemetry.span("")  # shared no-op
-            try:
-                degrade.maybe_fault("device")
-                # The bool() syncs stay inside the try: jitted dispatch
-                # is async, so execution failures raise at consumption.
-                with sp:
-                    out = fn(member, states, alive, jnp.int32(iters), *targs)
-                    member, states, alive, accepted, incomplete, explored, it_done = out
-                    accepted_b = bool(accepted)
-                    incomplete_b = bool(incomplete)
-            except Exception as e:  # noqa: BLE001
-                if not degrade.is_resource_error(e):
-                    raise
-                # Degradation ladder: the device (not the search) gave
-                # out.  Evict the compiled block fn, retry ONCE with a
-                # halved beam from the block snapshot, then settle for
-                # "unknown" — the dispatcher's CPU settle takes over.
-                _block_fn_cache.pop(key, None)
-                if device_retried or B <= 64:
-                    degrade.record("device", "fall-through", e)
+                    sp = telemetry.span("")  # shared no-op
+                try:
+                    degrade.maybe_fault("device")
+                    # The bool() syncs stay inside the try: jitted dispatch
+                    # is async, so execution failures raise at consumption.
+                    with sp:
+                        out = fn(member, states, alive, jnp.int32(iters), *targs)
+                        member, states, alive, accepted, incomplete, explored, it_done = out
+                        accepted_b = bool(accepted)
+                        incomplete_b = bool(incomplete)
+                except Exception as e:  # noqa: BLE001
+                    if not degrade.is_resource_error(e):
+                        raise
+                    # Degradation ladder: the device (not the search) gave
+                    # out.  Evict the compiled block fn, retry ONCE with a
+                    # halved beam from the block snapshot, then settle for
+                    # "unknown" — the dispatcher's CPU settle takes over.
+                    _block_fn_cache.pop(key, None)
+                    if device_retried or B <= 64:
+                        degrade.record("device", "fall-through", e)
+                        return WGLResult(
+                            valid="unknown",
+                            configs_explored=explored_total,
+                            reason="device-resource-error",
+                            elapsed_s=time.monotonic() - t0,
+                        )
+                    device_retried = True
+                    degrade.record("device", "retry-halved", e)
+                    B //= 2
+                    m0, s0, a0_ = snap
+                    # Frontier rows are packed alive-first; truncating live
+                    # rows beyond the new beam forfeits exactness, which
+                    # soft_incomplete degrades to "unknown" (never a false
+                    # conviction).
+                    if bool(a0_[B:].any()):
+                        soft_incomplete = True
+                    member = m0[:B]
+                    states = s0[:B]
+                    alive = a0_[:B]
+                    snap = (member, states, alive)
+                    continue
+                if telemetry.enabled():
+                    telemetry.count("wgl.bfs.rounds", int(it_done))
+
+                if accepted_b:
+                    explored_total += int(explored)
+                    return WGLResult(
+                        valid=True,
+                        configs_explored=explored_total,
+                        elapsed_s=time.monotonic() - t0,
+                    )
+                if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
+                    # The limit must bind inside the retry ladder too —
+                    # round-1 bug: a 45 s limit was ignored for 280 s+ while
+                    # the ladder doubled and recompiled.
+                    return WGLResult(
+                        valid="unknown",
+                        configs_explored=explored_total + int(explored),
+                        reason="time-limit",
+                        elapsed_s=time.monotonic() - t0,
+                    )
+                if incomplete_b and B < max_beam:
+                    # Retry this block with a wider beam, exactly.
+                    B *= 2
+                    m0, s0, a0_ = snap
+                    pad = B - m0.shape[0]
+                    member = jnp.pad(m0, ((0, pad), (0, 0)))
+                    states = jnp.pad(s0, ((0, pad), (0, 0)))
+                    alive = jnp.pad(a0_, (0, pad))
+                    snap = (member, states, alive)
+                    continue
+                if incomplete_b:
+                    soft_incomplete = True
+                explored_total += int(explored)
+                break
+
+            if not bool(alive.any()):
+                if soft_incomplete:
                     return WGLResult(
                         valid="unknown",
                         configs_explored=explored_total,
-                        reason="device-resource-error",
+                        reason="beam-overflow",
                         elapsed_s=time.monotonic() - t0,
                     )
-                device_retried = True
-                degrade.record("device", "retry-halved", e)
-                B //= 2
-                m0, s0, a0_ = snap
-                # Frontier rows are packed alive-first; truncating live
-                # rows beyond the new beam forfeits exactness, which
-                # soft_incomplete degrades to "unknown" (never a false
-                # conviction).
-                if bool(a0_[B:].any()):
-                    soft_incomplete = True
-                member = m0[:B]
-                states = s0[:B]
-                alive = a0_[:B]
-                snap = (member, states, alive)
-                continue
-            if telemetry.enabled():
-                telemetry.count("wgl.bfs.rounds", int(it_done))
-
-            if accepted_b:
-                explored_total += int(explored)
                 return WGLResult(
-                    valid=True,
+                    valid=False,
                     configs_explored=explored_total,
                     elapsed_s=time.monotonic() - t0,
                 )
             if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
-                # The limit must bind inside the retry ladder too —
-                # round-1 bug: a 45 s limit was ignored for 280 s+ while
-                # the ladder doubled and recompiled.
-                return WGLResult(
-                    valid="unknown",
-                    configs_explored=explored_total + int(explored),
-                    reason="time-limit",
-                    elapsed_s=time.monotonic() - t0,
-                )
-            if incomplete_b and B < max_beam:
-                # Retry this block with a wider beam, exactly.
-                B *= 2
-                m0, s0, a0_ = snap
-                pad = B - m0.shape[0]
-                member = jnp.pad(m0, ((0, pad), (0, 0)))
-                states = jnp.pad(s0, ((0, pad), (0, 0)))
-                alive = jnp.pad(a0_, (0, pad))
-                snap = (member, states, alive)
-                continue
-            if incomplete_b:
-                soft_incomplete = True
-            explored_total += int(explored)
-            break
-
-        if not bool(alive.any()):
-            if soft_incomplete:
                 return WGLResult(
                     valid="unknown",
                     configs_explored=explored_total,
-                    reason="beam-overflow",
+                    reason="time-limit",
                     elapsed_s=time.monotonic() - t0,
                 )
-            return WGLResult(
-                valid=False,
-                configs_explored=explored_total,
-                elapsed_s=time.monotonic() - t0,
-            )
-        if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
-            return WGLResult(
-                valid="unknown",
-                configs_explored=explored_total,
-                reason="time-limit",
-                elapsed_s=time.monotonic() - t0,
-            )
-        n0 += int(it_done)
-        prev_active = active
+            n0 += int(it_done)
+            prev_active = active
 
-    # Ran every level with live configs and never accepted: with an exact
-    # search this is unreachable (a full linearization covers all oks);
-    # degrade safely.
-    return WGLResult(
-        valid="unknown" if soft_incomplete else False,
-        configs_explored=explored_total,
-        reason="exhausted",
-        elapsed_s=time.monotonic() - t0,
-    )
+        # Ran every level with live configs and never accepted: with an exact
+        # search this is unreachable (a full linearization covers all oks);
+        # degrade safely.
+        return WGLResult(
+            valid="unknown" if soft_incomplete else False,
+            configs_explored=explored_total,
+            reason="exhausted",
+            elapsed_s=time.monotonic() - t0,
+        )
+
+    # The BFS pass record: shape features + plan knobs + the
+    # compile/execute split folded in from the wgl.bfs.compile /
+    # wgl.bfs.block spans via the span-exit hook (telemetry/profile.py).
+    with profile.capture(
+        "bfs", ops=int(N), ok=int(packed.n_ok),
+    ) as _pb:
+        _pb.knob(
+            beam=int(_bucket(beam, lo=256)), block=int(block),
+            max_beam=int(max_beam), max_window=int(max_window),
+            mesh=mesh is not None,
+        )
+        res = _bfs()
+        _pb.outcome = (f"unknown:{res.reason}"
+                       if res.valid == "unknown" else res.valid)
+        _pb.feature(explored=int(res.configs_explored))
+    return res

@@ -32,6 +32,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import profile
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
 from . import degrade
@@ -290,84 +291,109 @@ def check_wgl_batched(
     B = _bucket(beam, lo=32)
     batch_retried = False  # one halved-beam retry on resource errors
 
-    while todo:
-        if mesh is not None:
-            pad_t = n_dev * math.ceil(len(todo) / n_dev)
-        else:
-            pad_t = len(todo)
-        sel = np.asarray(todo + [todo[0]] * (pad_t - len(todo)))
-        fn = _get_kernel(B, bp.N, SW, cand_factor * B, pm.jax_step, mesh)
-        try:
-            degrade.maybe_fault("batched")
-            acc, alive_end, inc, expl = fn(
-                jnp.asarray(bp.ret[sel]),
-                jnp.asarray(bp.inv[sel]),
-                jnp.asarray(bp.f[sel]),
-                jnp.asarray(bp.a0[sel]),
-                jnp.asarray(bp.a1[sel]),
-                jnp.asarray(bp.okv[sel]),
-                jnp.asarray(init_state),
-                jnp.asarray(bp.n_ops[sel]),
-            )
-            # The host transfers stay inside the try: jitted dispatch is
-            # asynchronous, so execution failures raise at consumption.
-            acc = np.asarray(acc)
-            alive_end = np.asarray(alive_end)
-            inc = np.asarray(inc)
-            expl = np.asarray(expl)
-        except Exception as e:  # noqa: BLE001
-            if not degrade.is_resource_error(e):
-                raise
-            # Degradation ladder: evict the compiled kernel, retry ONCE
-            # with a halved beam (and cap the overflow ladder there so
-            # it can't climb back into the OOM region); a second
-            # failure hands every unsettled key to the CPU settle.
-            _kernel_cache.pop(
-                (B, bp.N, SW, cand_factor * B, pm.jax_step, mesh), None
-            )
-            if batch_retried or B <= 32:
-                degrade.record("batched", "fall-through", e)
-                for k in todo:
-                    verdict[k] = "unknown"
-                todo = []
+    # One cost record per batched pass: shape features, the beam plan,
+    # and the compile/execute split folded in from the span hook.
+    with profile.capture(
+        "batched", keys=K, ops=int(sum(p.n for p in packs)),
+    ) as _pb:
+        _pb.knob(beam=B, max_beam=int(max_beam),
+                 cand_factor=int(cand_factor), mesh=mesh is not None)
+        while todo:
+            if mesh is not None:
+                pad_t = n_dev * math.ceil(len(todo) / n_dev)
+            else:
+                pad_t = len(todo)
+            sel = np.asarray(todo + [todo[0]] * (pad_t - len(todo)))
+            # jax.jit is lazy: a cache-miss kernel pays trace+compile inside
+            # its first call, so the span name splits compile vs execute
+            # exactly like the witness/BFS tiers (the phase profile and the
+            # per-pass cost record both read this convention).
+            fresh_fn = (B, bp.N, SW, cand_factor * B, pm.jax_step,
+                        mesh) not in _kernel_cache
+            fn = _get_kernel(B, bp.N, SW, cand_factor * B, pm.jax_step, mesh)
+            sp = telemetry.span(
+                "wgl.batched.compile" if fresh_fn else "wgl.batched.block",
+                keys=len(todo), beam=B,
+            ) if telemetry.enabled() else telemetry.span("")
+            try:
+                degrade.maybe_fault("batched")
+                with sp:
+                    acc, alive_end, inc, expl = fn(
+                        jnp.asarray(bp.ret[sel]),
+                        jnp.asarray(bp.inv[sel]),
+                        jnp.asarray(bp.f[sel]),
+                        jnp.asarray(bp.a0[sel]),
+                        jnp.asarray(bp.a1[sel]),
+                        jnp.asarray(bp.okv[sel]),
+                        jnp.asarray(init_state),
+                        jnp.asarray(bp.n_ops[sel]),
+                    )
+                    # The host transfers stay inside the try: jitted
+                    # dispatch is asynchronous, so execution failures raise
+                    # at consumption.
+                    acc = np.asarray(acc)
+                    alive_end = np.asarray(alive_end)
+                    inc = np.asarray(inc)
+                    expl = np.asarray(expl)
+            except Exception as e:  # noqa: BLE001
+                if not degrade.is_resource_error(e):
+                    raise
+                # Degradation ladder: evict the compiled kernel, retry ONCE
+                # with a halved beam (and cap the overflow ladder there so
+                # it can't climb back into the OOM region); a second
+                # failure hands every unsettled key to the CPU settle.
+                _kernel_cache.pop(
+                    (B, bp.N, SW, cand_factor * B, pm.jax_step, mesh), None
+                )
+                if batch_retried or B <= 32:
+                    degrade.record("batched", "fall-through", e)
+                    for k in todo:
+                        verdict[k] = "unknown"
+                    todo = []
+                    continue
+                batch_retried = True
+                degrade.record("batched", "retry-halved", e)
+                B //= 2
+                max_beam = min(max_beam, B)
                 continue
-            batch_retried = True
-            degrade.record("batched", "retry-halved", e)
-            B //= 2
-            max_beam = min(max_beam, B)
-            continue
 
-        retry = []
-        for i, k in enumerate(todo):
-            explored[k] += int(expl[i])
-            if acc[i]:
-                verdict[k] = True
-            elif inc[i]:
-                # Inexact (beam/candidate overflow): a wider beam can
-                # genuinely settle it.
-                if B < max_beam:
-                    retry.append(k)
+            retry = []
+            for i, k in enumerate(todo):
+                explored[k] += int(expl[i])
+                if acc[i]:
+                    verdict[k] = True
+                elif inc[i]:
+                    # Inexact (beam/candidate overflow): a wider beam can
+                    # genuinely settle it.
+                    if B < max_beam:
+                        retry.append(k)
+                    else:
+                        verdict[k] = "unknown"
+                elif alive_end[i]:
+                    # Defensive guard: an exact search ended with a live
+                    # frontier but no acceptance, which shouldn't happen —
+                    # re-running with a wider beam can't change an exact
+                    # outcome, so don't ride the ladder (round-1 weak #5:
+                    # each rung recompiles); report unknown for the CPU
+                    # fallback to settle.
+                    verdict[k] = "unknown"
                 else:
-                    verdict[k] = "unknown"
-            elif alive_end[i]:
-                # Defensive guard: an exact search ended with a live
-                # frontier but no acceptance, which shouldn't happen —
-                # re-running with a wider beam can't change an exact
-                # outcome, so don't ride the ladder (round-1 weak #5:
-                # each rung recompiles); report unknown for the CPU
-                # fallback to settle.
-                verdict[k] = "unknown"
-            else:
-                verdict[k] = False  # exact search exhausted: invalid
-        todo = retry
-        if todo:
-            if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
-                for k in todo:
-                    verdict[k] = "unknown"
-                todo = []
-            else:
-                B *= 2
+                    verdict[k] = False  # exact search exhausted: invalid
+            todo = retry
+            if todo:
+                if time_limit_s is not None and time.monotonic() - t0 > time_limit_s:
+                    for k in todo:
+                        verdict[k] = "unknown"
+                    todo = []
+                else:
+                    B *= 2
 
+        _pb.outcome = {
+            "proven": sum(1 for v in verdict if v is True),
+            "refuted": sum(1 for v in verdict if v is False),
+            "unknown": sum(1 for v in verdict if v == "unknown"),
+        }
+        _pb.degraded = batch_retried or None
     if telemetry.enabled():
         # Tier populations for the cohort-settle ladder: an exact False
         # here is a device REFUTATION the settle tier can accept

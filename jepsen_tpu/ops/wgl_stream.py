@@ -55,6 +55,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import profile
 from ..history.packed import NO_RET, ST_OK, PackedOps
 from ..models.base import PackedModel
 from . import degrade
@@ -294,7 +295,10 @@ def check_wgl_witness_stream(
     # First pass spans every key; after any death the stream continues
     # segment-sized.
     span = K
-    with telemetry.span("wgl.stream", keys=K):
+    with profile.capture(
+        "stream", keys=K, ops=int(stream_timeline_len(packs)),
+    ) as _pp, telemetry.span("wgl.stream", keys=K):
+        _pp.knob(segment=seg, max_restarts=max_restarts)
         while start < K:
             remaining = None
             if time_limit_s is not None:
@@ -354,6 +358,11 @@ def check_wgl_witness_stream(
                     K - start,
                 )
                 break
+        _pp.feature(restarts=restarts, passes=passes)
+        _pp.outcome = {
+            "proven": sum(1 for v in verdicts if v is True),
+            "escalated": sum(1 for v in verdicts if v is None),
+        }
     if telemetry.enabled():
         telemetry.count("wgl.stream.keys-proven",
                         sum(1 for v in verdicts if v is True))

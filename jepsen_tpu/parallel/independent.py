@@ -23,6 +23,7 @@ from collections import OrderedDict
 from typing import Any, Callable, Iterable, NamedTuple, Optional
 
 from .. import telemetry
+from ..telemetry import profile
 from ..checker.core import Checker, check_safe, merge_valid
 from ..checker.linearizable import Linearizable
 from ..history.core import History, Op
@@ -533,170 +534,184 @@ class IndependentChecker(Checker):
              small-budget detail pass, unknowns for the exact engine)
              under bounded_pmap, every slice carved from the same
              tier budget."""
-        import logging
+        # One cost record for the whole settle pipeline; the
+        # chained span hook folds the batched children's
+        # compile/execute time into this record too.
+        with profile.capture(
+            "settle", keys=len(cohort_keys),
+            ops=int(sum(all_packs[k].n for k in cohort_keys)),
+        ) as _ps:
+            import logging
 
-        from ..checker.refute import check_refute
-        from ..ops.wgl_batched import check_wgl_batched
+            from ..checker.refute import check_refute
+            from ..ops.wgl_batched import check_wgl_batched
 
-        log = logging.getLogger(__name__)
-        groups: "OrderedDict[str, list]" = OrderedDict()
-        for k in cohort_keys:
-            d = _settle_digest(all_packs[k], pm)
-            groups.setdefault(d, []).append(k)
+            log = logging.getLogger(__name__)
+            groups: "OrderedDict[str, list]" = OrderedDict()
+            for k in cohort_keys:
+                d = _settle_digest(all_packs[k], pm)
+                groups.setdefault(d, []).append(k)
 
-        group_result: dict[str, dict] = {}
-        reps: list[str] = []
-        for d in groups:
-            hit = _memo_get(d)
-            if hit is not None:
-                group_result[d] = hit
-            else:
-                reps.append(d)
-        n_memo = sum(len(groups[d]) for d in group_result)
+            group_result: dict[str, dict] = {}
+            reps: list[str] = []
+            for d in groups:
+                hit = _memo_get(d)
+                if hit is not None:
+                    group_result[d] = hit
+                else:
+                    reps.append(d)
+            n_memo = sum(len(groups[d]) for d in group_result)
 
-        # Screen classifier: which representatives are provably invalid
-        # without any search.  Sound-when-fires; None = no opinion.
-        def screen_one(d: str):
-            b = budget_left()
-            try:
-                return check_refute(
-                    all_packs[groups[d][0]], pm,
-                    time_limit_s=30.0 if b is None else min(b, 30.0),
-                )
-            except Exception:  # noqa: BLE001 — a screen bug must not
-                log.warning("refutation screen failed for key %r",
-                            groups[d][0], exc_info=True)
-                return None  # change a verdict; the search tiers decide
-
-        screened = dict(zip(reps, bounded_pmap(screen_one, reps,
-                                               bound=self.bound)))
-        refuted_reps = [d for d in reps if screened[d] is not None]
-        survivors = [d for d in reps if screened[d] is None]
-
-        # Batched frontier BFS over the screen survivors.  Start the
-        # beam SMALL: the overflow-retry ladder re-batches only the
-        # keys that overflowed, so typical short per-key histories
-        # settle in the cheap narrow passes and only the rare wide key
-        # climbs.  Measured (200 keys x 100 ops, 8-dev CPU mesh,
-        # warm): start 32 = 1.8 s vs start 256 = 16.3 s — the
-        # per-step frontier work scales with the start width for
-        # EVERY key, paid even by keys the narrowest pass would
-        # settle.  32 is the kernel's smallest beam bucket
-        # (check_wgl_batched's _bucket lo=32; anything lower rounds
-        # up to it).  Worst case (all keys climb to max) the
-        # geometric ladder costs ~2x the final pass — bounded, and
-        # far rarer than the all-keys-small common case.
-        device_verdict: dict[str, Any] = {d: None for d in reps}
-        device_explored: dict[str, int] = {d: 0 for d in reps}
-        n_batched_proven = 0
-        if survivors:
-            batch = check_wgl_batched(
-                [all_packs[groups[d][0]] for d in survivors],
-                pm,
-                beam=min(lin.beam, 32),
-                max_beam=max(lin.max_beam, lin.beam),
-                mesh=mesh,
-                time_limit_s=budget_left(),
-            )
-            for i, d in enumerate(survivors):
-                device_verdict[d] = batch.valid[i]
-                device_explored[d] = int(batch.explored[i])
-                if batch.valid[i] is True:
-                    group_result[d] = {
-                        "valid": True,
-                        "algorithm": "wgl-tpu-batched",
-                        "configs-explored": int(batch.explored[i]),
-                    }
-                    _memo_put(d, group_result[d])
-                    n_batched_proven += 1
-
-        # Parallel CPU settle of everything still without a result:
-        # screen-refuted reps (the "settle" algorithm re-fires the
-        # cheap screen and renders the certificate), device-refuted
-        # reps (small detail slice; the exact device verdict stands if
-        # the slice expires), and device unknowns (exact engine).
-        todo = [d for d in reps if d not in group_result]
-
-        def settle_one(d: str) -> dict:
-            k = groups[d][0]
-            dv = device_verdict[d]
-            budget = budget_left()
-            if dv is False:
-                budget = (self.REFUTED_DETAIL_BUDGET_S if budget is None
-                          else min(budget, self.REFUTED_DETAIL_BUDGET_S))
-            single = Linearizable(
-                model,
-                "settle",
-                time_limit_s=budget,
-                max_configs=lin.max_configs,
-            )
-            r = check_safe(single, test, subs[k],
-                           {**opts, "history_key": k})
-            if dv is not None:
-                r["device-verdict"] = dv
-            if dv is False:
-                if r.get("valid") == "unknown":
-                    # The detail slice expired; the device refutation
-                    # is exact (search exhausted without overflow) and
-                    # settles the verdict on its own.
-                    r = {
-                        "valid": False,
-                        "algorithm": "wgl-tpu-batched",
-                        "configs-explored": device_explored[d],
-                        "device-verdict": False,
-                    }
-                elif r.get("valid") is True:
-                    # Exact engines disagreeing is a checker bug, not a
-                    # history property; surface it loudly and keep the
-                    # CPU verdict (parity with per-key exact checking).
-                    log.error(
-                        "device/CPU verdict mismatch on key %r: batched"
-                        " kernel proved invalid, exact engine proved "
-                        "valid — keeping the CPU verdict", k,
+            # Screen classifier: which representatives are provably invalid
+            # without any search.  Sound-when-fires; None = no opinion.
+            def screen_one(d: str):
+                b = budget_left()
+                try:
+                    return check_refute(
+                        all_packs[groups[d][0]], pm,
+                        time_limit_s=30.0 if b is None else min(b, 30.0),
                     )
-            return r
+                except Exception:  # noqa: BLE001 — a screen bug must not
+                    log.warning("refutation screen failed for key %r",
+                                groups[d][0], exc_info=True)
+                    return None  # change a verdict; the search tiers decide
 
-        n_screen = n_device_refuted = n_cpu = 0
-        screen_fired = set(refuted_reps)
-        for d, r in zip(todo, bounded_pmap(settle_one, todo,
-                                           bound=self.bound)):
-            group_result[d] = r
-            _memo_put(d, r)
-            if device_verdict[d] is False:
-                n_device_refuted += 1
-            elif d in screen_fired:
-                n_screen += 1
-            else:
-                n_cpu += 1
+            screened = dict(zip(reps, bounded_pmap(screen_one, reps,
+                                                   bound=self.bound)))
+            refuted_reps = [d for d in reps if screened[d] is not None]
+            survivors = [d for d in reps if screened[d] is None]
 
-        # Fan every group's verdict out: the representative carries the
-        # full result (positional certificate fields cite ITS slice of
-        # the history); other members share the sanitized verdict.
-        settled: dict[Any, dict] = {}
-        live = set(reps)
-        for d, members in groups.items():
-            r = group_result.get(d)
-            if r is None:  # defensive: unreachable
-                continue
-            if d in live:
-                settled[members[0]] = r
-                extra = members[1:]
-                n_memo += len(extra)
-            else:
-                extra = members  # cross-call memo hit: all share
-            for k2 in extra:
-                shared = _sanitize_settle(r)
-                shared["memo-hit"] = True
-                settled[k2] = shared
-        if telemetry.enabled():
-            telemetry.count("wgl.settle.screen-refuted", n_screen)
-            telemetry.count("wgl.settle.batched-proven",
-                            n_batched_proven)
-            telemetry.count("wgl.settle.batched-refuted",
-                            n_device_refuted)
-            telemetry.count("wgl.settle.cpu-settled", n_cpu)
-            telemetry.count("wgl.settle.memo-hit", n_memo)
-        return settled
+            # Batched frontier BFS over the screen survivors.  Start the
+            # beam SMALL: the overflow-retry ladder re-batches only the
+            # keys that overflowed, so typical short per-key histories
+            # settle in the cheap narrow passes and only the rare wide key
+            # climbs.  Measured (200 keys x 100 ops, 8-dev CPU mesh,
+            # warm): start 32 = 1.8 s vs start 256 = 16.3 s — the
+            # per-step frontier work scales with the start width for
+            # EVERY key, paid even by keys the narrowest pass would
+            # settle.  32 is the kernel's smallest beam bucket
+            # (check_wgl_batched's _bucket lo=32; anything lower rounds
+            # up to it).  Worst case (all keys climb to max) the
+            # geometric ladder costs ~2x the final pass — bounded, and
+            # far rarer than the all-keys-small common case.
+            device_verdict: dict[str, Any] = {d: None for d in reps}
+            device_explored: dict[str, int] = {d: 0 for d in reps}
+            n_batched_proven = 0
+            if survivors:
+                batch = check_wgl_batched(
+                    [all_packs[groups[d][0]] for d in survivors],
+                    pm,
+                    beam=min(lin.beam, 32),
+                    max_beam=max(lin.max_beam, lin.beam),
+                    mesh=mesh,
+                    time_limit_s=budget_left(),
+                )
+                for i, d in enumerate(survivors):
+                    device_verdict[d] = batch.valid[i]
+                    device_explored[d] = int(batch.explored[i])
+                    if batch.valid[i] is True:
+                        group_result[d] = {
+                            "valid": True,
+                            "algorithm": "wgl-tpu-batched",
+                            "configs-explored": int(batch.explored[i]),
+                        }
+                        _memo_put(d, group_result[d])
+                        n_batched_proven += 1
+
+            # Parallel CPU settle of everything still without a result:
+            # screen-refuted reps (the "settle" algorithm re-fires the
+            # cheap screen and renders the certificate), device-refuted
+            # reps (small detail slice; the exact device verdict stands if
+            # the slice expires), and device unknowns (exact engine).
+            todo = [d for d in reps if d not in group_result]
+
+            def settle_one(d: str) -> dict:
+                k = groups[d][0]
+                dv = device_verdict[d]
+                budget = budget_left()
+                if dv is False:
+                    budget = (self.REFUTED_DETAIL_BUDGET_S if budget is None
+                              else min(budget, self.REFUTED_DETAIL_BUDGET_S))
+                single = Linearizable(
+                    model,
+                    "settle",
+                    time_limit_s=budget,
+                    max_configs=lin.max_configs,
+                )
+                r = check_safe(single, test, subs[k],
+                               {**opts, "history_key": k})
+                if dv is not None:
+                    r["device-verdict"] = dv
+                if dv is False:
+                    if r.get("valid") == "unknown":
+                        # The detail slice expired; the device refutation
+                        # is exact (search exhausted without overflow) and
+                        # settles the verdict on its own.
+                        r = {
+                            "valid": False,
+                            "algorithm": "wgl-tpu-batched",
+                            "configs-explored": device_explored[d],
+                            "device-verdict": False,
+                        }
+                    elif r.get("valid") is True:
+                        # Exact engines disagreeing is a checker bug, not a
+                        # history property; surface it loudly and keep the
+                        # CPU verdict (parity with per-key exact checking).
+                        log.error(
+                            "device/CPU verdict mismatch on key %r: batched"
+                            " kernel proved invalid, exact engine proved "
+                            "valid — keeping the CPU verdict", k,
+                        )
+                return r
+
+            n_screen = n_device_refuted = n_cpu = 0
+            screen_fired = set(refuted_reps)
+            for d, r in zip(todo, bounded_pmap(settle_one, todo,
+                                               bound=self.bound)):
+                group_result[d] = r
+                _memo_put(d, r)
+                if device_verdict[d] is False:
+                    n_device_refuted += 1
+                elif d in screen_fired:
+                    n_screen += 1
+                else:
+                    n_cpu += 1
+
+            # Fan every group's verdict out: the representative carries the
+            # full result (positional certificate fields cite ITS slice of
+            # the history); other members share the sanitized verdict.
+            settled: dict[Any, dict] = {}
+            live = set(reps)
+            for d, members in groups.items():
+                r = group_result.get(d)
+                if r is None:  # defensive: unreachable
+                    continue
+                if d in live:
+                    settled[members[0]] = r
+                    extra = members[1:]
+                    n_memo += len(extra)
+                else:
+                    extra = members  # cross-call memo hit: all share
+                for k2 in extra:
+                    shared = _sanitize_settle(r)
+                    shared["memo-hit"] = True
+                    settled[k2] = shared
+            if telemetry.enabled():
+                telemetry.count("wgl.settle.screen-refuted", n_screen)
+                telemetry.count("wgl.settle.batched-proven",
+                                n_batched_proven)
+                telemetry.count("wgl.settle.batched-refuted",
+                                n_device_refuted)
+                telemetry.count("wgl.settle.cpu-settled", n_cpu)
+                telemetry.count("wgl.settle.memo-hit", n_memo)
+            _ps.outcome = {
+                "screen-refuted": n_screen,
+                "batched-proven": n_batched_proven,
+                "batched-refuted": n_device_refuted,
+                "cpu-settled": n_cpu,
+                "memo-hit": n_memo,
+            }
+            return settled
 
 
 def independent_checker(base: Checker, **kw: Any) -> IndependentChecker:

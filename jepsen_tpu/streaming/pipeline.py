@@ -38,6 +38,7 @@ import time
 from typing import Any, Optional
 
 from .. import telemetry
+from ..telemetry import profile
 from ..history.core import Op
 from ..history.packed import PackedBuilder
 from ..models.base import PackedModel
@@ -382,10 +383,30 @@ class StreamingSession:
         self.finished = True
         if not self.broken:
             try:
-                batch = self._buf.take()
-                if batch:
-                    self._ingest(batch)
-                self._finalize()
+                # The frontier/session cost record: final-drain device
+                # work (wgl.online.chunk spans) folds in via the span
+                # hook; mid-run device time rides as a feature from the
+                # frontier carries (it ran on the checker thread).
+                with profile.capture(
+                    "frontier",
+                    keys=len(self._builders) or 1,
+                    ops=int(self._ops_ingested),
+                ) as _pf:
+                    _pf.knob(mode=self.mode,
+                             advance_rows=self.advance_rows)
+                    batch = self._buf.take()
+                    if batch:
+                        self._ingest(batch)
+                    self._finalize()
+                    frontiers = list(self._frontiers.values())
+                    if self._frontier is not None:
+                        frontiers.append(self._frontier)
+                    _pf.feature(
+                        checks=self._checks,
+                        device_s=round(sum(
+                            fr.device_s for fr in frontiers), 6),
+                    )
+                    _pf.outcome = {"proven": len(self._verdicts)}
             except Exception as e:  # noqa: BLE001
                 self._break(f"{type(e).__name__}: {e}")
         if self._remote is not None:

@@ -194,6 +194,11 @@ class RemoteFeed:
             "streaming": True,
             "budget-s": self.budget_s,
             "time-limit-s": self.time_limit_s,
+            # The run's trace context rides the streamed submission
+            # too, so daemon spans for a mid-run feed still nest under
+            # the run that generated the ops.
+            "trace": telemetry.trace_context()
+            if telemetry.enabled() else None,
         })
         c.wf.flush()
         self._client = c

@@ -40,9 +40,11 @@ from __future__ import annotations
 import json
 import logging
 import os
+import re
 import threading
 import time
-from typing import Any, Optional
+import uuid
+from typing import Any, Callable, Optional
 
 log = logging.getLogger(__name__)
 
@@ -71,6 +73,23 @@ _gauges: dict[str, list] = {}
 _events: list[tuple] = []
 _events_dropped = 0
 
+#: Events adopted from *other* processes (checkerd RESULT meta["spans"])
+#: so a run's trace.json shows daemon-side work under its own pid.
+#: Wall-clock timestamped dicts, bounded to keep adoption cheap.
+MAX_FOREIGN_EVENTS = 4096
+_foreign: list[dict] = []
+
+# Trace context: every run scope mints a trace id; spans created by
+# work done *for* that run — in this process or a daemon — carry it so
+# tools/trace_merge.py can fuse the processes into one timeline.
+_trace_id: Optional[str] = None
+_parent_span: Optional[str] = None
+
+#: Per-thread span-exit hook: profile.capture() installs a callback
+#: `(span_name, dur_ns) -> None` to fold compile/execute span durations
+#: into the active pass record without touching the hot-path registry.
+_pass_hook = threading.local()
+
 
 def enabled() -> bool:
     return _enabled
@@ -84,13 +103,103 @@ def enable(on: bool = True) -> None:
 
 def reset() -> None:
     """Clears every registry — the start of a run scope."""
-    global _events_dropped
+    global _events_dropped, _trace_id, _parent_span
     with _lock:
         _span_stats.clear()
         _counters.clear()
         _gauges.clear()
         _events.clear()
+        _foreign.clear()
         _events_dropped = 0
+        _trace_id = None
+        _parent_span = None
+
+
+#: Counter prefixes whose values outlive a single run: the search loop
+#: and the online/streaming path accumulate across many core.run scopes
+#: (each of which resets telemetry), and checkerd fleet counters belong
+#: to the daemon, not any one request.  `scoped_reset` keeps these.
+FLEET_COUNTER_PREFIXES = (
+    "nemesis.search.",
+    "wgl.online.",
+    "checkerd.",
+)
+
+
+def scoped_reset(
+    prefix_keep: tuple = FLEET_COUNTER_PREFIXES,
+) -> None:
+    """`reset()` that preserves counters under `prefix_keep` — the
+    start-of-run scope for processes embedded in a longer-lived loop
+    (nemesis search, streaming feeds, checkerd clients), where a plain
+    reset would silently zero fleet-scoped counters."""
+    global _events_dropped, _trace_id, _parent_span
+    with _lock:
+        kept = {
+            k: v for k, v in _counters.items()
+            if any(k.startswith(p) for p in prefix_keep)
+        }
+        _span_stats.clear()
+        _counters.clear()
+        _counters.update(kept)
+        _gauges.clear()
+        _events.clear()
+        _foreign.clear()
+        _events_dropped = 0
+        _trace_id = None
+        _parent_span = None
+
+
+# ---------------------------------------------------------------------------
+# Trace context
+# ---------------------------------------------------------------------------
+
+
+def new_span_id() -> str:
+    """A fresh 16-hex span id (also used for trace ids)."""
+    return uuid.uuid4().hex[:16]
+
+
+def trace_id() -> str:
+    """The current trace id, minted lazily per run scope."""
+    global _trace_id
+    with _lock:
+        if _trace_id is None:
+            _trace_id = new_span_id()
+        return _trace_id
+
+
+def trace_context() -> dict:
+    """The propagatable context: ``{"trace-id", "parent-span"}``.
+    Sent over the checkerd wire (SUBMIT "trace" field), stored in
+    `test["trace-parent"]` for search child runs, and stamped onto
+    daemon-side spans so they nest under the originating run."""
+    return {"trace-id": trace_id(), "parent-span": _parent_span}
+
+
+def seed_trace(ctx: Optional[dict]) -> None:
+    """Adopts a propagated trace context (or mints a fresh one when
+    `ctx` is falsy/malformed) — called at the start of a run scope."""
+    global _trace_id, _parent_span
+    tid = psp = None
+    if isinstance(ctx, dict):
+        tid = ctx.get("trace-id") or ctx.get("trace_id")
+        psp = ctx.get("parent-span") or ctx.get("parent_span")
+    with _lock:
+        _trace_id = str(tid) if tid else new_span_id()
+        _parent_span = str(psp) if psp else None
+
+
+def set_parent_span(span_id: Optional[str]) -> None:
+    """Sets the span id subsequent propagated work should nest under
+    (core.analyze sets its analyze span's id here)."""
+    global _parent_span
+    _parent_span = span_id
+
+
+def set_pass_hook(cb: Optional[Callable[[str, int], None]]) -> None:
+    """Installs (or clears, with None) this thread's span-exit hook."""
+    _pass_hook.cb = cb
 
 
 class _NoopSpan:
@@ -150,6 +259,12 @@ class Span:
                 )
             else:
                 _events_dropped += 1
+        cb = getattr(_pass_hook, "cb", None)
+        if cb is not None:
+            try:
+                cb(self.name, dur)
+            except Exception:  # noqa: BLE001 — profiling must not
+                pass           # change a pass's outcome.
         return False
 
 
@@ -205,6 +320,7 @@ def summary() -> dict:
             "recorded_at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime()
             ),
+            "trace_id": _trace_id,
             "spans": spans,
             "counters": dict(_counters),
             "gauges": {
@@ -300,11 +416,84 @@ def settle_counters() -> dict[str, Any]:
     }
 
 
+# ---------------------------------------------------------------------------
+# Cross-process span transport
+# ---------------------------------------------------------------------------
+
+
+def event_mark() -> int:
+    """An opaque cursor into the trace-event buffer; pass it to
+    `events_between` to capture the events recorded since."""
+    with _lock:
+        return len(_events)
+
+
+def events_between(mark: int, limit: int = 256) -> list[dict]:
+    """The events appended since `mark`, as JSON-able dicts with
+    wall-clock timestamps — the payload checkerd attaches to RESULT
+    meta["spans"] so clients can adopt daemon-side work into their own
+    traces.  Bounded to `limit`; newest events win (the interesting
+    spans — cohort, settle tiers — close last)."""
+    with _lock:
+        evs = _events[mark:]
+    out = []
+    for name, t0_rel, dur, tid, tname, attrs in evs[-limit:]:
+        ev: dict[str, Any] = {
+            "name": name,
+            "t0_unix_s": _T0_WALL + t0_rel / 1e9,
+            "dur_s": dur / 1e9,
+            "tid": tid,
+            "thread": tname,
+        }
+        if attrs:
+            ev["attrs"] = dict(attrs)
+        out.append(ev)
+    return out
+
+
+def trim_events(mark: int) -> None:
+    """Truncates the trace-event buffer back to `mark` — a long-lived
+    daemon captures each cohort's events then trims, so the 200k cap
+    never saturates across weeks of uptime."""
+    global _events_dropped
+    with _lock:
+        if 0 <= mark <= len(_events):
+            del _events[mark:]
+            _events_dropped = 0
+
+
+def adopt_remote_events(events: Any, pid: Any = None) -> None:
+    """Adopts span events captured in another process (see
+    `events_between`) into this run's trace.  They render under their
+    own pid in `chrome_trace()`, timestamp-rebased via wall clock."""
+    if not _enabled or not isinstance(events, list):
+        return
+    with _lock:
+        room = MAX_FOREIGN_EVENTS - len(_foreign)
+        for ev in events[:max(0, room)]:
+            if not isinstance(ev, dict) or "name" not in ev:
+                continue
+            e = dict(ev)
+            if pid is not None:
+                e.setdefault("pid", pid)
+            _foreign.append(e)
+
+
+def foreign_events() -> list[dict]:
+    """The adopted cross-process events (copies)."""
+    with _lock:
+        return [dict(e) for e in _foreign]
+
+
 def chrome_trace() -> dict:
     """The recorded spans as a Chrome trace-event dict ("X" complete
-    events, µs timestamps) — Perfetto / chrome://tracing loadable."""
+    events, µs timestamps) — Perfetto / chrome://tracing loadable.
+    Adopted remote events (checkerd daemon spans) appear under their
+    own pid, rebased onto this process's clock via wall time."""
     with _lock:
         events = list(_events)
+        foreign = [dict(e) for e in _foreign]
+        tid_ = _trace_id
     pid = os.getpid()
     out = []
     tnames: dict[int, str] = {}
@@ -330,12 +519,42 @@ def chrome_trace() -> dict:
             "tid": tid,
             "args": {"name": tname},
         })
+    fpids: dict[Any, bool] = {}
+    for ev in foreign:
+        try:
+            ts_us = (float(ev["t0_unix_s"]) - _T0_WALL) * 1e6
+            dur_us = float(ev.get("dur_s", 0.0)) * 1e6
+        except (KeyError, TypeError, ValueError):
+            continue
+        fpid = ev.get("pid", 0)
+        e: dict[str, Any] = {
+            "name": ev["name"],
+            "cat": str(ev["name"]).split(".", 1)[0],
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": fpid,
+            "tid": ev.get("tid", 0),
+        }
+        if ev.get("attrs"):
+            e["args"] = ev["attrs"]
+        out.append(e)
+        fpids[fpid] = True
+    for fpid in fpids:
+        out.append({
+            "name": "process_name",
+            "ph": "M",
+            "pid": fpid,
+            "tid": 0,
+            "args": {"name": f"checkerd[{fpid}]"},
+        })
     return {
         "traceEvents": out,
         "displayTimeUnit": "ms",
         "otherData": {
             "source": "jepsen_tpu.telemetry",
             "t0_unix_s": _T0_WALL,
+            "trace_id": tid_,
         },
     }
 
@@ -375,3 +594,74 @@ def log_top_spans(logger: logging.Logger, n: int = 5) -> None:
         for name, st in tops
     ]
     logger.info("telemetry top spans: %s", "; ".join(parts))
+
+
+# ---------------------------------------------------------------------------
+# Prometheus scrape surface
+# ---------------------------------------------------------------------------
+
+_PROM_BAD = re.compile(r"[^a-zA-Z0-9_]")
+
+#: The chip-health states the degrade ladder can report; rendered
+#: one-hot so a scrape always sees the full state space.
+CHIP_HEALTH_STATES = (
+    "unprobed", "ok", "wedged", "ok-after-reset", "absent",
+)
+
+
+def _prom_name(name: str) -> str:
+    return "jepsen_" + _PROM_BAD.sub("_", name)
+
+
+def prometheus_text(
+    extra_gauges: Optional[dict] = None,
+    chip_state: Optional[str] = None,
+) -> str:
+    """The registry rendered in Prometheus text exposition format:
+    counters as `counter`, gauge last-values and span totals/counts as
+    `gauge`.  `extra_gauges` ({name: number}) lets a server mix in
+    surface-local values (queue depth, utilization); `chip_state`
+    renders the one-hot `jepsen_chip_health{state=...}` family."""
+    with _lock:
+        counters = dict(_counters)
+        gauges = {k: g[0] for k, g in _gauges.items()}
+        spans = {k: (c, t) for k, (c, t, _m) in _span_stats.items()}
+    lines: list[str] = []
+    for name in sorted(counters):
+        v = counters[name]
+        if not isinstance(v, (int, float)):
+            continue
+        pn = _prom_name(name) + "_total"
+        lines.append(f"# TYPE {pn} counter")
+        lines.append(f"{pn} {v}")
+    for name in sorted(gauges):
+        v = gauges[name]
+        if not isinstance(v, (int, float)):
+            continue
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    if spans:
+        lines.append("# TYPE jepsen_span_seconds_total counter")
+        lines.append("# TYPE jepsen_span_count_total counter")
+        for name in sorted(spans):
+            c, t = spans[name]
+            lines.append(
+                f'jepsen_span_seconds_total{{span="{name}"}} {t / 1e9:.6f}'
+            )
+            lines.append(f'jepsen_span_count_total{{span="{name}"}} {c}')
+    for name in sorted(extra_gauges or {}):
+        v = (extra_gauges or {})[name]
+        if not isinstance(v, (int, float)):
+            continue
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} gauge")
+        lines.append(f"{pn} {v}")
+    if chip_state is not None:
+        lines.append("# TYPE jepsen_chip_health gauge")
+        known = chip_state in CHIP_HEALTH_STATES
+        for st in CHIP_HEALTH_STATES:
+            hot = 1 if st == chip_state or (
+                st == "unprobed" and not known) else 0
+            lines.append(f'jepsen_chip_health{{state="{st}"}} {hot}')
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,240 @@
+"""Per-pass cost profiles: the training set for the ROADMAP-3 model.
+
+Every WGL checking pass (witness / stream / frontier / batched / BFS /
+settle / exact-CPU) runs under `capture()`, which assembles one
+structured record — history-shape features, plan knobs, the measured
+compile-vs-execute split, device-memory high-water mark, and the
+degradation/outcome — and appends it to a crash-safe JSONL store under
+the run's store dir (checkerd keeps its own store and aggregates
+fleet-wide counts into stats()).
+
+Crash-safety contract: `append` opens/appends/closes one line per
+record, so a SIGKILL mid-run loses at most the line being written;
+`read` skips torn or garbage lines instead of failing the file.  A
+learned cost model can therefore always train on whatever survived.
+
+Record schema (`SCHEMA_VERSION`, field-by-field meaning in
+doc/design.md "Fleet observatory"):
+
+    {"v", "ts", "trace_id", "pass", "features": {...},
+     "plan": {...}, "timing": {"compile_s", "execute_s", "total_s"},
+     "device": {"platform", "peak_bytes"}, "outcome", "degraded"}
+
+The compile/execute split rides the span taxonomy: span names ending
+``.compile`` accumulate into compile_s; execute spans (``.chunk`` /
+``.block``) into execute_s — both folded in via the per-thread
+span-exit hook, so nested passes (a settle cohort running batched
+kernels) see their children's device time without double bookkeeping.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Iterator, Optional
+
+from . import (  # noqa: F401 — the package is the registry
+    enabled,
+    set_pass_hook,
+    _pass_hook,
+    trace_id,
+)
+from . import count as _count
+
+log = logging.getLogger(__name__)
+
+SCHEMA_VERSION = 1
+
+#: File name of the profile store inside a store/run directory.
+PROFILE_FILE = "profiles.jsonl"
+
+#: Span-name suffixes classified as compilation / device execution.
+COMPILE_SUFFIXES = (".compile",)
+EXECUTE_SUFFIXES = (".chunk", ".block")
+
+_lock = threading.Lock()
+_store_path: Optional[str] = None
+
+
+def set_store(directory: Optional[str]) -> Optional[str]:
+    """Points the process's profile store at
+    `<directory>/profiles.jsonl` (None clears it).  Returns the path."""
+    global _store_path
+    with _lock:
+        if directory is None:
+            _store_path = None
+        else:
+            _store_path = os.path.join(directory, PROFILE_FILE)
+        return _store_path
+
+
+def store_path() -> Optional[str]:
+    with _lock:
+        return _store_path
+
+
+def append(record: dict) -> Optional[str]:
+    """Appends one record line to the store (crash-safe: a single
+    open-append-close).  No-op when telemetry is disabled or no store
+    is set; returns the path written, else None.  A profile write
+    failure must never change a pass's outcome."""
+    if not enabled():
+        return None
+    path = store_path()
+    if path is None:
+        return None
+    try:
+        line = json.dumps(record, sort_keys=True, default=repr)
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            f.write(line + "\n")
+        _count("profile.records")
+        return path
+    except (OSError, TypeError, ValueError) as e:
+        log.warning("profile append to %s failed: %r", path, e)
+        return None
+
+
+def read(path: str) -> list[dict]:
+    """Every intact record in a profile store; torn/garbage lines
+    (crash mid-append) are skipped, not fatal."""
+    out: list[dict] = []
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    except OSError:
+        pass
+    return out
+
+
+def count_records(path: Optional[str] = None) -> int:
+    """Intact-record count of a store (defaults to the active one)."""
+    p = path or store_path()
+    if not p:
+        return 0
+    return len(read(p))
+
+
+def by_pass(path: Optional[str] = None) -> dict[str, int]:
+    """{pass-name: record count} for a store — the per-tier coverage
+    view the CI smoke asserts on."""
+    p = path or store_path()
+    agg: dict[str, int] = {}
+    if not p:
+        return agg
+    for rec in read(p):
+        name = rec.get("pass") or "?"
+        agg[name] = agg.get(name, 0) + 1
+    return agg
+
+
+def _device_info() -> dict:
+    """Best-effort device platform + peak-memory HWM.  CPU backends
+    report no memory_stats; any failure degrades to nulls."""
+    info: dict[str, Any] = {"platform": None, "peak_bytes": None}
+    try:
+        import jax
+
+        dev = jax.local_devices()[0]
+        info["platform"] = getattr(dev, "platform", None)
+        stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+        if stats:
+            info["peak_bytes"] = stats.get(
+                "peak_bytes_in_use", stats.get("bytes_in_use")
+            )
+    except Exception:  # noqa: BLE001 — profiling never raises
+        pass
+    return info
+
+
+class Capture:
+    """The mutable record under assembly; `capture()` yields it."""
+
+    __slots__ = ("pass_name", "features", "plan", "outcome", "degraded",
+                 "_compile_ns", "_execute_ns", "_t0")
+
+    def __init__(self, pass_name: str):
+        self.pass_name = pass_name
+        self.features: dict[str, Any] = {}
+        self.plan: dict[str, Any] = {}
+        self.outcome: Any = None
+        self.degraded: Any = None
+        self._compile_ns = 0
+        self._execute_ns = 0
+        self._t0 = time.perf_counter_ns()
+
+    def feature(self, **kw: Any) -> None:
+        self.features.update(kw)
+
+    def knob(self, **kw: Any) -> None:
+        self.plan.update(kw)
+
+    def _on_span(self, name: str, dur_ns: int) -> None:
+        if name.endswith(COMPILE_SUFFIXES):
+            self._compile_ns += dur_ns
+        elif name.endswith(EXECUTE_SUFFIXES):
+            self._execute_ns += dur_ns
+
+    def record(self) -> dict:
+        total = time.perf_counter_ns() - self._t0
+        dev = _device_info()
+        return {
+            "v": SCHEMA_VERSION,
+            "ts": time.time(),
+            "trace_id": trace_id(),
+            "pass": self.pass_name,
+            "features": dict(self.features),
+            "plan": dict(self.plan),
+            "timing": {
+                "compile_s": round(self._compile_ns / 1e9, 6),
+                "execute_s": round(self._execute_ns / 1e9, 6),
+                "total_s": round(total / 1e9, 6),
+            },
+            "device": dev,
+            "outcome": self.outcome,
+            "degraded": self.degraded,
+        }
+
+
+@contextlib.contextmanager
+def capture(pass_name: str, **features: Any) -> Iterator[Capture]:
+    """Profiles one checking pass: installs the span-exit hook (chained
+    with any enclosing capture, so a settle cohort also sees its
+    batched children's compile/execute time), times the body, and
+    appends the assembled record on exit.  Cheap no-op when telemetry
+    is disabled."""
+    cap = Capture(pass_name)
+    cap.features.update(features)
+    if not enabled():
+        yield cap
+        return
+    prev = getattr(_pass_hook, "cb", None)
+
+    def hook(name: str, dur_ns: int) -> None:
+        cap._on_span(name, dur_ns)
+        if prev is not None:
+            prev(name, dur_ns)
+
+    set_pass_hook(hook)
+    try:
+        yield cap
+    except Exception as e:
+        if cap.outcome is None:
+            cap.outcome = f"error:{type(e).__name__}"
+        raise
+    finally:
+        set_pass_hook(prev)
+        append(cap.record())

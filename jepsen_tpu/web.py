@@ -95,6 +95,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._search(path[len("/search/"):])
             elif path.rstrip("/") == "/fleet":
                 self._fleet()
+            elif path.rstrip("/") == "/metrics":
+                self._metrics()
             else:
                 self._send(404, _page("404", "<p>not found</p>"))
         except BrokenPipeError:
@@ -198,6 +200,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
             ("cohorts merged (>1 run)", stats.get("cohorts-merged")),
             ("merge ratio", stats.get("merge-ratio")),
             ("models cached", stats.get("models-cached")),
+            ("chip health", stats.get("chip-health")),
+            ("profile records", stats.get("profile-records")),
             ("verdict latency mean s", lat.get("mean-s")),
             ("verdict latency max s", lat.get("max-s")),
         ]
@@ -225,6 +229,37 @@ class Handler(http.server.BaseHTTPRequestHandler):
             "checker fleet",
             f"<table>{orows}</table>" + runs_tbl + hint,
         ))
+
+    def _metrics(self) -> None:
+        """Prometheus text scrape surface: this process's telemetry
+        counters/gauges/span totals plus the chip-health one-hot.  The
+        dashboard usually runs in a different process from the test
+        runs, so the interesting numbers here are the daemon-side ones
+        when the dashboard and checkerd are co-hosted — checkerd also
+        exposes its own /metrics (see checkerd.server.make_metrics_server)
+        for the common split deployment."""
+        from . import telemetry
+        from .ops import degrade
+
+        extra = {}
+        try:
+            from .checkerd.client import fetch_stats
+
+            stats = fetch_stats(
+                self._query.get("addr", ["127.0.0.1:7462"])[0],
+                timeout=2.0,
+            )
+            for key in ("queue-depth", "utilization", "uptime-s",
+                        "requests", "cohorts", "merge-ratio",
+                        "profile-records"):
+                if stats.get(key) is not None:
+                    extra[f"checkerd.{key}"] = float(stats[key])
+        except Exception:  # noqa: BLE001 — scrape must not 500
+            pass
+        body = telemetry.prometheus_text(
+            extra_gauges=extra, chip_state=degrade.chip_state(),
+        ).encode()
+        self._send(200, body, ctype="text/plain; version=0.0.4")
 
     def _telemetry(self, rel: str) -> None:
         """Renders a run's telemetry.json (written by a

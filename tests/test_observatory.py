@@ -1,0 +1,325 @@
+"""Fleet observatory: trace propagation, cost profiles, flight
+recorder, and the Prometheus scrape surface.
+
+Covers the cross-process trace contract (daemon spans stamped with the
+submitting run's trace_id / analyze parent span), the trace_merge tool
+(one Perfetto-loadable timeline with both processes and flow bindings),
+the per-pass profile store (crash-safe JSONL with the compile/execute
+split and shape features), scoped_reset's fleet-counter preservation,
+and prometheus_text / chip_health rendering.
+"""
+
+import json
+import os
+import sys
+import threading
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.checkerd.client import RemoteChecker
+from jepsen_tpu.checkerd.server import make_server
+from jepsen_tpu.history.core import History
+from jepsen_tpu.models.registers import Register
+from jepsen_tpu.parallel.independent import KV, IndependentChecker
+from jepsen_tpu.telemetry import flight, profile
+
+sys.path.insert(
+    0, os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools"))
+from trace_merge import daemon_trace_from_spans, merge  # noqa: E402
+
+
+@pytest.fixture()
+def scope():
+    """Telemetry on, registry/trace/profile state clean on both sides."""
+    prior = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    try:
+        yield
+    finally:
+        profile.set_store(None)
+        flight.set_dir(None)
+        telemetry.reset()
+        telemetry.enable(prior)
+
+
+@pytest.fixture()
+def daemon():
+    srv = make_server("127.0.0.1", 0, batch_window_s=0.0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    try:
+        yield srv, f"127.0.0.1:{srv.server_address[1]}"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.scheduler.stop()
+        t.join(timeout=5)
+
+
+def _reg_ops(key="k", read_back=1, start=0, process=0):
+    return [
+        {"index": start, "type": "invoke", "process": process,
+         "f": "write", "value": KV(key, 1), "time": start},
+        {"index": start + 1, "type": "ok", "process": process,
+         "f": "write", "value": KV(key, 1), "time": start + 1},
+        {"index": start + 2, "type": "invoke", "process": process,
+         "f": "read", "value": KV(key, None), "time": start + 2},
+        {"index": start + 3, "type": "ok", "process": process,
+         "f": "read", "value": KV(key, read_back), "time": start + 3},
+    ]
+
+
+def _reg_history(key="k", read_back=1):
+    return History(_reg_ops(key, read_back))
+
+
+# ---------------------------------------------------------------------
+# Trace context plumbing
+
+
+def test_trace_context_mint_and_seed(scope):
+    tid = telemetry.trace_id()
+    assert tid and telemetry.trace_id() == tid  # stable once minted
+    ctx = telemetry.trace_context()
+    assert ctx["trace-id"] == tid
+    telemetry.reset()
+    assert telemetry.trace_id() != tid  # reset mints fresh
+    telemetry.seed_trace({"trace-id": tid, "parent-span": "beef"})
+    assert telemetry.trace_id() == tid
+    assert telemetry.trace_context()["parent-span"] == "beef"
+
+
+def test_scoped_reset_preserves_fleet_counters(scope):
+    telemetry.count("nemesis.search.healed-iterations", 3)
+    telemetry.count("wgl.online.chunks", 2)
+    telemetry.count("interpreter.op-timeouts", 5)
+    telemetry.scoped_reset()
+    kept = telemetry.summary()["counters"]
+    assert kept.get("nemesis.search.healed-iterations") == 3
+    assert kept.get("wgl.online.chunks") == 2
+    assert "interpreter.op-timeouts" not in kept
+
+
+# ---------------------------------------------------------------------
+# Daemon round-trip: spans carry the submitting run's trace identity
+
+
+def test_daemon_spans_carry_run_trace(scope, daemon):
+    _, addr = daemon
+    sid = telemetry.new_span_id()
+    tid = telemetry.trace_id()
+    telemetry.set_parent_span(sid)
+    try:
+        with telemetry.span("lifecycle.analyze",
+                            span_id=sid, trace_id=tid):
+            res = RemoteChecker(
+                IndependentChecker(Linearizable(Register())),
+                addr, run_id="trace-run", fallback=False,
+            ).check({"name": "trace-run"}, _reg_history(), {})
+    finally:
+        telemetry.set_parent_span(None)
+    assert res["valid"] is True
+    spans = res["checkerd"].get("spans")
+    assert spans, "RESULT meta must carry daemon spans"
+    for ev in spans:
+        assert ev["attrs"]["trace_id"] == tid, ev
+        assert ev["attrs"]["parent_span"] == sid, ev
+    assert any(ev["name"] == "checkerd.cohort" for ev in spans)
+    # The client adopted them: the run's own chrome trace shows the
+    # daemon's pid as a second process.
+    doc = telemetry.chrome_trace()
+    pids = {e.get("pid") for e in doc["traceEvents"]}
+    assert res["checkerd"]["pid"] in pids
+    assert doc["otherData"]["trace_id"] == tid
+
+
+def test_trace_merge_two_processes_with_flows(scope, daemon, tmp_path):
+    _, addr = daemon
+    sid = telemetry.new_span_id()
+    tid = telemetry.trace_id()
+    telemetry.set_parent_span(sid)
+    try:
+        with telemetry.span("lifecycle.analyze",
+                            span_id=sid, trace_id=tid):
+            res = RemoteChecker(
+                IndependentChecker(Linearizable(Register())),
+                addr, run_id="merge-run", fallback=False,
+            ).check({"name": "merge-run"}, _reg_history(), {})
+    finally:
+        telemetry.set_parent_span(None)
+    meta = res["checkerd"]
+    run_doc = telemetry.chrome_trace()
+    daemon_doc = daemon_trace_from_spans(meta["spans"],
+                                         pid=meta.get("pid"))
+    merged = merge([run_doc, daemon_doc], labels=["run", "daemon"])
+    # Valid Chrome-trace JSON: serializable, traceEvents with the
+    # required keys, and both processes present.
+    blob = json.dumps(merged)
+    back = json.loads(blob)
+    assert isinstance(back["traceEvents"], list)
+    for ev in back["traceEvents"]:
+        assert "name" in ev and "ph" in ev and "pid" in ev
+    xpids = {e["pid"] for e in back["traceEvents"] if e["ph"] == "X"}
+    assert len(xpids) >= 2
+    assert merged["otherData"]["flows"] >= 1
+    # Every daemon span sits inside the analyze interval on the merged
+    # timeline (the daemon worked strictly during the run's analyze).
+    analyze = next(e for e in back["traceEvents"]
+                   if e["name"] == "lifecycle.analyze")
+    for ev in back["traceEvents"]:
+        if ev["ph"] == "X" and ev["name"] == "checkerd.cohort":
+            assert ev["ts"] >= analyze["ts"] - 1e3
+            assert ev["ts"] + ev.get("dur", 0) <= \
+                analyze["ts"] + analyze["dur"] + 1e3
+    # CLI round trip: files in, merged file out.
+    p1, p2 = tmp_path / "run.json", tmp_path / "daemon.json"
+    p1.write_text(json.dumps(run_doc))
+    p2.write_text(json.dumps(daemon_doc))
+    out = tmp_path / "merged.json"
+    import trace_merge
+    assert trace_merge.main(
+        ["-o", str(out), str(p1), str(p2)]) == 0
+    assert json.loads(out.read_text())["otherData"]["flows"] >= 1
+
+
+# ---------------------------------------------------------------------
+# Cost profiles
+
+
+def test_profile_record_per_pass_with_split(scope, tmp_path):
+    profile.set_store(str(tmp_path))
+    checker = IndependentChecker(Linearizable(Register()))
+    # Mixed validity: the invalid key escalates past the stream
+    # screen, so the settle pass runs too.
+    ops = _reg_ops("good", 1) + _reg_ops("bad", 9, start=4, process=1)
+    res = checker.check({"name": "prof"}, History(ops), {})
+    assert res["valid"] is False
+    agg = profile.by_pass()
+    assert agg, "checking must emit profile records"
+    assert "settle" in agg
+    recs = profile.read(profile.store_path())
+    for rec in recs:
+        assert rec["v"] == profile.SCHEMA_VERSION
+        assert rec["trace_id"] == telemetry.trace_id()
+        t = rec["timing"]
+        for k in ("compile_s", "execute_s", "total_s"):
+            assert isinstance(t[k], (int, float)), (rec["pass"], k)
+        assert t["total_s"] >= t["execute_s"] >= 0
+        assert rec["features"], rec["pass"]
+        assert "platform" in rec["device"]
+
+
+def test_profile_store_crash_safe(scope, tmp_path):
+    profile.set_store(str(tmp_path))
+    profile.append({"v": 1, "pass": "witness", "ok": True})
+    profile.append({"v": 1, "pass": "settle", "ok": True})
+    path = profile.store_path()
+    with open(path, "a") as f:
+        f.write('{"v": 1, "pass": "torn line, no clos')  # no newline
+    recs = profile.read(path)
+    assert [r["pass"] for r in recs] == ["witness", "settle"]
+    assert profile.count_records() == 2
+    assert profile.by_pass() == {"witness": 1, "settle": 1}
+
+
+def test_profile_disabled_is_noop(tmp_path):
+    prior = telemetry.enabled()
+    telemetry.enable(False)
+    try:
+        profile.set_store(str(tmp_path))
+        with profile.capture("witness", ops=4) as cap:
+            cap.knob(beam=8)
+        assert profile.count_records() == 0
+    finally:
+        profile.set_store(None)
+        telemetry.enable(prior)
+
+
+def test_capture_nesting_chains_hooks(scope, tmp_path):
+    profile.set_store(str(tmp_path))
+    import time as time_mod
+
+    with profile.capture("settle") as outer:
+        with profile.capture("batched") as inner:
+            # Long enough that the 6-decimal rounding in the record
+            # can't floor a real duration to zero.
+            with telemetry.span("wgl.batched.compile"):
+                time_mod.sleep(0.002)
+            with telemetry.span("wgl.batched.block"):
+                time_mod.sleep(0.002)
+        assert inner is not None and outer is not None
+    recs = {r["pass"]: r for r in profile.read(profile.store_path())}
+    # Both the inner pass and the enclosing settle see the split.
+    assert recs["batched"]["timing"]["compile_s"] > 0
+    assert recs["batched"]["timing"]["execute_s"] > 0
+    assert recs["settle"]["timing"]["compile_s"] > 0
+    assert recs["settle"]["timing"]["execute_s"] > 0
+
+
+# ---------------------------------------------------------------------
+# Flight recorder
+
+
+def test_flight_recorder_dump(scope, tmp_path):
+    flight.set_dir(str(tmp_path))
+    flight.reset()
+    flight.note("op-timeout", thread=3, f="write")
+    telemetry.count("interpreter.op-timeouts")
+    path = flight.dump("op-timeout")
+    assert path and os.path.isfile(path)
+    doc = json.load(open(path))
+    assert doc["reason"] == "op-timeout"
+    assert any(e["kind"] == "op-timeout" for e in doc["events"])
+    assert doc["counters"].get("interpreter.op-timeouts") == 1
+    assert flight.status()["dumps"] == 1
+
+
+def test_flight_recorder_bounded_and_silent(scope, tmp_path):
+    flight.set_dir(str(tmp_path))
+    flight.reset()
+    for i in range(flight.MAX_EVENTS * 2):
+        flight.note("spam", i=i)
+    assert len(flight.events()) == flight.MAX_EVENTS
+    flight.set_dir(None)
+    flight.note("after-clear")  # must not raise with no dir set
+    assert flight.dump("nowhere") is None
+
+
+# ---------------------------------------------------------------------
+# Prometheus scrape surface
+
+
+def test_prometheus_text_renders_registry(scope):
+    telemetry.count("checker.budget-exceeded", 2)
+    telemetry.gauge("queue.depth", 7)
+    with telemetry.span("wgl.witness.chunk"):
+        pass
+    text = telemetry.prometheus_text(
+        extra_gauges={"checkerd.utilization": 0.5},
+        chip_state="ok-after-reset",
+    )
+    assert "jepsen_checker_budget_exceeded_total 2" in text
+    assert "jepsen_queue_depth 7" in text
+    assert 'jepsen_span_count_total{span="wgl.witness.chunk"} 1' in text
+    assert "jepsen_checkerd_utilization 0.5" in text
+    # chip health is one-hot over the full state space.
+    hot = [ln for ln in text.splitlines()
+           if ln.startswith("jepsen_chip_health{")]
+    assert len(hot) == len(telemetry.CHIP_HEALTH_STATES)
+    assert sum(float(ln.rsplit(" ", 1)[1]) for ln in hot) == 1.0
+    assert 'state="ok-after-reset"} 1' in text
+
+
+def test_prometheus_unknown_chip_state_maps_to_unprobed(scope):
+    text = telemetry.prometheus_text(chip_state="martian")
+    assert 'jepsen_chip_health{state="unprobed"} 1' in text
+
+
+def test_chip_state_accessor():
+    from jepsen_tpu.ops import degrade
+
+    assert degrade.chip_state() in telemetry.CHIP_HEALTH_STATES

@@ -58,10 +58,24 @@ def measure(name: str, hist, n_bad: int, reps: int, platform: str,
     from jepsen_tpu.parallel.mesh import default_mesh
     from jepsen_tpu.utils import summarize_times
 
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.telemetry import flight, profile
+
     chk = IndependentChecker(
         Linearizable(cas_register(), time_limit_s=time_limit_s)
     )
     test = {"mesh": default_mesh()}
+    # With JEPSEN_TELEMETRY=1 the observatory rides along: the record
+    # gains profile_records + flight status so the BENCH trajectory
+    # prices the instrumentation's own overhead (<2% target on the
+    # mixed shape).
+    profile_dir = None
+    if telemetry.enabled():
+        import tempfile
+
+        profile_dir = tempfile.mkdtemp(prefix=f"bench-profiles-{name}-")
+        profile.set_store(profile_dir)
+        flight.reset()
     times = []
     for rep in range(reps + 1):  # rep 0 = compile warm-up, not counted
         clear_settle_memo()
@@ -83,12 +97,17 @@ def measure(name: str, hist, n_bad: int, reps: int, platform: str,
         if rep > 0:
             times.append(dt)
     stats = summarize_times(times)
-    return {
+    rec = {
         "metric": f"independent_{name}",
         "platform": platform,
         "ops_per_s": round((len(hist) / 2) / stats["median_s"], 1),
         **stats,
     }
+    if profile_dir is not None:
+        rec["profile_records"] = profile.count_records()
+        rec["flight"] = flight.status()
+        profile.set_store(None)
+    return rec
 
 
 def main() -> int:

@@ -1,0 +1,226 @@
+#!/usr/bin/env python
+"""CI smoke for the fleet observatory (tier1.yml step).
+
+Starts a real `jepsen_tpu.checkerd` daemon (with its /metrics server
+and a profile store), runs a small suite against it with telemetry on,
+and asserts the three observatory layers end-to-end:
+
+  * trace propagation — the daemon's RESULT meta["spans"] carry the
+    submitting run's trace_id / analyze parent span, and
+    tools/trace_merge.py fuses run + daemon into one Chrome trace with
+    both processes and at least one flow binding;
+  * cost profiles — the local profile store holds >= 1 record per
+    executed pass (settle plus its tiers), each with the
+    compile/execute/total timing split and non-empty shape features;
+    the daemon's own store also recorded its cohort passes;
+  * scrape surface — GET /metrics on the daemon parses as Prometheus
+    text with >= 1 counter and a full one-hot jepsen_chip_health
+    family.
+
+Exit 0 + "PASS" on success, exit 1 with a reason otherwise.  CPU-only:
+the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JEPSEN_TELEMETRY"] = "1"
+
+from jepsen_tpu import telemetry  # noqa: E402
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.checkerd.client import RemoteChecker  # noqa: E402
+from jepsen_tpu.history.core import History  # noqa: E402
+from jepsen_tpu.models.registers import Register  # noqa: E402
+from jepsen_tpu.parallel.independent import (  # noqa: E402
+    KV,
+    IndependentChecker,
+)
+from jepsen_tpu.telemetry import profile  # noqa: E402
+from trace_merge import daemon_trace_from_spans, merge  # noqa: E402
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def history(prefix: str) -> History:
+    ops = []
+
+    def add(process, f, key, value):
+        i = len(ops)
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": f, "value": KV(key, None if f == "read" else value),
+                    "time": i})
+        ops.append({"index": i + 1, "type": "ok", "process": process,
+                    "f": f, "value": KV(key, value), "time": i + 1})
+
+    add(0, "write", f"{prefix}-good", 1)
+    add(0, "read", f"{prefix}-good", 1)
+    add(1, "write", f"{prefix}-bad", 1)
+    add(1, "read", f"{prefix}-bad", 9)
+    return History(ops)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def main() -> None:
+    port, mport = free_port(), free_port()
+    addr = f"127.0.0.1:{port}"
+    tmp = tempfile.mkdtemp(prefix="observatory-smoke-")
+    run_dir = os.path.join(tmp, "run")
+    daemon_dir = os.path.join(tmp, "daemon")
+    env = dict(os.environ, JEPSEN_TELEMETRY="1", JAX_PLATFORMS="cpu")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.checkerd",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--metrics-port", str(mport),
+         "--profile-dir", daemon_dir,
+         "--batch-window", "0.2", "--platform", "cpu"],
+        env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1):
+                    break
+            except OSError:
+                if daemon.poll() is not None:
+                    fail(f"daemon exited early rc={daemon.returncode}")
+                if time.monotonic() > deadline:
+                    fail("daemon never started listening")
+                time.sleep(0.2)
+
+        telemetry.enable(True)
+        telemetry.reset()
+        profile.set_store(run_dir)
+        h = history("obs")
+        test = {"name": "observatory-smoke"}
+
+        # Mimic core.analyze's trace scope: the analyze span is the
+        # parent every propagated span must point back to.
+        sid = telemetry.new_span_id()
+        tid = telemetry.trace_id()
+        telemetry.set_parent_span(sid)
+        try:
+            with telemetry.span("lifecycle.analyze",
+                                span_id=sid, trace_id=tid):
+                expected = IndependentChecker(
+                    Linearizable(Register())).check(test, h, {})
+                got = RemoteChecker(
+                    IndependentChecker(Linearizable(Register())),
+                    addr, run_id="obs-run", fallback=False,
+                ).check(test, h, {})
+        finally:
+            telemetry.set_parent_span(None)
+
+        if got["valid"] != expected["valid"]:
+            fail(f"remote valid {got['valid']} != {expected['valid']}")
+        meta = got.get("checkerd") or {}
+        spans = meta.get("spans") or []
+        if not spans:
+            fail("RESULT meta carried no daemon spans")
+        for ev in spans:
+            attrs = ev.get("attrs") or {}
+            if attrs.get("trace_id") != tid:
+                fail(f"daemon span {ev['name']} trace_id "
+                     f"{attrs.get('trace_id')} != {tid}")
+            if attrs.get("parent_span") != sid:
+                fail(f"daemon span {ev['name']} parent_span "
+                     f"{attrs.get('parent_span')} != {sid}")
+
+        # --- trace merge: run + daemon on one timeline -------------
+        run_trace = telemetry.chrome_trace()
+        run_path = os.path.join(tmp, "run-trace.json")
+        with open(run_path, "w") as f:
+            json.dump(run_trace, f)
+        daemon_path = os.path.join(tmp, "daemon-trace.json")
+        with open(daemon_path, "w") as f:
+            json.dump(daemon_trace_from_spans(spans, pid=meta.get("pid")),
+                      f)
+        merged = merge([json.load(open(run_path)),
+                        json.load(open(daemon_path))],
+                       labels=["run", "daemon"])
+        mpath = os.path.join(tmp, "merged-trace.json")
+        with open(mpath, "w") as f:
+            json.dump(merged, f)
+        pids = {e.get("pid") for e in merged["traceEvents"]
+                if e.get("ph") == "X"}
+        if len(pids) < 2:
+            fail(f"merged trace has {len(pids)} pid(s), want >= 2")
+        if merged["otherData"]["flows"] < 1:
+            fail("merged trace has no flow bindings to the analyze span")
+
+        # --- profile store: a record per executed pass -------------
+        local = profile.by_pass()
+        if not local:
+            fail("local profile store is empty")
+        if "settle" not in local:
+            fail(f"no settle pass record in local store: {local}")
+        for rec in profile.read(profile.store_path()):
+            t = rec.get("timing") or {}
+            for k in ("compile_s", "execute_s", "total_s"):
+                if not isinstance(t.get(k), (int, float)):
+                    fail(f"record for pass {rec.get('pass')} missing "
+                         f"timing.{k}")
+            if not rec.get("features"):
+                fail(f"record for pass {rec.get('pass')} has no "
+                     "shape features")
+            if rec.get("trace_id") != tid:
+                fail(f"record for pass {rec.get('pass')} trace_id "
+                     f"{rec.get('trace_id')} != {tid}")
+        remote_profiles = profile.by_pass(
+            os.path.join(daemon_dir, profile.PROFILE_FILE))
+        if not remote_profiles:
+            fail("daemon profile store is empty")
+
+        # --- /metrics scrape ---------------------------------------
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=5,
+        ).read().decode()
+        counters = [ln for ln in body.splitlines()
+                    if ln and not ln.startswith("#")
+                    and "_total" in ln.split(" ")[0]]
+        if not counters:
+            fail(f"no counter samples in /metrics:\n{body[:500]}")
+        chip = {}
+        for ln in body.splitlines():
+            if ln.startswith("jepsen_chip_health{"):
+                state = ln.split('state="', 1)[1].split('"', 1)[0]
+                chip[state] = float(ln.rsplit(" ", 1)[1])
+        if set(chip) != set(telemetry.CHIP_HEALTH_STATES):
+            fail(f"chip_health states {sorted(chip)} != "
+                 f"{sorted(telemetry.CHIP_HEALTH_STATES)}")
+        if sum(chip.values()) != 1.0:
+            fail(f"chip_health not one-hot: {chip}")
+
+        print(f"PASS: {len(spans)} daemon spans propagated, "
+              f"merged trace {mpath} "
+              f"({merged['otherData']['flows']} flows), "
+              f"local passes {local}, daemon passes {remote_profiles}, "
+              f"{len(counters)} counters scraped, chip_health ok")
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+
+
+if __name__ == "__main__":
+    main()

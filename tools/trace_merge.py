@@ -1,0 +1,210 @@
+#!/usr/bin/env python
+"""Fuse Chrome traces from several processes into one Perfetto timeline.
+
+A run that submits work to checkerd (or spawns search children) ends up
+with its trace scattered across processes: the run's own trace.json, the
+daemon's cohort/settle spans (shipped back in RESULT meta["spans"] and
+adopted into the run trace, or exported from the daemon itself), and any
+child-run traces.  Each file carries `otherData.t0_unix_s` — the wall
+clock at that process's perf-counter origin — so they can be rebased
+onto one shared timeline:
+
+    python tools/trace_merge.py -o merged.json run/trace.json daemon.json
+
+The merge keeps each process under its own pid (colliding pids between
+files are offset), rebases every event's `ts` onto the earliest input's
+origin, and emits Chrome flow events ("s"/"f") binding daemon spans to
+the run span that caused them: a daemon event whose `args.parent_span`
+names a run event's `args.span_id` (and whose `args.trace_id` matches)
+gets an arrow from that run span in Perfetto's UI.
+
+`daemon_trace_from_spans` builds a merge-ready trace dict straight from
+RESULT meta["spans"], for tests and tooling that never wrote the daemon
+side to disk.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Any, Optional
+
+
+def daemon_trace_from_spans(spans: list, pid: Any = "checkerd") -> dict:
+    """A Chrome-trace dict from checkerd RESULT meta["spans"] (the
+    wall-clock event dicts produced by telemetry.events_between), so
+    the daemon side of a run can be merged without a daemon-side
+    trace.json.  The earliest span's wall clock becomes the document's
+    `otherData.t0_unix_s` origin and every ts is made relative to it —
+    exactly the shape telemetry's own trace.json exports have, so the
+    merge rebases this like any other input."""
+    origin = min(
+        (float(ev["t0_unix_s"]) for ev in spans or []
+         if isinstance(ev, dict) and "t0_unix_s" in ev),
+        default=0.0,
+    )
+    events: list[dict] = []
+    for ev in spans or []:
+        if not isinstance(ev, dict) or "name" not in ev:
+            continue
+        try:
+            ts_us = (float(ev["t0_unix_s"]) - origin) * 1e6
+            dur_us = float(ev.get("dur_s", 0.0)) * 1e6
+        except (KeyError, TypeError, ValueError):
+            continue
+        e: dict[str, Any] = {
+            "name": ev["name"],
+            "cat": str(ev["name"]).split(".", 1)[0],
+            "ph": "X",
+            "ts": ts_us,
+            "dur": dur_us,
+            "pid": ev.get("pid", pid),
+            "tid": ev.get("tid", 0),
+        }
+        if ev.get("attrs"):
+            e["args"] = dict(ev["attrs"])
+        events.append(e)
+    pids = {e["pid"] for e in events}
+    for p in pids:
+        events.append({
+            "name": "process_name", "ph": "M", "pid": p, "tid": 0,
+            "args": {"name": f"checkerd[{p}]"},
+        })
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {"source": "daemon_trace_from_spans",
+                      "t0_unix_s": origin},
+    }
+
+
+def _load(path: str) -> dict:
+    with open(path) as f:
+        doc = json.load(f)
+    if not isinstance(doc, dict) or "traceEvents" not in doc:
+        raise ValueError(f"{path}: not a Chrome trace "
+                         "(no traceEvents key)")
+    return doc
+
+
+def merge(docs: list[dict], labels: Optional[list[str]] = None) -> dict:
+    """Merges Chrome-trace dicts onto one timeline.  Each doc needs
+    `otherData.t0_unix_s`; docs without it are assumed already rebased
+    (offset 0).  Returns the merged trace dict."""
+    labels = labels or [f"trace{i}" for i in range(len(docs))]
+    origins = [
+        float((d.get("otherData") or {}).get("t0_unix_s") or 0.0)
+        for d in docs
+    ]
+    base = min((o for o in origins if o), default=0.0)
+
+    out: list[dict] = []
+    used_pids: set = set()
+    # span_id → rebased run event, for flow binding.
+    by_span_id: dict[str, dict] = {}
+    trace_ids: set = set()
+
+    for doc, origin, label in zip(docs, origins, labels):
+        offset_us = (origin - base) * 1e6 if origin else 0.0
+        # Offset colliding pids so two processes that happened to share
+        # a pid (common across hosts/containers) stay separate rows.
+        pid_map: dict[Any, Any] = {}
+        doc_pids = {e.get("pid") for e in doc["traceEvents"]}
+        bump = 0
+        for p in sorted(doc_pids, key=str):
+            q = p
+            while q in used_pids:
+                bump += 100000
+                q = (p + bump) if isinstance(p, int) else f"{p}+{bump}"
+            pid_map[p] = q
+            used_pids.add(q)
+        tid_ = (doc.get("otherData") or {}).get("trace_id")
+        if tid_:
+            trace_ids.add(tid_)
+        for ev in doc["traceEvents"]:
+            e = dict(ev)
+            e["pid"] = pid_map.get(ev.get("pid"), ev.get("pid"))
+            if e.get("ph") != "M":
+                try:
+                    e["ts"] = float(e.get("ts", 0.0)) + offset_us
+                except (TypeError, ValueError):
+                    pass
+            out.append(e)
+            args = e.get("args")
+            if (e.get("ph") == "X" and isinstance(args, dict)
+                    and args.get("span_id")):
+                by_span_id[str(args["span_id"])] = e
+
+    # Flow events: daemon/child spans that name a parent_span get an
+    # arrow from that span.  Perfetto draws ph "s" at the source and
+    # ph "f" (bp "e") at the destination, joined by matching id.
+    flows: list[dict] = []
+    flow_id = 0
+    for e in out:
+        args = e.get("args")
+        if not (e.get("ph") == "X" and isinstance(args, dict)):
+            continue
+        parent = args.get("parent_span")
+        if not parent or str(parent) not in by_span_id:
+            continue
+        src = by_span_id[str(parent)]
+        if src is e:
+            continue
+        if args.get("trace_id") and trace_ids \
+                and args["trace_id"] not in trace_ids:
+            continue
+        # Each flow id binds exactly one s→f pair, so the source span
+        # re-opens a fresh flow for every destination bound to it.
+        flow_id += 1
+        fid = f"span-flow-{flow_id}"
+        flows.append({
+            "name": "span-flow", "cat": "flow", "ph": "s",
+            "id": fid, "ts": src["ts"], "pid": src["pid"],
+            "tid": src.get("tid", 0),
+        })
+        flows.append({
+            "name": "span-flow", "cat": "flow", "ph": "f", "bp": "e",
+            "id": fid, "ts": e["ts"], "pid": e["pid"],
+            "tid": e.get("tid", 0),
+        })
+    out.extend(flows)
+
+    return {
+        "traceEvents": out,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "tools/trace_merge.py",
+            "t0_unix_s": base,
+            "inputs": labels,
+            "trace_ids": sorted(trace_ids),
+            "flows": flow_id,
+        },
+    }
+
+
+def main(argv: Optional[list[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n\n")[0])
+    ap.add_argument("traces", nargs="+",
+                    help="Chrome trace JSON files (telemetry trace.json"
+                         " exports) to merge")
+    ap.add_argument("-o", "--out", default="merged-trace.json",
+                    help="output path (default: merged-trace.json)")
+    args = ap.parse_args(argv)
+    try:
+        docs = [_load(p) for p in args.traces]
+    except (OSError, ValueError) as e:
+        print(f"trace_merge: {e}", file=sys.stderr)
+        return 1
+    merged = merge(docs, labels=list(args.traces))
+    with open(args.out, "w") as f:
+        json.dump(merged, f)
+    n = len(merged["traceEvents"])
+    print(f"trace_merge: wrote {args.out} "
+          f"({n} events from {len(docs)} traces, "
+          f"{merged['otherData']['flows']} flow bindings)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
