@@ -1,0 +1,49 @@
+"""jepsenlint: AST-based invariant analysis for this repo's protocols.
+
+Nine PRs of checker infrastructure rest on conventions no tool
+enforced: every nemesis journals ledger intent before injecting (PR 4),
+every checker routes through ``check_safe`` budgets (PR 2), every WGL
+pass runs under ``profile.capture`` (PR 9), every narrowing
+``.astype(np.int32)`` needs a dominating range guard (the
+``wgl_witness._plan_blocks`` bug class), and ~30 modules spawn threads
+whose lock discipline nobody checks.  This package makes those
+properties *declared and machine-checkable* — the TVM lesson
+(PAPERS.md): passes compose safely because their invariants are
+checked, not remembered.
+
+Three rule families (``rules/``):
+
+  * ``device``      — JAX/device hygiene: unguarded narrowing casts,
+    host syncs inside jit-traced code, ``np``/``jnp`` mixing in traced
+    functions, device passes outside ``profile.capture``;
+  * ``concurrency`` — a module-level lock-order graph built from
+    ``with lock:`` / ``acquire()`` nesting (cycles are errors), plus
+    attributes written from a thread entry point and read elsewhere
+    with no common lock;
+  * ``protocol``    — framework contracts: ledger intent before
+    session mutation, compensator ctypes that exist in the ledger
+    registry, telemetry counter names inside the declared namespaces
+    (cross-checked against ``FLEET_COUNTER_PREFIXES``), no
+    ``check_safe`` bypasses, no swallowed exceptions in teardown.
+
+Infrastructure (``core.py``): a ``Finding`` model with severity,
+``# jepsenlint: ignore[rule] -- reason`` suppressions (a reason is
+mandatory), a committed ``lint_baseline.json`` of accepted findings
+with written justifications, JSON + human output, and a <30 s
+full-repo runtime contract.  Run it as ``jepsen lint``, via
+``tools/lint.py``, or ``python -m jepsen_tpu.analysis``.
+"""
+
+from .core import (  # noqa: F401
+    Finding,
+    LintReport,
+    Module,
+    baseline_path,
+    lint_source,
+    load_baseline,
+    main,
+    render_human,
+    render_json,
+    run_lint,
+    save_baseline,
+)

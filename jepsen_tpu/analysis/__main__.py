@@ -1,0 +1,16 @@
+"""``python -m jepsen_tpu.analysis`` — the standalone lint entry.
+Same driver as ``jepsen lint`` and ``tools/lint.py``."""
+
+import argparse
+import sys
+
+from .core import add_lint_args, main
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(
+        prog="jepsenlint",
+        description="AST-based invariant analysis for this repo "
+        "(device hygiene, lock discipline, framework protocols)",
+    )
+    add_lint_args(p)
+    sys.exit(main(p.parse_args()))

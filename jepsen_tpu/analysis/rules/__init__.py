@@ -1,0 +1,18 @@
+"""Rule families.  Each module exposes ``check(modules) -> [Finding]``
+plus a ``RULES`` catalog ({rule-id: (severity, one-line doc)}) that
+doc/design.md's rule table and the test suite are built from."""
+
+from . import concurrency, device, protocol
+
+FAMILIES = {
+    "device": device.check,
+    "concurrency": concurrency.check,
+    "protocol": protocol.check,
+}
+
+#: {rule-id: (severity, doc)} over every family — the catalog.
+RULES = {
+    **device.RULES,
+    **concurrency.RULES,
+    **protocol.RULES,
+}

@@ -1,0 +1,414 @@
+"""Device/JAX hygiene rules.
+
+The bug classes here are the ones this repo has actually shipped and
+hand-caught:
+
+  * ``device.unguarded-narrowing`` — ``.astype(np.int32)`` (or any
+    narrower integer) with no *dominating* range guard.  The exemplar
+    is ``wgl_witness._plan_blocks``: it raises ``OverflowError`` when
+    the 64-bit timeline maximum reaches int32 INF *before* casting,
+    because a wrapped ``inv`` silently corrupts the barrier order
+    (ADVICE round 5 caught this by hand; this rule is that reviewer).
+    A cast counts as guarded when the enclosing function asserts/bails
+    on a bound before it, or the cast source is already clamped
+    (``np.minimum`` / ``.clip``) or inherently bounded (comparison
+    masks, ``searchsorted`` ranks, ``arange``).
+  * ``device.host-sync-in-jit`` — ``.item()`` / ``np.asarray`` /
+    ``block_until_ready`` / ``float()`` on a traced value inside a
+    jit/pmap-traced function: either a trace-time crash or a silent
+    device→host sync per call.
+  * ``device.np-in-jit`` — ``np.`` *compute* calls inside traced
+    functions (dtype/constant accessors are fine): numpy ops trace as
+    constants and pin the value on host.
+  * ``device.host-sync-in-capture`` — ``.item()`` / ``np.asarray`` /
+    ``block_until_ready`` inside a loop inside a ``profile.capture``
+    block.  Per-iteration syncs are the classic hidden serializer in a
+    device pipeline; the witness search's one-scalar-per-block sync is
+    the *intended* shape and gets baselined, anything new must argue.
+  * ``device.uncaptured-device-call`` — a function in ``ops/`` or
+    ``streaming/`` that demonstrably drives devices (calls a jitted
+    function, ``device_put``, ``block_until_ready``) but is neither
+    under a ``profile.capture`` itself nor only reachable from covered
+    functions: a pass invisible to the PR 9 cost profiles and the
+    ROADMAP-3 cost model's training set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional
+
+from ..core import Finding, Module
+
+RULES = {
+    "device.unguarded-narrowing": (
+        "warning",
+        ".astype to a narrower int with no dominating range guard",
+    ),
+    "device.host-sync-in-jit": (
+        "error",
+        "host sync (.item/np.asarray/block_until_ready/float) inside a "
+        "jit-traced function",
+    ),
+    "device.np-in-jit": (
+        "warning",
+        "np.* compute call inside a jit-traced function (use jnp)",
+    ),
+    "device.host-sync-in-capture": (
+        "advice",
+        "per-iteration host sync inside a profile.capture hot loop",
+    ),
+    "device.uncaptured-device-call": (
+        "warning",
+        "device-driving function in ops//streaming/ not under "
+        "profile.capture",
+    ),
+}
+
+#: Integer dtypes narrower than the int64 indices/timestamps the
+#: history pipeline carries.
+_NARROW_INTS = {
+    "int32", "int16", "int8", "uint32", "uint16", "uint8",
+}
+
+#: Tokens whose presence in a preceding raise/assert marks the cast
+#: range-checked (the _plan_blocks idiom and its relatives).
+_GUARD_TOKENS = ("INF", "iinfo", "int32", "overflow", "Overflow")
+
+#: Call names in the cast source that already bound the value.
+_CLAMP_TOKENS = ("minimum(", ".clip(", "clip(", "searchsorted(",
+                 "arange(", "argsort(", "nonzero(", "cumsum(")
+
+_HOST_SYNC_ATTRS = {"item", "block_until_ready", "tolist"}
+
+
+def _dtype_of_astype(call: ast.Call) -> Optional[str]:
+    """"int32" when `call` is `<x>.astype(<narrow int dtype>)`."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "astype" and call.args):
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Attribute):          # np.int32 / jnp.int32
+        name = arg.attr
+    elif isinstance(arg, ast.Name):             # bare int32
+        name = arg.id
+    elif isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        name = arg.value                        # .astype("int32")
+    else:
+        return None
+    return name if name in _NARROW_INTS else None
+
+
+def _is_bounded_value(m: Module, value: ast.AST) -> bool:
+    """Casts of masks/ranks/clamped values can't overflow int32."""
+    if isinstance(value, (ast.Compare, ast.BoolOp)):
+        return True
+    seg = m.seg(value)
+    return any(tok in seg for tok in _CLAMP_TOKENS)
+
+
+def _has_dominating_guard(m: Module, fn: ast.FunctionDef,
+                          cast_line: int) -> bool:
+    """A raise/assert before the cast whose text talks about the int32
+    bound — the lexical stand-in for dominance that matches how every
+    real guard in this repo is written (straight-line prologue checks)."""
+    for node in ast.walk(fn):
+        if getattr(node, "lineno", 1 << 30) >= cast_line:
+            continue
+        if isinstance(node, (ast.Raise, ast.Assert)):
+            seg = m.seg(node)
+            if any(tok in seg for tok in _GUARD_TOKENS):
+                return True
+        # Delegated guards: a bare call statement whose name says it
+        # range-checks (`_require_i32(arr)`) is the same idiom hoisted
+        # into a helper.
+        if (isinstance(node, ast.Expr)
+                and isinstance(node.value, ast.Call)):
+            name = m.seg(node.value.func).lower()
+            if any(t in name for t in ("i32", "int32", "overflow")) \
+                    and any(t in name for t in
+                            ("require", "guard", "check", "assert")):
+                return True
+    return False
+
+
+def _traced_functions(m: Module) -> set[ast.FunctionDef]:
+    """FunctionDefs traced by jax: decorated with jit/pmap (directly or
+    via partial), or passed to a jax.jit/jax.pmap call anywhere in the
+    module."""
+    out: set[ast.FunctionDef] = set()
+    by_name: dict[str, list[ast.FunctionDef]] = {}
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.FunctionDef):
+            by_name.setdefault(node.name, []).append(node)
+            for dec in node.decorator_list:
+                seg = m.seg(dec)
+                if "jit" in seg or "pmap" in seg or "shard_map" in seg:
+                    out.add(node)
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = m.seg(node.func)
+        if seg.split("(")[0] not in (
+            "jax.jit", "jit", "jax.pmap", "pmap"
+        ):
+            continue
+        for arg in node.args[:1]:
+            if isinstance(arg, ast.Name):
+                for fn in by_name.get(arg.id, []):
+                    out.add(fn)
+    return out
+
+
+def _in_any(m: Module, node: ast.AST,
+            fns: set[ast.FunctionDef]) -> Optional[ast.FunctionDef]:
+    f = m.enclosing_function(node)
+    while f is not None:
+        if f in fns:
+            return f
+        f = m.enclosing_function(f)
+    return None
+
+
+def _check_narrowing(m: Module) -> list[Finding]:
+    out = []
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dtype = _dtype_of_astype(node)
+        if dtype is None:
+            continue
+        value = node.func.value  # type: ignore[union-attr]
+        if _is_bounded_value(m, value):
+            continue
+        fn = m.enclosing_function(node)
+        if fn is not None and _has_dominating_guard(m, fn, node.lineno):
+            continue
+        out.append(m.finding(
+            "device.unguarded-narrowing", "warning", node,
+            f".astype({dtype}) narrows a 64-bit value with no "
+            f"dominating range guard; assert/bail on the max first "
+            f"(the wgl_witness._plan_blocks idiom) or clamp with "
+            f"np.minimum",
+        ))
+    return out
+
+
+def _check_jit_bodies(m: Module) -> list[Finding]:
+    out = []
+    traced = _traced_functions(m)
+    if not traced:
+        return out
+    for node in ast.walk(m.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _in_any(m, node, traced)
+        if fn is None:
+            continue
+        func = node.func
+        if isinstance(func, ast.Attribute):
+            if func.attr in _HOST_SYNC_ATTRS:
+                out.append(m.finding(
+                    "device.host-sync-in-jit", "error", node,
+                    f".{func.attr}() inside jit-traced `{fn.name}` "
+                    f"forces a device→host sync (or a trace error); "
+                    f"keep the value on device",
+                ))
+            elif (isinstance(func.value, ast.Name)
+                  and func.value.id == "np"):
+                if func.attr in ("asarray", "array"):
+                    out.append(m.finding(
+                        "device.host-sync-in-jit", "error", node,
+                        f"np.{func.attr}() inside jit-traced "
+                        f"`{fn.name}` pulls the tracer to host",
+                    ))
+                elif not _NP_DTYPE_OK(func.attr):
+                    out.append(m.finding(
+                        "device.np-in-jit", "warning", node,
+                        f"np.{func.attr}() inside jit-traced "
+                        f"`{fn.name}` computes on host and traces as "
+                        f"a constant; use jnp.{func.attr}",
+                    ))
+        elif isinstance(func, ast.Name) and func.id in ("float", "int"):
+            if node.args and not isinstance(node.args[0], ast.Constant):
+                out.append(m.finding(
+                    "device.host-sync-in-jit", "error", node,
+                    f"{func.id}() on a traced value inside "
+                    f"`{fn.name}` concretizes the tracer",
+                ))
+    return out
+
+
+def _NP_DTYPE_OK(attr: str) -> bool:
+    return attr in {
+        "int8", "int16", "int32", "int64", "uint8", "uint16",
+        "uint32", "uint64", "float16", "float32", "float64",
+        "bool_", "iinfo", "finfo", "dtype", "ndarray", "integer",
+        "floating", "generic", "shape", "bfloat16",
+    }
+
+
+def _capture_withs(m: Module) -> list[ast.With]:
+    out = []
+    for node in ast.walk(m.tree):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                if "profile.capture" in m.seg(item.context_expr):
+                    out.append(node)
+                    break
+    return out
+
+
+def _check_capture_loops(m: Module) -> list[Finding]:
+    out = []
+    for w in _capture_withs(m):
+        for loop in ast.walk(w):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call):
+                    continue
+                func = node.func
+                name = None
+                if isinstance(func, ast.Attribute):
+                    if func.attr in ("item", "block_until_ready"):
+                        name = f".{func.attr}()"
+                    elif (isinstance(func.value, ast.Name)
+                          and func.value.id == "np"
+                          and func.attr == "asarray"):
+                        name = "np.asarray()"
+                if name:
+                    out.append(m.finding(
+                        "device.host-sync-in-capture", "advice", node,
+                        f"{name} per loop iteration inside a "
+                        f"profile.capture hot path serializes the "
+                        f"device pipeline; batch the sync or justify "
+                        f"it (sequential-by-design searches are)",
+                    ))
+    return out
+
+
+#: Source markers that say "this function drives a device from host".
+_DEVICE_MARKERS = ("block_until_ready", "device_put(", ".addressable_",
+                   "jax.block_until_ready")
+
+
+def _check_uncaptured(modules: list[Module]) -> list[Finding]:
+    """Repo-wide coverage fixpoint: a device-driving ops//streaming/
+    function is fine when it runs under profile.capture itself or is
+    only reachable from covered callers — *in any scanned module*
+    (check_wgl_witness is covered by wgl.py's `capture("witness")`
+    around the call, one module over)."""
+    from .concurrency import _import_map
+
+    targets = [m for m in modules
+               if m.rel.startswith(("jepsen_tpu/ops/",
+                                    "jepsen_tpu/streaming/"))]
+    if not targets:
+        return []
+
+    # Every function in the scan set is a potential caller; module-level
+    # functions in target modules are the flag candidates.
+    fn_info: dict[tuple[str, str], dict] = {}
+    traced_by_mod: dict[str, set[ast.FunctionDef]] = {}
+    for m in modules:
+        traced_by_mod[m.name] = _traced_functions(m)
+        traced_names = {f.name for f in traced_by_mod[m.name]}
+        for fn in [n for n in ast.walk(m.tree)
+                   if isinstance(n, ast.FunctionDef)]:
+            seg = m.seg(fn)
+            drives = any(tok in seg for tok in _DEVICE_MARKERS)
+            if not drives:
+                # Calling a locally jitted function executes on device.
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Name)
+                            and node.func.id in traced_names):
+                        drives = True
+                        break
+            fn_info[(m.name, fn.name)] = {
+                "m": m, "fn": fn, "drives": drives,
+                "captures": "profile.capture" in seg,
+                "callers": set(), "called_at_toplevel": False,
+            }
+
+    # Call graph across modules: bare names resolve in the caller's
+    # module, `alias.f(...)` through its import map.
+    for m in modules:
+        imports = _import_map(m)
+        for node in ast.walk(m.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            key = None
+            if isinstance(f, ast.Name):
+                tgt = imports.get(f.id)
+                if tgt and "." in tgt:          # from x import f
+                    key = tuple(tgt.rsplit(".", 1))
+                else:
+                    key = (m.name, f.id)
+            elif (isinstance(f, ast.Attribute)
+                  and isinstance(f.value, ast.Name)):
+                base = imports.get(f.value.id)
+                if base:
+                    key = (base, f.attr)
+            if key is None or key not in fn_info:
+                continue
+            caller = m.enclosing_function(node)
+            if caller is None:
+                fn_info[key]["called_at_toplevel"] = True
+            elif (m.name, caller.name) in fn_info \
+                    and fn_info[(m.name, caller.name)]["fn"] is caller:
+                fn_info[key]["callers"].add((m.name, caller.name))
+            else:
+                # Nested/method caller: count its own capture state.
+                if "profile.capture" in m.seg(caller):
+                    fn_info[key]["callers"].add(("<covered>", ""))
+                else:
+                    fn_info[key]["called_at_toplevel"] = True
+
+    # Greatest fixpoint: start with everything covered and strip any
+    # function that doesn't capture and has an uncovered entry path.
+    # (A least fixpoint can never cover recursion — check_wgl_witness's
+    # _retry_on_scan/_retry_smaller cycle — even when every external
+    # caller runs under capture.)
+    covered = set(fn_info) | {("<covered>", "")}
+    changed = True
+    while changed:
+        changed = False
+        for key, i in fn_info.items():
+            if key not in covered or i["captures"]:
+                continue
+            if (i["called_at_toplevel"] or not i["callers"]
+                    or not (i["callers"] <= covered)):
+                covered.discard(key)
+                changed = True
+
+    out = []
+    for m in targets:
+        traced = traced_by_mod[m.name]
+        for (mod, _name), i in fn_info.items():
+            if mod != m.name or i["m"] is not m:
+                continue
+            if i["fn"] in traced:   # the kernel itself, not the driver
+                continue
+            if i["drives"] and (mod, i["fn"].name) not in covered:
+                out.append(m.finding(
+                    "device.uncaptured-device-call", "warning", i["fn"],
+                    f"`{i['fn'].name}` drives devices but neither runs "
+                    f"under profile.capture nor is only called from "
+                    f"covered functions — its cost is invisible to the "
+                    f"per-pass profile store (telemetry/profile.py)",
+                ))
+    return out
+
+
+def check(modules: list[Module]) -> list[Finding]:
+    out: list[Finding] = []
+    scan = [m for m in modules if m.rel.startswith("jepsen_tpu/")]
+    for m in scan:
+        out.extend(_check_narrowing(m))
+        out.extend(_check_jit_bodies(m))
+        out.extend(_check_capture_loops(m))
+    out.extend(_check_uncaptured(scan))
+    return out

@@ -299,6 +299,15 @@ def single_test_cmd(
     )
     cd.set_defaults(_run=_run_checkerd)
 
+    ln = sub.add_parser(
+        "lint",
+        help="run jepsenlint (AST invariant analysis) over the repo",
+    )
+    from .analysis.core import add_lint_args
+
+    add_lint_args(ln)
+    ln.set_defaults(_run=_run_lint)
+
     return parser
 
 
@@ -517,6 +526,14 @@ def _run_checkerd(opts) -> int:
         max_budget_s=opts.max_budget,
     )
     return EXIT_VALID
+
+
+def _run_lint(opts) -> int:
+    """`jepsen lint`: AST invariant analysis (jepsen_tpu/analysis/).
+    Exit 0 = no unbaselined findings, 1 = findings — the tier-1 gate."""
+    from .analysis.core import main as lint_main
+
+    return lint_main(opts)
 
 
 def run(parser: argparse.ArgumentParser, argv: Optional[Sequence[str]] = None) -> int:

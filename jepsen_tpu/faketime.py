@@ -10,10 +10,13 @@ different speeds.  `wrap` moves the real binary aside idempotently;
 
 from __future__ import annotations
 
+import logging
 import random
 from typing import Optional
 
 from .control import Session
+
+log = logging.getLogger(__name__)
 
 #: Suffix for the displaced original binary (faketime.clj:37-47).
 REAL_SUFFIX = ".no-faketime"
@@ -158,7 +161,9 @@ def faketime_package(opts: dict) -> Optional[dict]:
                 )
                 fault_ledger.healed(test, tag="faketime", by="teardown")
             except Exception:  # noqa: BLE001 — ledger keeps the record
-                pass
+                log.warning("faketime teardown unwrap failed; entries "
+                            "stay outstanding for jepsen repair",
+                            exc_info=True)
 
         def fs(self) -> set:
             return {"start-faketime", "stop-faketime"}

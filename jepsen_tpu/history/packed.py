@@ -348,6 +348,25 @@ class PackedBuilder:
         return _rows_to_packed(rows, with_preds=True)
 
 
+def _require_i32(arr: "np.ndarray") -> None:
+    """The process/status/f/a0/a1 columns narrow to int32 on device;
+    a0/a1 carry model-encoded op arguments, which nothing bounds.  A
+    value past int32 would wrap silently in the cast below and corrupt
+    every verdict downstream, so bail loudly first (the
+    wgl_witness._plan_blocks idiom)."""
+    if not arr.size:
+        return
+    cols = arr[:, 2:7]
+    lo = int(cols.min())
+    hi = int(cols.max())
+    if lo < -(2 ** 31) or hi >= 2 ** 31:
+        raise OverflowError(
+            f"packed op column value out of int32 range "
+            f"[{lo}, {hi}]: re-encode op arguments (a0/a1) into a "
+            f"dense int32 domain before packing"
+        )
+
+
 def _rows_to_packed(rows: list, *, with_preds: bool) -> "PackedOps":
     """Shared row-tuples -> PackedOps tail of pack_history.  `rows`
     must already be inv-sorted.  with_preds=False leaves preds/horizon
@@ -360,6 +379,7 @@ def _rows_to_packed(rows: list, *, with_preds: bool) -> "PackedOps":
     inv = arr[:, 0]
     ret = arr[:, 1]
     n = arr.shape[0]
+    _require_i32(arr)
 
     if with_preds:
         ret_sorted = np.sort(ret)
@@ -453,6 +473,7 @@ def pack_history(h: History, encode: OpEncoderFn) -> PackedOps:
     inv = arr[:, 0]
     ret = arr[:, 1]
     n = arr.shape[0]
+    _require_i32(arr)
 
     # preds[a] = #{y != a : ret(y) < inv(a)}
     # horizon[a] = #{y != a : inv(y) < ret(a)}

@@ -264,7 +264,10 @@ class Span:
             try:
                 cb(self.name, dur)
             except Exception:  # noqa: BLE001 — profiling must not
-                pass           # change a pass's outcome.
+                # change a pass's outcome, but a silently dead hook
+                # means silently missing cost records.
+                log.debug("span-exit hook failed for %s",
+                          self.name, exc_info=True)
         return False
 
 
@@ -616,12 +619,15 @@ def _prom_name(name: str) -> str:
 def prometheus_text(
     extra_gauges: Optional[dict] = None,
     chip_state: Optional[str] = None,
+    lint_findings: Optional[dict] = None,
 ) -> str:
     """The registry rendered in Prometheus text exposition format:
     counters as `counter`, gauge last-values and span totals/counts as
     `gauge`.  `extra_gauges` ({name: number}) lets a server mix in
     surface-local values (queue depth, utilization); `chip_state`
-    renders the one-hot `jepsen_chip_health{state=...}` family."""
+    renders the one-hot `jepsen_chip_health{state=...}` family;
+    `lint_findings` ({severity: count}, from a jepsenlint store
+    summary) renders `jepsen_lint_findings{severity=...}` gauges."""
     with _lock:
         counters = dict(_counters)
         gauges = {k: g[0] for k, g in _gauges.items()}
@@ -657,6 +663,14 @@ def prometheus_text(
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {v}")
+    if lint_findings:
+        lines.append("# TYPE jepsen_lint_findings gauge")
+        for sev in sorted(lint_findings):
+            v = lint_findings[sev]
+            if not isinstance(v, (int, float)):
+                continue
+            lines.append(
+                f'jepsen_lint_findings{{severity="{sev}"}} {v}')
     if chip_state is not None:
         lines.append("# TYPE jepsen_chip_health gauge")
         known = chip_state in CHIP_HEALTH_STATES

@@ -174,6 +174,34 @@ class Handler(http.server.BaseHTTPRequestHandler):
             "</code>; start a daemon with <code>jepsen checkerd</code>"
             " and route runs through it with <code>--remote</code></p>"
         )
+        lint_tbl = ""
+        try:
+            from .analysis.core import read_store_summary
+
+            summary = read_store_summary(self.store_dir)
+        except Exception:  # noqa: BLE001 — render, don't 500
+            summary = None
+        if summary:
+            counts = summary.get("counts") or {}
+            lrows = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(str(v))}</td></tr>"
+                for k, v in [
+                    ("last run", summary.get("at")),
+                    ("clean", summary.get("clean")),
+                    ("unbaselined", summary.get("unbaselined")),
+                    ("baselined", summary.get("baselined")),
+                    ("errors", counts.get("error")),
+                    ("warnings", counts.get("warning")),
+                    ("advice", counts.get("advice")),
+                    ("files", summary.get("files")),
+                    ("duration s", summary.get("duration_s")),
+                ]
+            )
+            lint_tbl = (
+                "<h2>static analysis (jepsenlint)</h2>"
+                f"<table>{lrows}</table>"
+            )
         try:
             from .checkerd.client import fetch_stats
 
@@ -183,7 +211,7 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 "checker fleet",
                 f"<p>checkerd at <code>{html.escape(addr)}</code> "
                 f"is unreachable: <code>{html.escape(repr(e))}</code>"
-                f"</p>" + hint,
+                f"</p>" + lint_tbl + hint,
             ))
             return
         devs = stats.get("devices") or {}
@@ -227,7 +255,7 @@ class Handler(http.server.BaseHTTPRequestHandler):
         ) if rrows else "<p>no runs have submitted yet</p>"
         self._send(200, _page(
             "checker fleet",
-            f"<table>{orows}</table>" + runs_tbl + hint,
+            f"<table>{orows}</table>" + runs_tbl + lint_tbl + hint,
         ))
 
     def _metrics(self) -> None:
@@ -256,8 +284,18 @@ class Handler(http.server.BaseHTTPRequestHandler):
                     extra[f"checkerd.{key}"] = float(stats[key])
         except Exception:  # noqa: BLE001 — scrape must not 500
             pass
+        lint_counts = None
+        try:
+            from .analysis.core import read_store_summary
+
+            summary = read_store_summary(self.store_dir)
+            if summary:
+                lint_counts = summary.get("counts")
+        except Exception:  # noqa: BLE001 — scrape must not 500
+            pass
         body = telemetry.prometheus_text(
             extra_gauges=extra, chip_state=degrade.chip_state(),
+            lint_findings=lint_counts,
         ).encode()
         self._send(200, body, ctype="text/plain; version=0.0.4")
 

@@ -1,0 +1,397 @@
+"""jepsenlint tests: true-positive fixtures per rule family, clean
+negatives, suppression semantics, the baseline round-trip, the
+counters-doc drift gate, and (slow) the whole-repo clean gate."""
+
+import os
+import textwrap
+
+import pytest
+
+from jepsen_tpu.analysis.core import (
+    RUNTIME_BUDGET_S,
+    baseline_path,
+    lint_source,
+    load_modules,
+    read_store_summary,
+    run_lint,
+    save_baseline,
+    write_store_summary,
+)
+from jepsen_tpu.analysis.rules import protocol
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _rules(findings):
+    return {f.rule for f in findings}
+
+
+def _root(tmp_path, source, rel="jepsen_tpu/fixture.py"):
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return str(tmp_path)
+
+
+# --------------------------------------------------------------------------
+# device family
+# --------------------------------------------------------------------------
+
+def test_device_unguarded_narrowing_fires():
+    found = lint_source(textwrap.dedent("""
+        import numpy as np
+
+        def pack(ts):
+            return ts.astype(np.int32)
+    """))
+    assert "device.unguarded-narrowing" in _rules(found)
+
+
+def test_device_narrowing_guarded_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import numpy as np
+
+        def pack(ts):
+            if ts.max() >= np.iinfo(np.int32).max:
+                raise OverflowError("ts exceeds int32")
+            return ts.astype(np.int32)
+    """))
+    assert "device.unguarded-narrowing" not in _rules(found)
+
+
+def test_device_narrowing_delegated_guard_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import numpy as np
+
+        def pack(ts):
+            _require_i32(ts)
+            return ts.astype(np.int32)
+    """))
+    assert "device.unguarded-narrowing" not in _rules(found)
+
+
+def test_device_host_sync_in_jit_fires():
+    found = lint_source(textwrap.dedent("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            return x.item()
+    """))
+    assert "device.host-sync-in-jit" in _rules(found)
+
+
+# --------------------------------------------------------------------------
+# concurrency family
+# --------------------------------------------------------------------------
+
+def test_lock_order_cycle_fires():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+        def two():
+            with B:
+                with A:
+                    pass
+    """))
+    assert "concurrency.lock-order-cycle" in _rules(found)
+
+
+def test_consistent_lock_order_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        A = threading.Lock()
+        B = threading.Lock()
+
+        def one():
+            with A:
+                with B:
+                    pass
+
+        def two():
+            with A:
+                with B:
+                    pass
+    """))
+    assert "concurrency.lock-order-cycle" not in _rules(found)
+
+
+def test_unsynced_thread_attr_fires():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Feed:
+            def __init__(self):
+                self.t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                self.n = 1
+
+            def snapshot(self):
+                return self.n
+    """))
+    assert "concurrency.unsynced-thread-attr" in _rules(found)
+
+
+def test_locked_thread_attr_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Feed:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.t = threading.Thread(target=self._loop)
+
+            def _loop(self):
+                with self.lock:
+                    self.n = 1
+
+            def snapshot(self):
+                with self.lock:
+                    return self.n
+    """))
+    assert "concurrency.unsynced-thread-attr" not in _rules(found)
+
+
+# --------------------------------------------------------------------------
+# protocol family
+# --------------------------------------------------------------------------
+
+def test_intent_before_mutation_fires():
+    found = lint_source(textwrap.dedent("""
+        from . import ledger as fault_ledger
+
+        class Nem:
+            def invoke(self, test, op):
+                self.sess.kill_daemon("db")
+                fault_ledger.intent(test, "process")
+                return op
+    """), rel="jepsen_tpu/nemesis/fixture.py")
+    assert "protocol.intent-before-mutation" in _rules(found)
+
+
+def test_intent_first_is_clean():
+    found = lint_source(textwrap.dedent("""
+        from . import ledger as fault_ledger
+
+        class Nem:
+            def invoke(self, test, op):
+                fault_ledger.intent(test, "process")
+                self.sess.kill_daemon("db")
+                return op
+    """), rel="jepsen_tpu/nemesis/fixture.py")
+    assert "protocol.intent-before-mutation" not in _rules(found)
+
+
+def test_closure_mutation_not_flagged():
+    # The on_nodes closure idiom: the nested def body runs AFTER the
+    # intent even though it is written above it lexically.
+    found = lint_source(textwrap.dedent("""
+        from . import ledger as fault_ledger
+
+        class Nem:
+            def invoke(self, test, op):
+                fault_ledger.intent(test, "process")
+
+                def act(sess, node):
+                    sess.kill_daemon("db")
+                    return "killed"
+
+                return on_nodes(test, act, ["n1"])
+    """), rel="jepsen_tpu/nemesis/fixture.py")
+    assert "protocol.intent-before-mutation" not in _rules(found)
+
+
+_LEDGER_SRC = """
+def run_compensator(ctype, entry):
+    if ctype == "known-undo":
+        return
+    raise ValueError(ctype)
+"""
+
+
+def test_unknown_compensator_fires():
+    found = lint_source(textwrap.dedent("""
+        def arm(test, led):
+            led.intent(test, "process",
+                       compensator={"type": "bogus-undo"})
+    """), rel="jepsen_tpu/nemesis/fixture.py",
+        extra={"jepsen_tpu/nemesis/ledger.py": _LEDGER_SRC})
+    assert "protocol.unknown-compensator" in _rules(found)
+
+
+def test_known_compensator_is_clean():
+    found = lint_source(textwrap.dedent("""
+        def arm(test, led):
+            led.intent(test, "process",
+                       compensator={"type": "known-undo"})
+    """), rel="jepsen_tpu/nemesis/fixture.py",
+        extra={"jepsen_tpu/nemesis/ledger.py": _LEDGER_SRC})
+    assert "protocol.unknown-compensator" not in _rules(found)
+
+
+def test_counter_namespace_fires():
+    found = lint_source(textwrap.dedent("""
+        from . import telemetry
+
+        def work():
+            telemetry.count("bogusns.thing")
+    """))
+    assert "protocol.counter-namespace" in _rules(found)
+
+
+def test_declared_namespace_is_clean():
+    found = lint_source(textwrap.dedent("""
+        from . import telemetry
+
+        def work():
+            telemetry.count("wgl.fixture-ok")
+    """))
+    assert "protocol.counter-namespace" not in _rules(found)
+
+
+def test_swallowed_teardown_fires():
+    found = lint_source(textwrap.dedent("""
+        class Thing:
+            def teardown(self):
+                try:
+                    self.release()
+                except Exception:
+                    pass
+    """))
+    assert "protocol.swallowed-teardown" in _rules(found)
+
+
+# --------------------------------------------------------------------------
+# suppressions
+# --------------------------------------------------------------------------
+
+_NARROW = """
+import numpy as np
+
+def pack(ts):
+    return ts.astype(np.int32){pragma}
+"""
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    root = _root(tmp_path, _NARROW.format(
+        pragma="  # jepsenlint: ignore[device.unguarded-narrowing]"
+               " -- fixture: bounded upstream"))
+    report = run_lint(root)
+    assert report.clean
+    assert len(report.suppressed) == 1
+    f, reason = report.suppressed[0]
+    assert f.rule == "device.unguarded-narrowing"
+    assert "bounded upstream" in reason
+
+
+def test_suppression_without_reason_is_an_error(tmp_path):
+    root = _root(tmp_path, _NARROW.format(
+        pragma="  # jepsenlint: ignore[device.unguarded-narrowing]"))
+    report = run_lint(root)
+    assert not report.clean
+    assert "lint.suppression-missing-reason" in _rules(report.findings)
+
+
+# --------------------------------------------------------------------------
+# baseline round-trip
+# --------------------------------------------------------------------------
+
+def test_baseline_round_trip(tmp_path):
+    root = _root(tmp_path, _NARROW.format(pragma=""))
+    report = run_lint(root)
+    assert not report.clean and len(report.findings) == 1
+
+    save_baseline(baseline_path(root), report.findings,
+                  justification="fixture: accepted for the round-trip")
+    report = run_lint(root)
+    assert report.clean
+    assert len(report.baselined) == 1
+    assert not report.stale_baseline
+
+    # A new violation is NOT covered by the old baseline.
+    fx = tmp_path / "jepsen_tpu" / "fixture.py"
+    fx.write_text(fx.read_text() + textwrap.dedent("""
+        def pack2(ts):
+            return ts.astype(np.int16)
+    """))
+    report = run_lint(root)
+    assert not report.clean and len(report.findings) == 1
+    assert len(report.baselined) == 1
+
+    # Fixing the original finding makes its baseline entry stale.
+    fx.write_text(textwrap.dedent("""
+        import numpy as np
+
+        def pack(ts):
+            assert ts.max() < np.iinfo(np.int32).max
+            return ts.astype(np.int32)
+    """))
+    report = run_lint(root)
+    assert not report.findings
+    assert len(report.stale_baseline) == 1
+
+
+def test_baseline_fingerprints_are_line_stable(tmp_path):
+    root = _root(tmp_path, _NARROW.format(pragma=""))
+    before = run_lint(root).findings
+    fx = tmp_path / "jepsen_tpu" / "fixture.py"
+    fx.write_text("# a new leading comment shifts every line\n"
+                  + fx.read_text())
+    after = run_lint(root).findings
+    assert [f.fingerprint for f in before] == \
+        [f.fingerprint for f in after]
+    assert before[0].line != after[0].line
+
+
+# --------------------------------------------------------------------------
+# counters doc drift + store summary
+# --------------------------------------------------------------------------
+
+def test_counters_doc_drift():
+    modules = load_modules(REPO)
+    live = {e["name"] for e in protocol.scan_counters(modules)}
+    with open(os.path.join(REPO, "doc", "counters.md"),
+              encoding="utf-8") as f:
+        documented = protocol.doc_counter_names(f.read())
+    assert documented == live, (
+        "doc/counters.md is stale — regenerate with "
+        "`jepsen lint --write-counters doc/counters.md`"
+    )
+
+
+def test_store_summary_and_prometheus_gauges(tmp_path):
+    from jepsen_tpu import telemetry
+
+    root = _root(tmp_path, _NARROW.format(pragma=""))
+    report = run_lint(root)
+    store = tmp_path / "store"
+    store.mkdir()
+    assert write_store_summary(report, str(store))
+    summary = read_store_summary(str(store))
+    assert summary and summary["unbaselined"] == 1
+    text = telemetry.prometheus_text(lint_findings=summary["counts"])
+    assert 'jepsen_lint_findings{severity="warning"} 1' in text
+    assert 'jepsen_lint_findings{severity="error"} 0' in text
+
+
+# --------------------------------------------------------------------------
+# the repo gate itself
+# --------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_lint_repo_clean():
+    report = run_lint(REPO)
+    assert report.clean, [f.to_dict() for f in report.findings]
+    assert not report.stale_baseline
+    assert report.duration_s < RUNTIME_BUDGET_S

@@ -1,0 +1,32 @@
+#!/usr/bin/env python
+"""Standalone jepsenlint entry (tier1.yml step).
+
+Equivalent to `jepsen lint` on any suite CLI and to
+`python -m jepsen_tpu.analysis`; exists so CI and editors can run the
+analyzer without picking a suite.  Exit 0 = no unbaselined findings,
+1 = findings, 2 = internal error.
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from jepsen_tpu.analysis.core import add_lint_args, main  # noqa: E402
+
+if __name__ == "__main__":
+    p = argparse.ArgumentParser(
+        prog="jepsenlint",
+        description="AST-based invariant analysis: device hygiene, "
+        "lock discipline, framework protocols",
+    )
+    add_lint_args(p)
+    try:
+        sys.exit(main(p.parse_args()))
+    except Exception:  # noqa: BLE001 — CI needs the distinct code
+        import traceback
+
+        traceback.print_exc()
+        sys.exit(2)
