@@ -103,6 +103,8 @@ DECLARED_NAMESPACES = {
     "profile": "per-pass cost profiling (telemetry/profile.py)",
     "lint": "jepsenlint itself (analysis/)",
     "bench": "bench.py sweeps",
+    "forensics": "anomaly dossiers (forensics.py)",
+    "slo": "SLO alert engine (telemetry/slo.py)",
 }
 
 #: Fleet-scoped modules: counters here survive scoped_reset only when

@@ -382,6 +382,12 @@ class Linearizable(Checker):
         }
         if res.reason:
             out["unknown-reason"] = res.reason
+        if res.valid == "unknown" and res.final_configs:
+            # The WGL death state for budget-blown unknowns: the
+            # deepest configurations the search was holding when the
+            # limit hit — forensics dossiers ship these even when
+            # there is no refutation to shrink.
+            out["final-configs"] = res.final_configs[:10]
         if res.valid is False and res.final_configs:
             # Truncate like checker.clj:230-233 (10 configs).
             out["final-configs"] = res.final_configs[:10]

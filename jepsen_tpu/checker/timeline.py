@@ -40,7 +40,11 @@ _COL_WIDTH = 104
 _HEADER = 24
 
 
-def render(test: dict, history: History) -> str:
+def render(test: dict, history: History,
+           highlight: Optional[int] = None) -> str:
+    """`highlight` is a history index (invocation or completion): that
+    op's box gets a red border — forensics dossiers use it to mark the
+    op the linearizability search died on."""
     ops = []
     for op in history:
         if op.is_invoke:
@@ -78,10 +82,12 @@ def render(test: dict, history: History) -> str:
             f"{comp.value!r} [{inv.time / 1e6:.1f}ms - {comp.time / 1e6:.1f}ms]"
         )
         label = html.escape(f"{comp.f} {comp.value!r}")[:64]
+        hot = highlight is not None and highlight in (inv.index, comp.index)
+        border = "border:2px solid #D00;" if hot else ""
         boxes.append(
             f"<div class='op' title='{title}' style='"
             f"left:{col[inv.process] * _COL_WIDTH}px;"
-            f"top:{top:.1f}px;height:{height:.1f}px;"
+            f"top:{top:.1f}px;height:{height:.1f}px;{border}"
             f"background:{color}'>{label}</div>"
         )
     return (

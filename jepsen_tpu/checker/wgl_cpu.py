@@ -43,7 +43,9 @@ class WGLResult:
     configs_explored: int = 0
     #: why unknown: "config-limit" | "time-limit" | None
     reason: Optional[str] = None
-    #: on invalid: deepest configurations reached, as dicts for reporting
+    #: on invalid (and budget-blown unknown): deepest configurations
+    #: reached, as dicts for reporting — the WGL death state forensics
+    #: dossiers ship
     final_configs: list[dict] = field(default_factory=list)
     #: on invalid: index (packed row) of the op that could not be linearized
     crashed_at: Optional[int] = None
@@ -52,6 +54,31 @@ class WGLResult:
     @property
     def is_valid(self):
         return self.valid is True
+
+
+def _report_configs(
+    deepest: list[tuple[int, tuple[int, ...]]],
+    report_configs: int,
+    ok_mask: int,
+    n: int,
+) -> list[dict]:
+    """Deepest configurations as reporting dicts (truncation to 10
+    mirrors checker.clj:230-233) — shared by the invalid return and the
+    budget-blown unknown returns, so forensics dossiers get a death
+    state either way."""
+    final = []
+    for S, state in deepest[:report_configs]:
+        missing = [
+            i for i in range(n) if (ok_mask >> i) & 1 and not (S >> i) & 1
+        ]
+        final.append(
+            {
+                "linearized": [i for i in range(n) if (S >> i) & 1],
+                "state": list(state),
+                "missing_ok_ops": missing[:10],
+            }
+        )
+    return final
 
 
 def check_wgl_cpu(
@@ -109,6 +136,8 @@ def check_wgl_cpu(
                 valid=UNKNOWN,
                 configs_explored=explored,
                 reason="config-limit",
+                final_configs=_report_configs(
+                    deepest, report_configs, ok_mask, n),
                 elapsed_s=time.monotonic() - t0,
             )
         if time_limit_s is not None and not (explored & 0x3FF):
@@ -117,6 +146,8 @@ def check_wgl_cpu(
                     valid=UNKNOWN,
                     configs_explored=explored,
                     reason="time-limit",
+                    final_configs=_report_configs(
+                        deepest, report_configs, ok_mask, n),
                     elapsed_s=time.monotonic() - t0,
                 )
         S, state = stack.pop()
@@ -178,16 +209,7 @@ def check_wgl_cpu(
             )
 
     # Frontier exhausted without covering all ok ops: not linearizable.
-    final = []
-    for S, state in deepest[:report_configs]:
-        missing = [i for i in range(n) if (ok_mask >> i) & 1 and not (S >> i) & 1]
-        final.append(
-            {
-                "linearized": [i for i in range(n) if (S >> i) & 1],
-                "state": list(state),
-                "missing_ok_ops": missing[:10],
-            }
-        )
+    final = _report_configs(deepest, report_configs, ok_mask, n)
     crashed = None
     if final and final[0]["missing_ok_ops"]:
         crashed = final[0]["missing_ok_ops"][0]

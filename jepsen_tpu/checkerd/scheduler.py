@@ -469,6 +469,25 @@ class Scheduler:
                 meta["budget-exceeded"] = True
             if steps:
                 meta["degradations"] = steps
+            # Death-state summary for remote forensics: which keys went
+            # bad and how, without the client digging through every
+            # key-result.  The full certificates / deepest configs ride
+            # in krs themselves, so client-side dossiers are built from
+            # the same bytes an in-process check would have produced.
+            bad = {
+                str(i): {
+                    "valid": kr.get("valid"),
+                    "algorithm": kr.get("algorithm"),
+                    "reason": kr.get("unknown-reason") or kr.get("error"),
+                }
+                for i, kr in enumerate(krs)
+                if isinstance(kr, dict)
+                and kr.get("valid") in (False, "unknown")
+            }
+            if bad:
+                meta["forensics"] = {
+                    "bad-keys": bad, "count": len(bad),
+                }
             r.result = {
                 "valid": merge_valid(k.get("valid") for k in krs)
                 if krs else True,

@@ -241,8 +241,15 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 "checkerd.merge-ratio": st.get("merge-ratio", 0.0),
                 "checkerd.profile-records": st.get("profile-records", 0),
             }
+            # SLO sweep on every scrape: the daemon-surface gauges
+            # (queue depth, merge ratio) only exist here, so this is
+            # where their rules get their samples.
+            from ..telemetry import slo
+
+            slo.evaluate(extra, degrade.chip_state())
             body = telemetry.prometheus_text(
                 extra_gauges=extra, chip_state=degrade.chip_state(),
+                slo_firing=slo.firing_gauges(),
             ).encode()
         except Exception as e:  # noqa: BLE001 — a scrape must not 500
             # the daemon into a restart loop; answer degraded instead.

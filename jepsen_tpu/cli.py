@@ -340,6 +340,11 @@ def _run_test(test_fn, opts) -> int:
             f"==> {test['name']} {test.get('start-time')}: "
             f"valid={test['results'].get('valid')}"
         )
+        forens = test["results"].get("forensics")
+        if isinstance(forens, dict) and forens.get("dossiers"):
+            n = len(forens["dossiers"])
+            print(f"    {n} anomaly dossier{'s' if n != 1 else ''}: "
+                  f"{forens.get('dir')}")
         if _SEVERITY[code] > _SEVERITY[worst]:
             worst = code
     return worst

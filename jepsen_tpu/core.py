@@ -24,7 +24,7 @@ from typing import Any, Optional
 
 from . import db as jdb
 from . import interpreter, oses, store, telemetry
-from .telemetry import flight, profile
+from .telemetry import flight, profile, slo
 from .checker.core import check_safe
 from .control import Session, health, with_sessions
 from .history import History
@@ -211,6 +211,20 @@ def analyze(test: dict, history: History, dir: Optional[str] = None) -> dict:
         results.setdefault("resilience", resil)
     if sess is not None and isinstance(results, dict):
         results.setdefault("streaming", sess.stats())
+    # Anomaly forensics: every bad verdict ships a dossier (minimal
+    # counterexample, death state, trace slice, nemesis correlation)
+    # under <store>/forensics/.  Fail-open: assembly must never change
+    # the verdict it documents.
+    if isinstance(results, dict) and opts.get("dir"):
+        try:
+            from . import forensics
+            fsum = forensics.assemble(
+                test, results, history, opts["dir"], checker=checker
+            )
+            if fsum is not None:
+                results.setdefault("forensics", fsum)
+        except Exception:  # noqa: BLE001 — side output only
+            log.warning("forensics assembly failed", exc_info=True)
     return results
 
 
@@ -247,6 +261,7 @@ def run(test: dict) -> dict:
     run_dir = store.test_dir(test)
     profile.set_store(run_dir)
     flight.set_dir(run_dir)
+    slo.set_dir(run_dir)
     try:
         return _run_prepared(test)
     except BaseException as e:
@@ -262,6 +277,7 @@ def run(test: dict) -> dict:
             telemetry.log_top_spans(log)
         profile.set_store(None)
         flight.set_dir(None)
+        slo.set_dir(None)
 
 
 def _run_prepared(test: dict) -> dict:

@@ -630,6 +630,15 @@ def signature(outcome: dict) -> frozenset:
                     feats.add(f"a:{name}:error")
                 for anom in (sub.get("anomaly-types") or []):
                     feats.add(f"a:{name}:{anom}")
+        # Anomaly-forensics signatures (jepsen_tpu/forensics.py): each
+        # dossier's fingerprint of WHY a verdict went bad is its own
+        # fitness dimension, so the search distinguishes schedules that
+        # produce *different* anomalies, not just "an anomaly".
+        forens = results.get("forensics")
+        if isinstance(forens, dict):
+            for d in forens.get("dossiers") or []:
+                if isinstance(d, dict) and d.get("signature"):
+                    feats.add(f"x:{d['signature']}")
     records = outcome.get("ledger") or []
     healed_by = {
         r["id"]: r.get("by", "run")
@@ -747,6 +756,61 @@ class Corpus:
 # ---------------------------------------------------------------------------
 
 
+def greedy_shrink(
+    items: Sequence[Any],
+    rebuild: Callable[[tuple], Any],
+    is_interesting: Callable[[Any], bool],
+    *,
+    simplify: Optional[Callable[[Any], Any]] = None,
+    max_attempts: int = 24,
+    min_items: int = 1,
+) -> tuple[tuple, int]:
+    """The two-pass greedy delta-debugger, generic over the unit being
+    minimized.  `items` is the sequence of droppable units; `rebuild`
+    turns a kept subsequence back into the candidate object that
+    `is_interesting` judges; `simplify` (optional) maps one unit to a
+    simpler form tried in pass 2.  Pass 1 drops units largest-index
+    first (never below `min_items`); pass 2 swaps each survivor for its
+    simplified form; both repeat while anything sticks and the attempt
+    budget holds.  Deterministic: same inputs + deterministic oracle =
+    same minimum.  Returns (kept units, attempts spent).
+
+    Shared by the nemesis schedule shrinker below and the anomaly
+    forensics counterexample minimizer (jepsen_tpu/forensics.py), so
+    both shrink with the same discipline."""
+    attempts = 0
+    cur = tuple(items)
+    progressed = True
+    while progressed and attempts < max_attempts:
+        progressed = False
+        # Pass 1: drop whole units.
+        i = len(cur) - 1
+        while i >= 0 and attempts < max_attempts:
+            if len(cur) <= min_items:
+                break
+            cand = cur[:i] + cur[i + 1:]
+            attempts += 1
+            if is_interesting(rebuild(cand)):
+                cur = cand
+                progressed = True
+            i -= 1
+        if simplify is None:
+            continue
+        # Pass 2: simplify the survivors.
+        for i, it in enumerate(cur):
+            if attempts >= max_attempts:
+                break
+            simpler = simplify(it)
+            if simpler is None or simpler == it:
+                continue
+            cand = cur[:i] + (simpler,) + cur[i + 1:]
+            attempts += 1
+            if is_interesting(rebuild(cand)):
+                cur = cand
+                progressed = True
+    return cur, attempts
+
+
 def shrink(sched: Schedule, is_interesting: Callable[[Schedule], bool],
            *, max_attempts: int = 24) -> tuple[Schedule, int]:
     """Greedy minimization: drop events (largest index first), then
@@ -754,45 +818,23 @@ def shrink(sched: Schedule, is_interesting: Callable[[Schedule], bool],
     still reproduces.  Event salts pin each survivor's materialization,
     so dropping a neighbor never changes what the rest do.  Returns
     (smallest reproducer, attempts spent)."""
-    attempts = 0
-    cur = sched
-    progressed = True
-    while progressed and attempts < max_attempts:
-        progressed = False
-        # Pass 1: drop whole events.
-        i = len(cur.events) - 1
-        while i >= 0 and attempts < max_attempts:
-            if len(cur.events) == 1:
-                break
-            cand = dataclasses.replace(
-                cur,
-                events=cur.events[:i] + cur.events[i + 1:],
-            )
-            attempts += 1
-            if is_interesting(cand):
-                cur = cand
-                progressed = True
-            i -= 1
-        # Pass 2: simplify the survivors.
-        for i, e in enumerate(cur.events):
-            if attempts >= max_attempts:
-                break
-            simpler = e
-            if e.duration > 0.2:
-                simpler = dataclasses.replace(simpler, duration=0.2)
-            if isinstance(e.targets, int) and e.targets > 1:
-                simpler = dataclasses.replace(simpler, targets=1)
-            if simpler == e:
-                continue
-            cand = dataclasses.replace(
-                cur,
-                events=cur.events[:i] + (simpler,) + cur.events[i + 1:],
-            )
-            attempts += 1
-            if is_interesting(cand):
-                cur = cand
-                progressed = True
-    return cur, attempts
+
+    def rebuild(events: tuple) -> Schedule:
+        return dataclasses.replace(sched, events=events)
+
+    def simplify(e: Event) -> Event:
+        simpler = e
+        if e.duration > 0.2:
+            simpler = dataclasses.replace(simpler, duration=0.2)
+        if isinstance(e.targets, int) and e.targets > 1:
+            simpler = dataclasses.replace(simpler, targets=1)
+        return simpler
+
+    kept, attempts = greedy_shrink(
+        sched.events, rebuild, is_interesting,
+        simplify=simplify, max_attempts=max_attempts,
+    )
+    return rebuild(kept), attempts
 
 
 # ---------------------------------------------------------------------------

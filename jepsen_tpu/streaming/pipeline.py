@@ -413,6 +413,15 @@ class StreamingSession:
             self._remote.commit(list(self._builders))
         self.verdict_lag_s = time.monotonic() - t0
         telemetry.gauge("wgl.online.verdict-lag-s", self.verdict_lag_s)
+        # The verdict-lag SLO samples the gauge the instant it lands:
+        # a blown lag budget dumps its postmortem here, at finish time,
+        # not on the next telemetry flush.
+        try:
+            from ..telemetry import slo
+
+            slo.evaluate()
+        except Exception:  # noqa: BLE001 — alerting is side output
+            log.warning("SLO evaluation at finish failed", exc_info=True)
         return self.stats()
 
     def _finalize(self) -> None:

@@ -568,6 +568,16 @@ def export(directory: str) -> Optional[tuple[str, str]]:
     output must never change a run's outcome)."""
     if not _enabled:
         return None
+    # The flush is the SLO engine's heartbeat: every export re-evaluates
+    # the declarative rules over the registry (telemetry/slo.py), so a
+    # blown SLO journals its transition and dumps a postmortem even in
+    # processes that never serve /metrics.
+    try:
+        from . import slo as _slo
+
+        _slo.evaluate()
+    except Exception:  # noqa: BLE001 — alerting never breaks the flush
+        log.warning("slo evaluation on export failed", exc_info=True)
     try:
         os.makedirs(directory, exist_ok=True)
         sum_path = os.path.join(directory, "telemetry.json")
@@ -620,6 +630,7 @@ def prometheus_text(
     extra_gauges: Optional[dict] = None,
     chip_state: Optional[str] = None,
     lint_findings: Optional[dict] = None,
+    slo_firing: Optional[dict] = None,
 ) -> str:
     """The registry rendered in Prometheus text exposition format:
     counters as `counter`, gauge last-values and span totals/counts as
@@ -627,7 +638,11 @@ def prometheus_text(
     surface-local values (queue depth, utilization); `chip_state`
     renders the one-hot `jepsen_chip_health{state=...}` family;
     `lint_findings` ({severity: count}, from a jepsenlint store
-    summary) renders `jepsen_lint_findings{severity=...}` gauges."""
+    summary) renders `jepsen_lint_findings{severity=...}` gauges;
+    `slo_firing` ({rule: 0|1}) renders the
+    `jepsen_slo_firing{rule=...}` family — when omitted, the default
+    SLO engine's current state (telemetry/slo.py) is exported, so every
+    scrape surface alerts for free."""
     with _lock:
         counters = dict(_counters)
         gauges = {k: g[0] for k, g in _gauges.items()}
@@ -678,4 +693,19 @@ def prometheus_text(
             hot = 1 if st == chip_state or (
                 st == "unprobed" and not known) else 0
             lines.append(f'jepsen_chip_health{{state="{st}"}} {hot}')
+    if slo_firing is None:
+        try:
+            from . import slo as _slo
+
+            slo_firing = _slo.firing_gauges()
+        except Exception:  # noqa: BLE001 — scrape must render regardless
+            slo_firing = None
+    if slo_firing:
+        lines.append("# TYPE jepsen_slo_firing gauge")
+        for rule in sorted(slo_firing):
+            v = slo_firing[rule]
+            if not isinstance(v, (int, float)):
+                continue
+            lines.append(
+                f'jepsen_slo_firing{{rule="{rule}"}} {int(bool(v))}')
     return "\n".join(lines) + "\n"

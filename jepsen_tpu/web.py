@@ -57,6 +57,36 @@ def _validity(run_dir: str) -> str:
     return v
 
 
+def _slo_panel() -> str:
+    """Per-rule SLO status table (telemetry/slo.py) for the index and
+    /fleet pages.  Renders this process's engine — the dashboard
+    co-hosted with runs or a daemon shows live state; a detached one
+    shows the stock rules all-clear."""
+    try:
+        from .telemetry import slo
+
+        rows = slo.status()
+    except Exception:  # noqa: BLE001 — render, don't 500
+        return ""
+    if not rows:
+        return ""
+    trs = "".join(
+        f"<tr><td>{html.escape(str(r['rule']))}</td>"
+        f"<td>{html.escape(str(r['kind']))}</td>"
+        f"<td>{html.escape(str(r['target']))}</td>"
+        f"<td>{html.escape(str(r['threshold']))}</td>"
+        f"<td class='valid-{'false' if r['firing'] else 'true'}'>"
+        f"{'FIRING' if r['firing'] else 'ok'}</td>"
+        f"<td>{html.escape(str(r['value']))}</td></tr>"
+        for r in rows
+    )
+    return (
+        "<h2>SLOs</h2><table><tr><th>rule</th><th>kind</th>"
+        "<th>target</th><th>threshold</th><th>state</th><th>last value"
+        "</th></tr>" + trs + "</table>"
+    )
+
+
 def _page(title: str, body: str) -> bytes:
     return (
         f"<!doctype html><html><head><meta charset='utf-8'>"
@@ -135,12 +165,18 @@ class Handler(http.server.BaseHTTPRequestHandler):
                     if os.path.isfile(os.path.join(d, "telemetry.json"))
                     else ""
                 )
+                forens = (
+                    f"<a href='/files/{q}/forensics/'>forensics</a>"
+                    if os.path.isdir(os.path.join(d, "forensics"))
+                    else ""
+                )
                 rows.append(
                     f"<tr><td><a href='/files/{q}/'>"
                     f"{html.escape(name)}</a></td>"
                     f"<td>{html.escape(t)}</td>"
                     f"<td class='valid-{html.escape(v.lower())}'>{html.escape(v)}</td>"
                     f"<td>{tel}</td>"
+                    f"<td>{forens}</td>"
                     f"<td><a href='/zip/{q}'>zip</a></td></tr>"
                 )
         body = (
@@ -150,9 +186,10 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 + "</ul>" if searches else ""
             )
             + "<table><tr><th>test</th><th>time</th><th>valid?</th>"
-            "<th></th><th></th></tr>"
+            "<th></th><th></th><th></th></tr>"
             + "".join(rows)
             + "</table>"
+            + _slo_panel()
         )
         self._send(200, _page("jepsen-tpu store", body))
 
@@ -211,7 +248,7 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 "checker fleet",
                 f"<p>checkerd at <code>{html.escape(addr)}</code> "
                 f"is unreachable: <code>{html.escape(repr(e))}</code>"
-                f"</p>" + lint_tbl + hint,
+                f"</p>" + _slo_panel() + lint_tbl + hint,
             ))
             return
         devs = stats.get("devices") or {}
@@ -255,7 +292,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
         ) if rrows else "<p>no runs have submitted yet</p>"
         self._send(200, _page(
             "checker fleet",
-            f"<table>{orows}</table>" + runs_tbl + lint_tbl + hint,
+            f"<table>{orows}</table>" + runs_tbl + _slo_panel()
+            + lint_tbl + hint,
         ))
 
     def _metrics(self) -> None:
@@ -291,6 +329,15 @@ class Handler(http.server.BaseHTTPRequestHandler):
             summary = read_store_summary(self.store_dir)
             if summary:
                 lint_counts = summary.get("counts")
+        except Exception:  # noqa: BLE001 — scrape must not 500
+            pass
+        # Evaluate the SLO rules with the freshest samples this scrape
+        # gathered (daemon gauges resolve through `extra`), so the
+        # exported jepsen_slo_firing family reflects this instant.
+        try:
+            from .telemetry import slo
+
+            slo.evaluate(extra, degrade.chip_state())
         except Exception:  # noqa: BLE001 — scrape must not 500
             pass
         body = telemetry.prometheus_text(

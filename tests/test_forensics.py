@@ -1,0 +1,332 @@
+"""Anomaly forensics & the SLO engine.
+
+Covers the delta-debugged minimal counterexample (strictly smaller
+than the original per-key history AND re-refuted by the exact CPU
+engine from its serialized form), dossier assembly through
+`core.analyze` (in-process and byte-identical through a real checkerd
+daemon), nemesis-window correlation against a planted fault ledger,
+SLO fire/clear transitions with the journal and the exported gauge
+family, and torn-tail survival of slo.jsonl.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from conftest import free_port  # noqa: F401 — conftest path side effect
+
+from jepsen_tpu import core, forensics, store, telemetry
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.checker.wgl_cpu import check_wgl_cpu
+from jepsen_tpu.checkerd.server import make_server
+from jepsen_tpu.history.core import History, Op
+from jepsen_tpu.history.packed import pack_history
+from jepsen_tpu.models.registers import Register
+from jepsen_tpu.nemesis.ledger import FaultLedger, ledger_path
+from jepsen_tpu.parallel.independent import KV, IndependentChecker
+from jepsen_tpu.telemetry import flight, slo
+from jepsen_tpu.telemetry.slo import Rule, SLOEngine
+
+
+# ---------------------------------------------------------------------
+# History builders (the test_checkerd idiom)
+
+
+def _reg_ops(key, pairs, start_index=0, process=0):
+    """[(written, read-back), ...] -> op dicts for one register key."""
+    ops = []
+    i = start_index
+    for wrote, read in pairs:
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": "write", "value": KV(key, wrote), "time": i})
+        i += 1
+        ops.append({"index": i, "type": "ok", "process": process,
+                    "f": "write", "value": KV(key, wrote), "time": i})
+        i += 1
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": "read", "value": KV(key, None), "time": i})
+        i += 1
+        ops.append({"index": i, "type": "ok", "process": process,
+                    "f": "read", "value": KV(key, read), "time": i})
+        i += 1
+    return ops
+
+
+def _mixed_history():
+    """Key "good" linearizable, key "bad" reads a never-written value
+    with healthy ops around it — shrinkable."""
+    ops = _reg_ops("good", [(1, 1), (2, 2)])
+    ops += _reg_ops("bad", [(1, 1), (2, 7), (3, 3)],
+                    start_index=len(ops), process=1)
+    return History(ops)
+
+
+def _bad_flat_ops():
+    """A single-register (unkeyed) non-linearizable history."""
+    ops = []
+    for i, (f, v) in enumerate([("write", 1), ("write", 1),
+                                ("read", 1), ("read", 1),
+                                ("read", 7), ("read", 7),
+                                ("write", 2), ("write", 2)]):
+        kind = "invoke" if i % 2 == 0 else "ok"
+        val = None if kind == "invoke" and f == "read" else v
+        ops.append({"index": i, "type": kind, "process": 0,
+                    "f": f, "value": val, "time": i * 1000})
+    return ops
+
+
+def _refute(ops_dicts):
+    """True when the exact CPU engine rejects the serialized ops."""
+    h = History([Op.from_dict(o) for o in ops_dicts], reindex=False)
+    pm = Register().packed()
+    return check_wgl_cpu(pack_history(h, pm.encode), pm).valid is False
+
+
+def _analyze(tmp_path, name, checkerd=None):
+    run_dir = str(tmp_path / name)
+    os.makedirs(run_dir, exist_ok=True)
+    test = {
+        "name": name,
+        "start-time": store.time_str(),
+        "checker": IndependentChecker(Linearizable(Register())),
+        "model": Register(),
+    }
+    if checkerd:
+        test["checkerd"] = checkerd
+    return core.analyze(test, _mixed_history(), dir=run_dir), run_dir
+
+
+# ---------------------------------------------------------------------
+# Minimal counterexample
+
+
+def test_minimize_shrinks_and_is_refuted():
+    h = History(_bad_flat_ops())
+    out = forensics.minimize(h, Register())
+    assert out is not None
+    assert out["result"].valid is False
+    assert out["op-count"] < out["original-op-count"]
+    # Survives a serialize/deserialize round trip — the dossier's JSON
+    # is the proof object, not the in-memory history.
+    assert _refute([op.to_dict() for op in out["history"]])
+
+
+def test_minimize_refuses_linearizable_history():
+    h = History(_reg_ops("k", [(1, 1), (2, 2)]))
+    assert forensics.minimize(h, Register()) is None
+
+
+def test_find_anomalies_independent_shape():
+    results, _ = _analyze_results_only()
+    anomalies = forensics.find_anomalies(results)
+    assert [a["key"] for a in anomalies] == ["bad"]
+
+
+def _analyze_results_only():
+    checker = IndependentChecker(Linearizable(Register()))
+    test = {"name": "t", "checker": checker}
+    results = checker.check(test, _mixed_history(),
+                            {"history-key": None})
+    return results, test
+
+
+# ---------------------------------------------------------------------
+# Dossier assembly through core.analyze
+
+
+def test_analyze_attaches_dossier(tmp_path):
+    results, run_dir = _analyze(tmp_path, "forensics-run")
+    assert results["valid"] is False
+    forens = results["forensics"]
+    dossiers = [d for d in forens["dossiers"] if d["key"] == "'bad'"]
+    assert len(dossiers) == 1
+    d = dossiers[0]["dir"]
+    assert d.startswith(os.path.join(run_dir, "forensics"))
+    with open(os.path.join(d, "counterexample.json")) as f:
+        ce = json.load(f)
+    assert ce["op-count"] < ce["original-op-count"]
+    assert ce["signature"]
+    assert _refute(ce["ops"])
+    manifest = json.load(open(os.path.join(d, "dossier.json")))
+    for fn in ("counterexample.json", "death.json", "linear.svg",
+               "timeline.html", "nemesis.json", "flight.json"):
+        assert fn in manifest["files"], fn
+        assert os.path.getsize(os.path.join(d, fn)) > 0
+
+
+def test_remote_dossier_byte_parity(tmp_path):
+    """The same run through a real checkerd daemon must yield a
+    byte-identical counterexample.json: remote verdicts carry enough
+    state to reproduce forensics client-side."""
+    srv = make_server("127.0.0.1", 0, batch_window_s=0.0)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        local, _ = _analyze(tmp_path, "local")
+        remote, _ = _analyze(tmp_path, "remote", checkerd=addr)
+        assert "fallback" not in (remote.get("checkerd") or {})
+        lo = [d for d in local["forensics"]["dossiers"]
+              if d["key"] == "'bad'"][0]["dir"]
+        ro = [d for d in remote["forensics"]["dossiers"]
+              if d["key"] == "'bad'"][0]["dir"]
+        with open(os.path.join(lo, "counterexample.json"), "rb") as f:
+            lb = f.read()
+        with open(os.path.join(ro, "counterexample.json"), "rb") as f:
+            rb = f.read()
+        assert lb == rb
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+def test_dossier_signature_feeds_coverage():
+    from jepsen_tpu.nemesis.search import signature
+    outcome = {"results": {
+        "valid": False,
+        "forensics": {"dossiers": [{"signature": "abc123def456"}]},
+    }}
+    assert "x:abc123def456" in signature(outcome)
+
+
+# ---------------------------------------------------------------------
+# Nemesis correlation
+
+
+def test_nemesis_correlation_planted_fault(tmp_path):
+    d = str(tmp_path)
+    test = {"name": "corr", "start-time": store.time_str()}
+    led = FaultLedger(ledger_path(d))
+    eid = led.intent("partition", nodes=["n1", "n2"])
+    led.healed(eid)
+    led.intent("clock-skew", nodes=["n3"])  # never healed -> open window
+    led.close()
+    # Op 0 spans [t0, t0+60s] and so overlaps both windows (the ledger
+    # records were written within that minute); op 2 starts an hour in
+    # and overlaps only the never-healed one.
+    ops = [
+        {"index": 0, "type": "invoke", "process": 0, "f": "read",
+         "value": None, "time": 0},
+        {"index": 1, "type": "ok", "process": 0, "f": "read",
+         "value": 7, "time": 60_000_000_000},
+        {"index": 2, "type": "invoke", "process": 1, "f": "read",
+         "value": None, "time": 3_600_000_000_000},
+        {"index": 3, "type": "ok", "process": 1, "f": "read",
+         "value": 7, "time": 3_601_000_000_000},
+    ]
+    corr = forensics.nemesis_correlation(test, History(ops), directory=d)
+    assert corr["window-count"] == 2
+    by_fault = {w["fault"]: w for w in corr["windows"]}
+    assert set(by_fault) == {"partition", "clock-skew"}
+    assert [h["index"] for h in by_fault["partition"]["overlapping-ops"]] \
+        == [0]
+    assert [h["index"] for h in by_fault["clock-skew"]["overlapping-ops"]] \
+        == [0, 2]
+
+
+def test_nemesis_correlation_no_ledger(tmp_path):
+    test = {"name": "none", "start-time": store.time_str()}
+    corr = forensics.nemesis_correlation(
+        test, History([]), directory=str(tmp_path))
+    assert corr == {"windows": [], "note": "no fault ledger"}
+
+
+# ---------------------------------------------------------------------
+# SLO engine
+
+
+def test_slo_fires_then_clears(tmp_path):
+    eng = SLOEngine(
+        rules=(Rule("verdict-lag", "gauge-above",
+                    "wgl.online.verdict-lag-s", 30.0),),
+        directory=str(tmp_path))
+    flight.set_dir(str(tmp_path))
+    try:
+        fired = eng.evaluate({"wgl.online.verdict-lag-s": 99.0}, now=100.0)
+        assert [(t["rec"], t["rule"]) for t in fired] \
+            == [("firing", "verdict-lag")]
+        assert eng.firing_gauges() == {"verdict-lag": 1}
+        # Firing dumped the flight ring as a postmortem.
+        assert os.path.isfile(tmp_path / "postmortem.json")
+        # Steady breach: no duplicate transition.
+        assert eng.evaluate({"wgl.online.verdict-lag-s": 99.0},
+                            now=101.0) == []
+        cleared = eng.evaluate({"wgl.online.verdict-lag-s": 1.0},
+                               now=102.0)
+        assert [(t["rec"], t["rule"]) for t in cleared] \
+            == [("cleared", "verdict-lag")]
+        assert eng.firing_gauges() == {"verdict-lag": 0}
+        journal = slo.read(str(tmp_path / "slo.jsonl"))
+        assert [r["rec"] for r in journal] == ["firing", "cleared"]
+    finally:
+        flight.set_dir(None)
+
+
+def test_slo_for_count_debounce(tmp_path):
+    eng = SLOEngine(rules=(Rule("queue", "gauge-above", "q", 10.0,
+                                for_count=3),))
+    assert eng.evaluate({"q": 50.0}, now=1.0) == []
+    assert eng.evaluate({"q": 50.0}, now=2.0) == []
+    fired = eng.evaluate({"q": 50.0}, now=3.0)
+    assert [t["rec"] for t in fired] == ["firing"]
+    # A single good sample resets the breach counter entirely.
+    eng2 = SLOEngine(rules=(Rule("queue", "gauge-above", "q", 10.0,
+                                 for_count=2),))
+    assert eng2.evaluate({"q": 50.0}, now=1.0) == []
+    assert eng2.evaluate({"q": 1.0}, now=2.0) == []
+    assert eng2.evaluate({"q": 50.0}, now=3.0) == []
+
+
+def test_slo_absent_input_is_no_opinion():
+    eng = SLOEngine(rules=(Rule("verdict-lag", "gauge-above",
+                                "wgl.online.verdict-lag-s", 30.0),))
+    assert eng.evaluate({}, now=1.0) == []
+    assert eng.firing_gauges() == {"verdict-lag": 0}
+
+
+def test_slo_prometheus_family(tmp_path):
+    slo.reset(rules=(Rule("verdict-lag", "gauge-above",
+                          "wgl.online.verdict-lag-s", 30.0),))
+    try:
+        slo.evaluate({"wgl.online.verdict-lag-s": 99.0})
+        text = telemetry.prometheus_text()
+        assert 'jepsen_slo_firing{rule="verdict-lag"} 1' in text
+        slo.evaluate({"wgl.online.verdict-lag-s": 1.0})
+        text = telemetry.prometheus_text()
+        assert 'jepsen_slo_firing{rule="verdict-lag"} 0' in text
+    finally:
+        slo.reset()
+        slo.set_dir(None)
+
+
+def test_slo_journal_survives_torn_tail(tmp_path):
+    path = str(tmp_path / "slo.jsonl")
+    eng = SLOEngine(
+        rules=(Rule("r", "gauge-above", "g", 1.0),),
+        directory=str(tmp_path))
+    eng.evaluate({"g": 5.0}, now=1.0)
+    eng.evaluate({"g": 0.0}, now=2.0)
+    with open(path, "a") as f:
+        f.write('{"rec": "firing", "rule": "torn"')  # SIGKILL mid-line
+    recs = slo.read(path)
+    assert [r["rec"] for r in recs] == ["firing", "cleared"]
+    assert all(r["rule"] == "r" for r in recs)
+
+
+# ---------------------------------------------------------------------
+# The CI smoke, as a slow test
+
+
+@pytest.mark.slow
+def test_forensics_smoke_tool():
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "forensics_smoke.py")
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run([sys.executable, tool], env=env,
+                          capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "PASS" in proc.stdout

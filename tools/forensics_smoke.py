@@ -1,0 +1,252 @@
+#!/usr/bin/env python
+"""CI smoke for anomaly forensics + the SLO engine (tier1.yml step).
+
+Plants a non-linearizable register run and asserts the forensics
+pipeline end-to-end:
+
+  * `core.analyze` over a mixed-validity keyed history attaches a
+    ``forensics`` block and writes a dossier for the bad key;
+  * the dossier's minimal counterexample is strictly smaller than the
+    original per-key subhistory and is *re-refuted* here by the exact
+    CPU engine, from the written JSON alone;
+  * the linviz SVG and the timeline HTML rendered;
+  * the same run routed through a real checkerd daemon produces a
+    byte-identical counterexample.json (remote parity);
+  * a blown verdict-lag SLO fires (postmortem dumped, `slo.jsonl`
+    transition journaled, `jepsen_slo_firing{rule=...} 1` exported),
+    then clears; and the daemon's /metrics scrape carries the
+    jepsen_slo_firing family.
+
+Exit 0 + "PASS" on success, exit 1 with a reason otherwise.  CPU-only:
+the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JEPSEN_TELEMETRY"] = "1"
+
+from jepsen_tpu import core, store, telemetry  # noqa: E402
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.checker.wgl_cpu import check_wgl_cpu  # noqa: E402
+from jepsen_tpu.history.core import History, Op  # noqa: E402
+from jepsen_tpu.history.packed import pack_history  # noqa: E402
+from jepsen_tpu.models.registers import Register  # noqa: E402
+from jepsen_tpu.parallel.independent import (  # noqa: E402
+    KV,
+    IndependentChecker,
+)
+from jepsen_tpu.telemetry import flight, profile, slo  # noqa: E402
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def history() -> History:
+    """Key "good" is linearizable; key "bad" reads a never-written
+    value twice, with a healthy write around it, so the minimal
+    counterexample has room to shrink."""
+    ops = []
+
+    def add(process, f, key, value):
+        i = len(ops)
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": f, "value": KV(key, None if f == "read" else value),
+                    "time": i * 1_000_000})
+        ops.append({"index": i + 1, "type": "ok", "process": process,
+                    "f": f, "value": KV(key, value), "time": (i + 1) * 1_000_000})
+
+    add(0, "write", "good", 1)
+    add(0, "read", "good", 1)
+    add(1, "write", "bad", 1)
+    add(1, "read", "bad", 1)
+    add(1, "read", "bad", 9)
+    add(1, "write", "bad", 2)
+    return History(ops)
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def analyze_run(tmp: str, name: str, checkerd=None) -> tuple[dict, str]:
+    run_dir = os.path.join(tmp, name)
+    os.makedirs(run_dir, exist_ok=True)
+    test = {
+        "name": name,
+        "start-time": store.time_str(),
+        "checker": IndependentChecker(Linearizable(Register())),
+        "model": Register(),
+    }
+    if checkerd:
+        test["checkerd"] = checkerd
+    results = core.analyze(test, history(), dir=run_dir)
+    return results, run_dir
+
+
+def check_dossier(results: dict, run_dir: str) -> str:
+    """Asserts one complete dossier for key "bad"; returns the path of
+    its counterexample.json."""
+    forens = results.get("forensics")
+    if not isinstance(forens, dict):
+        fail(f"no forensics block in results: {sorted(results)}")
+    dossiers = forens.get("dossiers") or []
+    bad = [d for d in dossiers if d.get("key") == "'bad'"]
+    if not bad:
+        fail(f"no dossier for key 'bad': {dossiers}")
+    d = bad[0]["dir"]
+    for fn in ("dossier.json", "counterexample.json",
+               "counterexample.txt", "death.json", "linear.svg",
+               "timeline.html", "profiles.json", "trace-slice.json",
+               "flight.json", "nemesis.json"):
+        p = os.path.join(d, fn)
+        if not os.path.isfile(p) or os.path.getsize(p) == 0:
+            fail(f"dossier file {fn} missing or empty in {d}")
+    ce_path = os.path.join(d, "counterexample.json")
+    with open(ce_path) as f:
+        ce = json.load(f)
+
+    # Strictly smaller than the original per-key subhistory.
+    if not ce["op-count"] < ce["original-op-count"]:
+        fail(f"counterexample not smaller: {ce['op-count']} vs "
+             f"{ce['original-op-count']}")
+
+    # Re-refute from the written JSON alone: the exact CPU engine must
+    # still reject the minimal subhistory.
+    ops = [Op.from_dict(o) for o in ce["ops"]]
+    h = History(ops, reindex=False)
+    pm = Register().packed()
+    res = check_wgl_cpu(pack_history(h, pm.encode), pm)
+    if res.valid is not False:
+        fail(f"shrunk counterexample no longer refuted: {res.valid}")
+
+    # The timeline highlights the crashed op; the SVG draws the death.
+    with open(os.path.join(d, "timeline.html")) as f:
+        if "border:2px solid" not in f.read():
+            fail("timeline.html has no highlighted op")
+    with open(os.path.join(d, "linear.svg")) as f:
+        if "<svg" not in f.read(200):
+            fail("linear.svg is not an SVG")
+    if not ce.get("signature"):
+        fail("counterexample carries no anomaly signature")
+    return ce_path
+
+
+def main() -> None:
+    tmp = tempfile.mkdtemp(prefix="forensics-smoke-")
+    port, mport = free_port(), free_port()
+    addr = f"127.0.0.1:{port}"
+    env = dict(os.environ, JEPSEN_TELEMETRY="1", JAX_PLATFORMS="cpu")
+    daemon = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.checkerd",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--metrics-port", str(mport),
+         "--profile-dir", os.path.join(tmp, "daemon"),
+         "--batch-window", "0.2", "--platform", "cpu"],
+        env=env,
+    )
+    try:
+        deadline = time.monotonic() + 60
+        while True:
+            try:
+                with socket.create_connection(("127.0.0.1", port),
+                                              timeout=1):
+                    break
+            except OSError:
+                if daemon.poll() is not None:
+                    fail(f"daemon exited early rc={daemon.returncode}")
+                if time.monotonic() > deadline:
+                    fail("daemon never started listening")
+                time.sleep(0.2)
+
+        telemetry.enable(True)
+        telemetry.reset()
+        profile.set_store(os.path.join(tmp, "local"))
+
+        # --- in-process dossier ------------------------------------
+        results, run_dir = analyze_run(tmp, "forensics-smoke")
+        if results.get("valid") is not False:
+            fail(f"planted run not invalid: {results.get('valid')}")
+        local_ce = check_dossier(results, run_dir)
+
+        # --- remote parity -----------------------------------------
+        r_results, r_dir = analyze_run(tmp, "forensics-smoke-remote",
+                                       checkerd=addr)
+        if (r_results.get("checkerd") or {}).get("fallback"):
+            fail("remote run fell back in-process; parity untested")
+        remote_ce = check_dossier(r_results, r_dir)
+        with open(local_ce, "rb") as f:
+            local_bytes = f.read()
+        with open(remote_ce, "rb") as f:
+            remote_bytes = f.read()
+        if local_bytes != remote_bytes:
+            fail("remote counterexample.json differs from in-process")
+
+        # --- SLO engine: fire, postmortem, journal, clear ----------
+        slo_dir = os.path.join(tmp, "slo")
+        slo.reset()
+        slo.set_dir(slo_dir)
+        flight.set_dir(slo_dir)
+        telemetry.gauge("wgl.online.verdict-lag-s", 99.0)
+        fired = slo.evaluate()
+        if not any(t["rule"] == "verdict-lag" and t["rec"] == "firing"
+                   for t in fired):
+            fail(f"verdict-lag SLO did not fire: {fired}")
+        text = telemetry.prometheus_text()
+        if 'jepsen_slo_firing{rule="verdict-lag"} 1' not in text:
+            fail("firing SLO gauge not exported by prometheus_text")
+        if not os.path.isfile(os.path.join(slo_dir, "postmortem.json")):
+            fail("firing SLO dumped no postmortem")
+        telemetry.gauge("wgl.online.verdict-lag-s", 0.5)
+        cleared = slo.evaluate()
+        if not any(t["rule"] == "verdict-lag" and t["rec"] == "cleared"
+                   for t in cleared):
+            fail(f"verdict-lag SLO did not clear: {cleared}")
+        journal = slo.read(slo.slo_path(slo_dir))
+        if [r["rec"] for r in journal
+                if r["rule"] == "verdict-lag"] != ["firing", "cleared"]:
+            fail(f"slo.jsonl transitions wrong: {journal}")
+
+        # --- daemon /metrics carries the SLO family ----------------
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=5,
+        ).read().decode()
+        slo_lines = [ln for ln in body.splitlines()
+                     if ln.startswith("jepsen_slo_firing{")]
+        if not slo_lines:
+            fail(f"no jepsen_slo_firing family in daemon /metrics:\n"
+                 f"{body[:500]}")
+
+        with open(local_ce) as f:
+            ce = json.load(f)
+        print(f"PASS: dossier at {os.path.dirname(local_ce)}, "
+              f"counterexample {ce['original-op-count']} -> "
+              f"{ce['op-count']} ops (sig {ce['signature']}), "
+              f"remote parity byte-identical, verdict-lag SLO "
+              f"fired+cleared, {len(slo_lines)} SLO gauges scraped")
+    finally:
+        daemon.terminate()
+        try:
+            daemon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            daemon.kill()
+        slo.set_dir(None)
+        flight.set_dir(None)
+        profile.set_store(None)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Per-pass cost regression diff between two profiles.jsonl stores.
+
+The per-pass profile store (jepsen_tpu/telemetry/profile.py) is the
+declared training set for the ROADMAP item-1 learned cost model; this
+tool keeps it trustworthy by comparing two stores — typically the
+previous CI run's and this one's — pass by pass:
+
+  * records are bucketed by *configuration*: pass name + plan knobs +
+    the shape features (op counts, key counts), with measured outputs
+    (explored configs, shrink attempts, device seconds) excluded, so a
+    bucket means "the same work was asked for";
+  * per bucket, the median execute_s (falling back to total_s when a
+    pass records no device execution) is compared old → new;
+  * a bucket regresses when the delta exceeds the noise floor
+    (default +35%, CPU CI timing is loud) AND the old median is above
+    the significance floor (default 50 ms — microsecond buckets jitter
+    by integer factors without meaning anything).
+
+Exit code 1 when regressions are found, 0 otherwise (including when
+either store is missing/empty — an advisory diff must not fail the
+first run of a new store).  Wired as an advisory tier1.yml step.
+
+Usage:
+  python tools/profile_diff.py OLD.jsonl NEW.jsonl
+      [--noise 0.35] [--min-s 0.05] [--json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.telemetry import profile  # noqa: E402
+
+#: Feature keys that are measured outputs, not requested shape — two
+#: runs of identical work may differ on all of these.
+MEASURED_FEATURES = frozenset((
+    "explored", "attempts", "kept_units", "checks", "device_s",
+    "proven", "settled", "merged",
+))
+
+
+def bucket_key(rec: dict) -> str:
+    feats = {
+        k: v for k, v in (rec.get("features") or {}).items()
+        if k not in MEASURED_FEATURES
+    }
+    return json.dumps(
+        {
+            "pass": rec.get("pass"),
+            "plan": rec.get("plan") or {},
+            "features": feats,
+        },
+        sort_keys=True, default=repr,
+    )
+
+
+def cost_of(rec: dict) -> float:
+    t = rec.get("timing") or {}
+    ex = t.get("execute_s") or 0.0
+    return float(ex if ex > 0 else t.get("total_s") or 0.0)
+
+
+def buckets(path: str) -> dict[str, list[float]]:
+    out: dict[str, list[float]] = {}
+    for rec in profile.read(path):
+        if not rec.get("pass"):
+            continue
+        out.setdefault(bucket_key(rec), []).append(cost_of(rec))
+    return out
+
+
+def diff(old_path: str, new_path: str, *, noise: float,
+         min_s: float) -> dict:
+    old = buckets(old_path)
+    new = buckets(new_path)
+    shared = sorted(set(old) & set(new))
+    rows = []
+    regressions = 0
+    for key in shared:
+        o = statistics.median(old[key])
+        n = statistics.median(new[key])
+        delta = (n - o) / o if o > 0 else (0.0 if n == 0 else float("inf"))
+        regressed = bool(delta > noise and o >= min_s)
+        regressions += regressed
+        cfg = json.loads(key)
+        rows.append({
+            "pass": cfg["pass"],
+            "config": cfg,
+            "old_s": round(o, 6),
+            "new_s": round(n, 6),
+            "delta": round(delta, 4) if delta != float("inf") else "inf",
+            "old_n": len(old[key]),
+            "new_n": len(new[key]),
+            "regressed": regressed,
+        })
+    rows.sort(key=lambda r: (not r["regressed"],
+                             -(r["new_s"] - r["old_s"])))
+    return {
+        "old": old_path,
+        "new": new_path,
+        "shared-buckets": len(shared),
+        "old-only": len(set(old) - set(new)),
+        "new-only": len(set(new) - set(old)),
+        "noise-floor": noise,
+        "min-s": min_s,
+        "regressions": regressions,
+        "rows": rows,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="diff per-pass cost records across two "
+                    "profiles.jsonl stores")
+    ap.add_argument("old", help="baseline store (previous run)")
+    ap.add_argument("new", help="candidate store (this run)")
+    ap.add_argument("--noise", type=float, default=0.35,
+                    help="relative regression floor (default 0.35)")
+    ap.add_argument("--min-s", type=float, default=0.05,
+                    help="ignore buckets whose old median is below "
+                         "this many seconds (default 0.05)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the full report as JSON")
+    args = ap.parse_args()
+
+    for path, name in ((args.old, "old"), (args.new, "new")):
+        if not os.path.isfile(path):
+            print(f"# profile_diff: {name} store {path} missing; "
+                  f"nothing to compare")
+            return 0
+
+    report = diff(args.old, args.new, noise=args.noise,
+                  min_s=args.min_s)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print(f"# {report['shared-buckets']} shared buckets "
+              f"({report['old-only']} old-only, "
+              f"{report['new-only']} new-only), "
+              f"noise floor +{args.noise:.0%}, "
+              f"min {args.min_s * 1000:.0f} ms")
+        for r in report["rows"][:24]:
+            mark = "REGRESSED" if r["regressed"] else "ok"
+            print(f"{mark:>9}  {r['pass']:<18} "
+                  f"{r['old_s'] * 1000:9.1f}ms -> "
+                  f"{r['new_s'] * 1000:9.1f}ms  "
+                  f"(delta {r['delta']}, n={r['old_n']}/{r['new_n']})")
+    if not report["shared-buckets"]:
+        print("# no shared buckets; stores describe different work")
+        return 0
+    if report["regressions"]:
+        print(f"# {report['regressions']} regression(s) beyond the "
+              f"noise floor")
+        return 1
+    print("# no regressions beyond the noise floor")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

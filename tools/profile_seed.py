@@ -1,0 +1,79 @@
+#!/usr/bin/env python
+"""Generates a profiles.jsonl store from a fixed checker workload.
+
+The candidate half of the advisory profile-diff CI step: runs the
+independent checker over a deterministic multi-key register history
+with profiling on, so the per-pass cost records land in
+`<dir>/profiles.jsonl` with identical shape features every run —
+`tools/profile_diff.py` then buckets this run's records against the
+cached previous run's.
+
+Usage: python tools/profile_seed.py OUT_DIR [keys] [pairs-per-key]
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JEPSEN_TELEMETRY"] = "1"
+
+from jepsen_tpu import telemetry  # noqa: E402
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.history.core import History  # noqa: E402
+from jepsen_tpu.models.registers import Register  # noqa: E402
+from jepsen_tpu.parallel.independent import (  # noqa: E402
+    KV,
+    IndependentChecker,
+)
+from jepsen_tpu.telemetry import profile  # noqa: E402
+
+
+def seed_history(keys: int, pairs: int) -> History:
+    """`keys` independent registers, each `pairs` write/read rounds —
+    linearizable by construction, identical shape every run."""
+    ops = []
+    for k in range(keys):
+        for v in range(pairs):
+            for f, val in (("write", v), ("read", v)):
+                i = len(ops)
+                ops.append({"index": i, "type": "invoke", "process": k,
+                            "f": f,
+                            "value": KV(k, None if f == "read" else val),
+                            "time": i})
+                ops.append({"index": i + 1, "type": "ok", "process": k,
+                            "f": f, "value": KV(k, val), "time": i + 1})
+    return History(ops)
+
+
+def main() -> int:
+    out = sys.argv[1] if len(sys.argv) > 1 else "profile-seed"
+    keys = int(sys.argv[2]) if len(sys.argv) > 2 else 8
+    pairs = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+    os.makedirs(out, exist_ok=True)
+    telemetry.enable(True)
+    telemetry.reset()
+    profile.set_store(out)
+    try:
+        checker = IndependentChecker(Linearizable(Register()))
+        res = checker.check({"name": "profile-seed"},
+                            seed_history(keys, pairs),
+                            {"history-key": None})
+        if res.get("valid") is not True:
+            print(f"FAIL: seed workload not valid: {res.get('valid')}")
+            return 1
+        path = profile.store_path()
+        n = len(profile.read(path)) if path and os.path.isfile(path) else 0
+        if not n:
+            print(f"FAIL: no profile records landed in {path}")
+            return 1
+        print(f"PASS: {n} profile records in {path} "
+              f"({keys} keys x {pairs} pairs)")
+        return 0
+    finally:
+        profile.set_store(None)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
