@@ -91,6 +91,7 @@ RULES = {
 #: generated from it plus the live scan.
 DECLARED_NAMESPACES = {
     "wgl": "device checker passes (ops/, streaming/, parallel/)",
+    "wgl.plan": "checking-plan compiler/executor/cache (plan/)",
     "checker": "checker harness (checker/)",
     "checkerd": "checker daemon fleet (checkerd/)",
     "nemesis": "fault injection + ledger + schedule search (nemesis/)",
@@ -348,12 +349,25 @@ def _fleet_prefixes(modules: list[Module]) -> Optional[tuple[str, ...]]:
     return None
 
 
+def declared_namespace(name: str) -> Optional[str]:
+    """The longest declared dotted prefix of a counter name, or None.
+    Sub-namespaces (e.g. wgl.plan under wgl) resolve to the most
+    specific owner, so doc/counters.md files them under the right
+    subsystem."""
+    parts = name.split(".")
+    for i in range(len(parts), 0, -1):
+        ns = ".".join(parts[:i])
+        if ns in DECLARED_NAMESPACES:
+            return ns
+    return None
+
+
 def _check_counters(modules: list[Module]) -> list[Finding]:
     out = []
     emissions = scan_counters(modules)
     for e in emissions:
-        ns = e["name"].split(".", 1)[0]
-        if ns not in DECLARED_NAMESPACES:
+        ns = declared_namespace(e["name"])
+        if ns is None:
             m: Module = e["module"]
             out.append(m.finding(
                 "protocol.counter-namespace", "warning", e["node"],

@@ -96,6 +96,7 @@ class Scheduler:
         max_budget_s: Optional[float] = None,
         bound: Optional[int] = None,
         profile_dir: Optional[str] = None,
+        plan_cache_dir: Optional[str] = None,
     ):
         self.batch_window_s = batch_window_s
         self.max_budget_s = max_budget_s
@@ -105,6 +106,14 @@ class Scheduler:
             # dir: every cohort's pass records aggregate here.
             profile.set_store(profile_dir)
             flight.set_dir(profile_dir)
+        if plan_cache_dir:
+            # Daemon warm start: the plan memo journal + XLA compile
+            # cache under one dir, so a restarted checkerd re-checking
+            # byte-identical histories skips settled work AND the
+            # recompiles (jepsen_tpu/plan/cache.py).
+            from ..plan import cache as plan_cache
+
+            plan_cache.configure(plan_cache_dir)
         self._cond = threading.Condition()
         self._queue: list[Request] = []
         self._tickets: dict[str, Request] = {}
@@ -271,6 +280,18 @@ class Scheduler:
         out["chip-health"] = degrade.chip_state()
         out["profile-records"] = profile.count_records()
         out["profile-by-pass"] = profile.by_pass()
+        # Plan-layer health: routing flag, persistent cache hit rates,
+        # and which passes the cost model covers — the /fleet plan
+        # panel renders this block.
+        from .. import plan as _plan
+        from ..plan import cache as plan_cache
+        from ..plan import costmodel
+
+        out["plan"] = {
+            "enabled": _plan.enabled(),
+            "cache": plan_cache.stats(),
+            "costmodel": costmodel.model_info(),
+        }
         return out
 
     # -- the worker ---------------------------------------------------------
@@ -509,6 +530,25 @@ def _settle_packs(
     from ..checker.refute import check_refute
     from ..ops.wgl_stream import check_wgl_witness_stream
     from ..parallel import independent as pind
+
+    # Compiled-plan route: the same stream / memo / decide-mode screen
+    # / exact pipeline as a pass DAG (jepsen_tpu/plan/), with the
+    # daemon's persistent plan memo in front when --plan-cache is set.
+    from ..plan import enabled as _plan_enabled
+
+    if _plan_enabled():
+        try:
+            from ..plan.compiler import run_packs
+
+            return run_packs(packs, model, lin, deadline)
+        except Exception:  # noqa: BLE001 — legacy ladder is the net
+            telemetry.count("wgl.plan.fallback")
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "plan executor failed; using the legacy packs ladder",
+                exc_info=True,
+            )
 
     pm = model.packed()
 

@@ -205,6 +205,7 @@ def make_server(
     max_budget_s: Optional[float] = None,
     bound: Optional[int] = None,
     profile_dir: Optional[str] = None,
+    plan_cache_dir: Optional[str] = None,
 ) -> CheckerdServer:
     srv = CheckerdServer((host, port), _Handler)
     srv.scheduler = Scheduler(
@@ -212,6 +213,7 @@ def make_server(
         max_budget_s=max_budget_s,
         bound=bound,
         profile_dir=profile_dir,
+        plan_cache_dir=plan_cache_dir,
     )
     return srv
 
@@ -283,12 +285,14 @@ def serve(
     max_budget_s: Optional[float] = None,
     metrics_port: Optional[int] = None,
     profile_dir: Optional[str] = None,
+    plan_cache_dir: Optional[str] = None,
 ) -> None:
     """Blocking entrypoint for `jepsen checkerd`."""
     srv = make_server(
         host, port,
         batch_window_s=batch_window_s, max_budget_s=max_budget_s,
         profile_dir=profile_dir,
+        plan_cache_dir=plan_cache_dir,
     )
     bound_port = srv.server_address[1]
     msrv = None
@@ -350,6 +354,12 @@ def main(argv: Optional[list[str]] = None) -> int:
         help="directory for the fleet-wide per-pass cost-profile "
         "store (profiles.jsonl) and postmortem dumps",
     )
+    p.add_argument(
+        "--plan-cache", default=None, metavar="DIR",
+        help="directory for the persistent plan memo and XLA compile "
+        "cache: a restarted daemon re-checking byte-identical "
+        "histories warm-starts from it (jepsen_tpu/plan/cache.py)",
+    )
     opts = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -365,5 +375,6 @@ def main(argv: Optional[list[str]] = None) -> int:
         batch_window_s=opts.batch_window, max_budget_s=opts.max_budget,
         metrics_port=None if opts.metrics_port < 0 else opts.metrics_port,
         profile_dir=opts.profile_dir,
+        plan_cache_dir=opts.plan_cache,
     )
     return 0

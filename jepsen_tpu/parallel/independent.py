@@ -386,6 +386,33 @@ class IndependentChecker(Checker):
                 unpackable.append(k)
                 continue
             all_packs[k] = p
+
+        # Compiled-plan route (jepsen_tpu/plan/): the same ladder —
+        # online consume, long-key split, stream witness, settle
+        # pipeline — expressed as a pass DAG and run by the plan
+        # executor, with cost-model knobs and (opt-in) persistent
+        # memoization.  JEPSEN_PLAN=0 keeps the hand-wired ladder
+        # below, which the parity suites diff against.
+        from ..plan import enabled as _plan_enabled
+
+        if _plan_enabled():
+            try:
+                from ..plan.compiler import run_cohort
+
+                return run_cohort(
+                    self, test, subs,
+                    [k for k in keys if k in all_packs],
+                    unpackable, all_packs, model, pm, lin, opts,
+                )
+            except Exception:  # noqa: BLE001 — legacy ladder is the net
+                telemetry.count("wgl.plan.fallback")
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "plan executor failed; using the legacy ladder",
+                    exc_info=True,
+                )
+
         results_unpack: dict[Any, dict] = {}
         if unpackable:
             rs = bounded_pmap(

@@ -1,0 +1,319 @@
+"""The plan compiler: packed work + model spec + budget -> Plan.
+
+Three entry points mirror the three call sites that used to wire the
+tier ladder by hand:
+
+* `run_cohort`  — IndependentChecker's per-key cohort (subsumes the
+  online-consume / long-key split / stream witness / `_settle_cohort`
+  pipeline)
+* `run_packs`   — checkerd's wire-packed submissions (subsumes
+  `_settle_packs`: stream, memo, decide-mode screen, exact CPU —
+  no batched tier)
+* `run_single`  — one Linearizable history on the auto device paths
+
+Each compiles a Plan whose knobs come from the cost model
+(plan/costmodel.py) — the hand heuristics when untrained, in which
+case every knob equals the legacy formula and the compiled plan is
+behavior-identical to the hand-wired ladder — and executes it through
+plan/executor.py.  The persistent-memo node is inserted only when a
+cache directory is configured (cache.py), so default runs have no
+on-disk state.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Optional
+
+from .. import telemetry
+from . import cache as plan_cache
+from . import costmodel
+from .executor import ExecContext, execute
+from .ir import PassNode, Plan
+
+log = logging.getLogger(__name__)
+
+
+def _identity(lin: Any, pm: Any, kind: str) -> dict:
+    """The persistent-memo identity: every fact whose change must MISS
+    the journaled verdicts (satellite: model spec, budget, algorithm;
+    the packed digest itself is the other key half)."""
+    return {
+        "kind": kind,
+        "model": pm.name,
+        "init": [int(v) for v in pm.init_state],
+        "width": int(pm.state_width),
+        "algorithm": lin.algorithm,
+        "budget-s": lin.time_limit_s,
+        "max-configs": lin.max_configs,
+    }
+
+
+def _knob_counter(*sources: str) -> None:
+    telemetry.count(
+        "wgl.plan.knobs-model" if "model" in sources
+        else "wgl.plan.knobs-heuristic"
+    )
+
+
+# ---------------------------------------------------------------------------
+# Cohort plans (IndependentChecker)
+# ---------------------------------------------------------------------------
+
+
+def compile_cohort_plan(
+    checker: Any, test: dict, lin: Any, pm: Any,
+    n_keys: int, n_ops: int, *,
+    has_unpackable: bool,
+) -> tuple[Plan, str]:
+    """-> (plan, entry-node-id for packable keys).  Node order is the
+    legacy ladder's; the cost model only turns knobs (and may drop the
+    stream tier when trained data says it loses)."""
+    sess = (test or {}).get("streaming-session") \
+        if getattr(checker, "streaming", True) else None
+    cache_on = plan_cache.cache_dir() is not None
+    stream_knobs, s_src = costmodel.choose_stream_knobs(n_keys, n_ops)
+    batched_knobs, b_src = costmodel.choose_batched_knobs(
+        n_keys, n_ops, lin.beam
+    )
+    order = costmodel.choose_tier_order(n_keys, n_ops, stream_knobs)
+    _knob_counter(s_src, b_src)
+
+    feats = {"keys": n_keys, "ops": n_ops}
+    nodes: list[PassNode] = []
+    if has_unpackable:
+        nodes.append(PassNode("fallback", "host-fallback"))
+    # The main chain: each entry's unknown edge points at the next.
+    chain: list[PassNode] = []
+    if sess is not None:
+        chain.append(PassNode("online", "online-consume",
+                              features=feats))
+    if cache_on:
+        chain.append(PassNode("pmemo", "persistent-memo",
+                              features=feats))
+    router = PassNode("router", "length-router",
+                      knobs={"threshold": 2000})
+    chain.append(router)
+    longdev = PassNode("longdev", "single-device", features=feats)
+    stream = None
+    if order != "skip-stream":
+        stream = PassNode("stream", "stream-witness",
+                          knobs=dict(stream_knobs), features=feats)
+    screen = PassNode("screen", "refute-screen",
+                      knobs={"mode": "classify"}, features=feats,
+                      group=True)
+    batched = PassNode("batched", "batched-bfs",
+                       knobs=dict(batched_knobs), features=feats,
+                       group=True)
+    detail = PassNode("detail", "settle-exact", features=feats,
+                      group=True)
+
+    after_router = stream if stream is not None else screen
+    for a, b in zip(chain, chain[1:]):
+        a.edges["unknown"] = b.id
+    router.edges["long"] = longdev.id
+    router.edges["unknown"] = after_router.id
+    if stream is not None:
+        stream.edges["unknown"] = screen.id
+    screen.edges["refuted"] = detail.id
+    screen.edges["unknown"] = batched.id
+    batched.edges["refuted"] = detail.id
+    batched.edges["unknown"] = detail.id
+
+    nodes.extend(chain)
+    nodes.append(longdev)
+    if stream is not None:
+        nodes.append(stream)
+    nodes.extend([screen, batched, detail])
+
+    plan = Plan(nodes, meta={
+        "kind": "cohort",
+        "model": pm.name,
+        "algorithm": lin.algorithm,
+        "budget-s": lin.time_limit_s,
+        "keys": n_keys,
+        "knobs": "model" if "model" in (s_src, b_src) else "heuristic",
+        "order": order,
+    })
+    return plan, chain[0].id
+
+
+def run_cohort(
+    checker: Any, test: dict, subs: dict, packable: list,
+    unpackable: list, packs: dict, model: Any, pm: Any, lin: Any,
+    opts: dict,
+) -> dict:
+    """Compiles and executes the cohort plan; drop-in for everything
+    after the packing partition in
+    IndependentChecker._check_linearizable."""
+    from ..parallel.mesh import checker_mesh
+
+    n_ops = int(sum(packs[k].n for k in packable))
+    plan, entry = compile_cohort_plan(
+        checker, test, lin, pm, len(packable), n_ops,
+        has_unpackable=bool(unpackable),
+    )
+    telemetry.count("wgl.plan.compile")
+    telemetry.count("wgl.plan.keys", len(packable) + len(unpackable))
+    ctx = ExecContext(
+        test=test, subs=subs, packs=packs, model=model, pm=pm, lin=lin,
+        opts=opts, bound=checker.bound, mesh=checker_mesh(test),
+        checker=checker, mode="cohort",
+        identity=_identity(lin, pm, "cohort"),
+    )
+    seeds: dict = {}
+    if unpackable:
+        seeds["fallback"] = list(unpackable)
+    if packable:
+        seeds[entry] = list(packable)
+    return execute(plan, ctx, seeds)
+
+
+# ---------------------------------------------------------------------------
+# Wire-packed plans (checkerd)
+# ---------------------------------------------------------------------------
+
+
+def compile_packs_plan(lin: Any, pm: Any, n_keys: int,
+                       n_ops: int) -> tuple[Plan, str]:
+    cache_on = plan_cache.cache_dir() is not None
+    stream_knobs, s_src = costmodel.choose_stream_knobs(n_keys, n_ops)
+    _knob_counter(s_src)
+    feats = {"keys": n_keys, "ops": n_ops}
+    chain: list[PassNode] = []
+    if cache_on:
+        chain.append(PassNode("pmemo", "persistent-memo",
+                              features=feats))
+    stream = PassNode("stream", "stream-witness",
+                      knobs=dict(stream_knobs), features=feats)
+    screen = PassNode("screen", "refute-screen",
+                      knobs={"mode": "decide"}, features=feats,
+                      group=True)
+    exact = PassNode("exact", "packs-exact", features=feats,
+                     group=True)
+    chain.append(stream)
+    for a, b in zip(chain, chain[1:]):
+        a.edges["unknown"] = b.id
+    stream.edges["unknown"] = screen.id
+    screen.edges["unknown"] = exact.id
+    plan = Plan(chain + [screen, exact], meta={
+        "kind": "packs",
+        "model": pm.name,
+        "algorithm": lin.algorithm,
+        "budget-s": lin.time_limit_s,
+        "keys": n_keys,
+    })
+    return plan, chain[0].id
+
+
+def run_packs(packs: dict, model: Any, lin: Any,
+              deadline: Optional[float]) -> dict:
+    """Drop-in for checkerd's _settle_packs."""
+    pm = model.packed()
+    out: dict = {}
+    live = []
+    for k, p in packs.items():
+        if p.n == 0:
+            out[k] = {"valid": True, "algorithm": "empty"}
+        else:
+            live.append(k)
+    if not live:
+        return out
+    n_ops = int(sum(packs[k].n for k in live))
+    plan, entry = compile_packs_plan(lin, pm, len(live), n_ops)
+    telemetry.count("wgl.plan.compile")
+    telemetry.count("wgl.plan.keys", len(live))
+    ctx = ExecContext(
+        test={}, subs={}, packs=packs, model=model, pm=pm, lin=lin,
+        opts={}, mode="packs", deadline=deadline,
+        identity=_identity(lin, pm, "packs"),
+    )
+    out.update(execute(plan, ctx, {entry: live}))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Single-history plans (Linearizable auto paths)
+# ---------------------------------------------------------------------------
+
+_SINGLE = "_history"
+
+
+def run_single(lin: Any, packed: Any, pm: Any, model: Any,
+               algorithm: str, test: dict, opts: dict) -> dict:
+    """One history through the executor: a persistent-memo probe (when
+    a cache dir is configured) in front of the device-first ladder.
+    With no cache the plan is the single device-ladder node, whose
+    runner IS the legacy ladder."""
+    cache_on = plan_cache.cache_dir() is not None
+    nodes: list[PassNode] = []
+    feats = {"ops": int(packed.n), "ok": int(packed.n_ok)}
+    ladder = PassNode("ladder", "device-ladder", features=feats,
+                      knobs={"beam": lin.beam, "max_beam": lin.max_beam,
+                             "block": lin.block})
+    if cache_on:
+        pmemo = PassNode("pmemo", "persistent-memo", features=feats,
+                         edges={"unknown": "ladder"})
+        nodes.append(pmemo)
+    nodes.append(ladder)
+    plan = Plan(nodes, meta={
+        "kind": "single",
+        "model": pm.name,
+        "algorithm": algorithm,
+        "budget-s": lin.time_limit_s,
+    })
+    telemetry.count("wgl.plan.compile")
+    identity = _identity(lin, pm, "single")
+    # Search-shape knobs join the identity: they cannot flip a verdict,
+    # but a memo entry must describe the plan that produced it.
+    identity["beam"] = lin.beam
+    identity["max-beam"] = lin.max_beam
+    ctx = ExecContext(
+        test=test, subs={}, packs={_SINGLE: packed}, model=model,
+        pm=pm, lin=lin, opts=opts, mode="single", identity=identity,
+    )
+    results = execute(plan, ctx, {nodes[0].id: [_SINGLE]})
+    r = results[_SINGLE]
+    if cache_on and not r.get("memo-hit") \
+            and r.get("valid") in (True, False):
+        from ..parallel.independent import _sanitize_settle
+
+        pmemo_store = plan_cache.active_memo()
+        if pmemo_store is not None:
+            pmemo_store.put(
+                plan_cache.memo_key(
+                    ctx.digest(_SINGLE), identity
+                ),
+                _sanitize_settle(r),
+            )
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Elle plans (dependency-graph cycle pass)
+# ---------------------------------------------------------------------------
+
+
+def plan_cycle_fn(device: str) -> Any:
+    """A `cycle_fn` for elle's analyses (checker/elle/append.py, wr.py)
+    that routes the cycle pass through a one-node plan, registering the
+    device SCC screen as the `elle-cycles` pass family.  Returns None
+    for the host default (elle's own Tarjan path)."""
+    if device == "off":
+        return None
+
+    def run(g: Any) -> Any:
+        plan = Plan(
+            [PassNode("cycles", "elle-cycles",
+                      knobs={"device": device},
+                      features={"vertices": len(getattr(g, "adj", ()))})],
+            meta={"kind": "elle", "device": device},
+        )
+        telemetry.count("wgl.plan.compile")
+        ctx = ExecContext(
+            test={}, subs={}, packs={_SINGLE: g}, model=None, pm=None,
+            lin=None, opts={}, mode="single",
+        )
+        return execute(plan, ctx, {"cycles": [_SINGLE]})[_SINGLE]["cycles"]
+
+    return run

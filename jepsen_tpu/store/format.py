@@ -48,6 +48,10 @@ BLOCK_RESULTS = 4
 #: Fault-ledger record (nemesis/ledger.py): one intent/healed entry per
 #: block, appended + fsynced before/after each cluster-touching fault.
 BLOCK_LEDGER = 5
+#: Plan-memo journal entry (plan/cache.py): one settled plan-node
+#: verdict per block, keyed by packed digest + plan knobs, so restarted
+#: checker processes warm-start past already-decided work.
+BLOCK_PLAN = 6
 
 #: Ops per sealed history chunk (format.clj:372-375).
 CHUNK_SIZE = 16384

@@ -341,7 +341,15 @@ class StreamingSession:
         digests = [_settle_digest(p, self.pm) for p in packs]
         self._stream_rows_hwm = max(self._stream_rows_hwm,
                                     sum(int(p.n) for p in packs))
-        verdicts = check_wgl_witness_stream(packs, self.pm)
+        kw: dict = {}
+        from ..plan import enabled as _plan_enabled
+        if _plan_enabled():
+            from ..plan import costmodel
+            knobs, _src = costmodel.choose_stream_knobs(
+                len(packs), sum(int(p.n) for p in packs))
+            kw["segment_keys"] = knobs["segment"]
+            kw["max_restarts"] = knobs["max_restarts"]
+        verdicts = check_wgl_witness_stream(packs, self.pm, **kw)
         for k, d, v in zip(keys, digests, verdicts):
             self._attempted[k] = d
             if v is True:

@@ -122,6 +122,7 @@ def reset() -> None:
 FLEET_COUNTER_PREFIXES = (
     "nemesis.search.",
     "wgl.online.",
+    "wgl.plan.",
     "checkerd.",
 )
 

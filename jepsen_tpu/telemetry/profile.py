@@ -99,9 +99,33 @@ def append(record: dict) -> Optional[str]:
         return None
 
 
+def normalize(rec: dict) -> dict:
+    """A raw store record coerced to the canonical shape every consumer
+    (profile_diff, costmodel_train, the observatory) can index without
+    KeyError.  Stores are written by whichever process version happens
+    to be running — client and daemon records routinely disagree on
+    schema — so missing/mistyped keys degrade to neutral values
+    (pass -> "unknown", dicts -> {}) instead of raising."""
+    name = rec.get("pass")
+    out = dict(rec)
+    out["pass"] = name if isinstance(name, str) and name else "unknown"
+    for k in ("features", "plan", "timing"):
+        v = rec.get(k)
+        out[k] = v if isinstance(v, dict) else {}
+    timing = {}
+    for k, v in out["timing"].items():
+        try:
+            timing[k] = float(v)
+        except (TypeError, ValueError):
+            continue
+    out["timing"] = timing
+    return out
+
+
 def read(path: str) -> list[dict]:
-    """Every intact record in a profile store; torn/garbage lines
-    (crash mid-append) are skipped, not fatal."""
+    """Every intact record in a profile store, normalized
+    (`normalize`); torn/garbage lines (crash mid-append) are skipped,
+    not fatal."""
     out: list[dict] = []
     try:
         with open(path) as f:
@@ -114,7 +138,7 @@ def read(path: str) -> list[dict]:
                 except ValueError:
                     continue
                 if isinstance(rec, dict):
-                    out.append(rec)
+                    out.append(normalize(rec))
     except OSError:
         pass
     return out
@@ -136,7 +160,7 @@ def by_pass(path: Optional[str] = None) -> dict[str, int]:
     if not p:
         return agg
     for rec in read(p):
-        name = rec.get("pass") or "?"
+        name = rec["pass"]
         agg[name] = agg.get(name, 0) + 1
     return agg
 

@@ -290,10 +290,38 @@ class Handler(http.server.BaseHTTPRequestHandler):
             "<th>merged</th><th>last latency s</th></tr>"
             + rrows + "</table>"
         ) if rrows else "<p>no runs have submitted yet</p>"
+        plan_tbl = ""
+        plan = stats.get("plan") or {}
+        if plan:
+            cache = plan.get("cache") or {}
+            memo = cache.get("memo") or {}
+            cm = plan.get("costmodel") or {}
+            prows = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(str(v))}</td></tr>"
+                for k, v in [
+                    ("plan executor", "on" if plan.get("enabled")
+                     else "off"),
+                    ("cache dir", cache.get("dir") or "(not configured)"),
+                    ("memo entries", memo.get("entries")),
+                    ("memo hits", memo.get("hits")),
+                    ("memo misses", memo.get("misses")),
+                    ("memo stores", memo.get("puts")),
+                    ("xla cache files", cache.get("xla_files")),
+                    ("cost model", "trained" if cm.get("loaded")
+                     else "heuristics"),
+                    ("cost model passes", ", ".join(cm.get("passes") or [])
+                     or "-"),
+                ]
+            )
+            plan_tbl = (
+                "<h2>plan cache (plan/cache.py)</h2>"
+                f"<table>{prows}</table>"
+            )
         self._send(200, _page(
             "checker fleet",
-            f"<table>{orows}</table>" + runs_tbl + _slo_panel()
-            + lint_tbl + hint,
+            f"<table>{orows}</table>" + runs_tbl + plan_tbl
+            + _slo_panel() + lint_tbl + hint,
         ))
 
     def _metrics(self) -> None:

@@ -308,3 +308,54 @@ def test_second_run_rides_the_warm_path(daemon):
     cold = t1["checkerd"]["check-s"]
     warm = t2["checkerd"]["check-s"]
     assert warm < cold, (cold, warm)
+
+
+def test_restarted_daemon_warm_starts_from_plan_cache(tmp_path):
+    """The plan layer of the warm path: with --plan-cache, a daemon
+    journals settled plan-node verdicts; a RESTARTED daemon (fresh
+    Scheduler over the same directory) must serve the byte-identical
+    resubmission from the journal, and a budget change must MISS."""
+    from jepsen_tpu import plan as _plan
+    from jepsen_tpu.plan import cache as plan_cache
+
+    if not _plan.enabled():
+        pytest.skip("JEPSEN_PLAN disabled")
+    h = _mixed_history()
+
+    def one_round(run_id, time_limit_s=None):
+        plan_cache.reset_for_tests()
+        srv = make_server("127.0.0.1", 0, batch_window_s=0.0,
+                          plan_cache_dir=str(tmp_path))
+        t = threading.Thread(target=srv.serve_forever, daemon=True)
+        t.start()
+        try:
+            addr = f"127.0.0.1:{srv.server_address[1]}"
+            base = IndependentChecker(
+                Linearizable(Register(), time_limit_s=time_limit_s))
+            res = RemoteChecker(
+                base, addr, run_id=run_id, fallback=False,
+            ).check({"name": run_id}, h, {})
+            return res, srv.scheduler.stats()["plan"]
+        finally:
+            srv.shutdown()
+            srv.server_close()
+            srv.scheduler.stop()
+            t.join(timeout=5)
+            plan_cache.reset_for_tests()
+
+    r1, p1 = one_round("cold")
+    assert r1["valid"] is False
+    memo1 = p1["cache"]["memo"]
+    assert memo1["puts"] >= 1
+
+    r2, p2 = one_round("warm")  # fresh scheduler, same directory
+    assert r2["valid"] is False
+    memo2 = p2["cache"]["memo"]
+    assert memo2["loaded"] >= memo1["puts"]
+    assert memo2["hits"] >= 1
+    for k in r1["results"]:
+        assert r2["results"][k]["valid"] == r1["results"][k]["valid"]
+
+    _, p3 = one_round("budget-change", time_limit_s=7.25)
+    memo3 = p3["cache"]["memo"]
+    assert memo3["hits"] == 0  # budget is part of the plan identity

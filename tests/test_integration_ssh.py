@@ -81,6 +81,12 @@ def cluster():
         }
         return
 
+    # The netns flavor runs minissh daemons, whose transport needs
+    # pyca/cryptography; without it only the env-nodes flavor can run.
+    pytest.importorskip(
+        "cryptography",
+        reason="netns cluster needs cryptography for minissh",
+    )
     from jepsen_tpu.control.netns import (
         NetnsSshCluster,
         netns_available,

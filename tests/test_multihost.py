@@ -96,9 +96,14 @@ def test_two_process_distributed_init_and_psum():
         pytest.fail("multi-host workers hung (coordinator deadlock?)")
     for rc, out, err in outs:
         if rc != 0 and ("Permission denied" in err
-                        or "unavailable" in err.lower()):
+                        or "unavailable" in err.lower()
+                        or "aren't implemented" in err):
+            # "Multiprocess computations aren't implemented on the CPU
+            # backend": jaxlib builds without CPU collectives can wire
+            # the mesh but die at the psum — a backend limitation, not
+            # a regression in the wire-up under test.
             pytest.skip(
-                "environment forbids the coordinator socket; probe "
+                "environment cannot run the cross-process psum; probe "
                 f"output: {err[-500:]}"
             )
     for pid, (rc, out, err) in enumerate(outs):

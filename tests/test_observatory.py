@@ -226,6 +226,30 @@ def test_profile_store_crash_safe(scope, tmp_path):
     assert profile.by_pass() == {"witness": 1, "settle": 1}
 
 
+def test_profile_read_tolerates_mixed_schemas(scope, tmp_path):
+    """Stores are written by whatever process version is running;
+    loaders must degrade missing/mistyped keys, never KeyError."""
+    profile.set_store(str(tmp_path))
+    profile.append({"v": 1, "pass": "witness",
+                    "timing": {"execute_s": 0.25}})
+    path = profile.store_path()
+    with open(path, "a") as f:
+        # Old-schema record: no "pass" at all, timing is a list.
+        f.write(json.dumps({"v": 1, "timing": [1, 2]}) + "\n")
+        # Daemon-side variant: pass is None, timing values are junk.
+        f.write(json.dumps({"v": 1, "pass": None, "features": "n/a",
+                            "timing": {"execute_s": "fast"}}) + "\n")
+    recs = profile.read(path)
+    assert [r["pass"] for r in recs] == ["witness", "unknown", "unknown"]
+    for r in recs:
+        assert isinstance(r["features"], dict)
+        assert isinstance(r["plan"], dict)
+        assert all(isinstance(v, float) for v in r["timing"].values())
+    assert recs[0]["timing"]["execute_s"] == 0.25
+    assert recs[2]["timing"] == {}  # junk value dropped, not raised
+    assert profile.by_pass() == {"witness": 1, "unknown": 2}
+
+
 def test_profile_disabled_is_noop(tmp_path):
     prior = telemetry.enabled()
     telemetry.enable(False)

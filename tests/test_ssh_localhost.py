@@ -28,7 +28,13 @@ from jepsen_tpu.control import (
     on_nodes,
     with_sessions,
 )
-from jepsen_tpu.control.minissh import MiniSshServer, generate_keypair
+
+# minissh's transport layer (aes128-ctr, ed25519) is built on
+# pyca/cryptography; the whole module skips when the image lacks it.
+pytest.importorskip(
+    "cryptography", reason="minissh needs the cryptography package"
+)
+from jepsen_tpu.control.minissh import MiniSshServer, generate_keypair  # noqa: E402
 
 N_NODES = 3
 

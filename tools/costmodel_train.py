@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Train the plan cost model from recorded per-pass profiles.
+
+The offline half of plan/costmodel.py: reads one or more
+profiles.jsonl stores (telemetry/profile.py — the declared training
+set), fits the per-pass ridge regressor over history-shape + knob
+features, and writes the model JSON that `JEPSEN_COSTMODEL=<path>`
+loads at runtime.  Untrained processes keep the hand heuristics, so
+shipping no model file is always safe.
+
+`--eval` replays the SAME recorded data as a knob-choice benchmark, in
+profile_diff's bucket terms: records are grouped by shape bucket (pass
++ requested-shape features), then by knob config within the bucket.
+For each bucket holding at least two configs, the model picks the
+config it predicts cheapest; the pick WINS when its measured median
+cost beats the hand-heuristic config's measured median.  `--require-win`
+exits nonzero unless the model wins at least one bucket — the CI
+acceptance gate for "the trained model beats the heuristics on at
+least one recorded shape".
+
+Usage:
+  python tools/costmodel_train.py STORE.jsonl [STORE2.jsonl ...]
+      [--out model.json] [--min-samples 4] [--eval] [--require-win]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.plan import costmodel  # noqa: E402
+from jepsen_tpu.telemetry import profile  # noqa: E402
+
+#: Same exclusion set as tools/profile_diff.py: measured outputs never
+#: define a shape bucket.
+MEASURED_FEATURES = frozenset((
+    "explored", "attempts", "kept_units", "checks", "device_s",
+    "proven", "settled", "merged", "passes", "restarts",
+))
+
+
+def shape_key(rec: dict) -> str:
+    feats = {
+        k: v for k, v in (rec.get("features") or {}).items()
+        if k not in MEASURED_FEATURES
+    }
+    return json.dumps({"pass": rec["pass"], "features": feats},
+                      sort_keys=True, default=repr)
+
+
+def knob_key(rec: dict) -> str:
+    plan = {
+        k: rec["plan"][k] for k in costmodel.KNOB_KEYS
+        if k in (rec.get("plan") or {})
+    }
+    return json.dumps(plan, sort_keys=True)
+
+
+def heuristic_config(pass_name: str, features: dict,
+                     configs: list[dict]) -> dict | None:
+    """The knob config the hand-wired ladder would have picked for this
+    shape, iff it appears among the bucket's recorded configs."""
+    if pass_name == "stream":
+        keys = int(features.get("keys") or 0)
+        want = costmodel.heuristic_stream_knobs(keys)
+        for c in configs:
+            if all(c.get(k) == v for k, v in want.items()):
+                return c
+        return None
+    if pass_name == "batched":
+        # The ladder starts batched at min(lin.beam, 32); the requested
+        # beam is not recorded, so the widest recorded beam <= 32
+        # stands in for the legacy start.
+        beams = [c.get("beam") for c in configs
+                 if isinstance(c.get("beam"), (int, float))]
+        legal = [b for b in beams if b <= 32]
+        if not legal:
+            return None
+        start = max(legal)
+        for c in configs:
+            if c.get("beam") == start:
+                return c
+    return None
+
+
+def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
+    """{buckets, comparable, wins, losses, ties, rows} over the
+    recorded data."""
+    shapes: dict[str, dict[str, list[float]]] = {}
+    feats_of: dict[str, dict] = {}
+    for rec in records:
+        if rec["pass"] not in model.passes:
+            continue
+        sk = shape_key(rec)
+        feats_of[sk] = {
+            k: v for k, v in rec["features"].items()
+            if k not in MEASURED_FEATURES
+        }
+        shapes.setdefault(sk, {}).setdefault(
+            knob_key(rec), []
+        ).append(costmodel.record_cost_s(rec))
+
+    rows = []
+    wins = losses = ties = comparable = 0
+    for sk, by_cfg in sorted(shapes.items()):
+        if len(by_cfg) < 2:
+            continue
+        cfg = json.loads(sk)
+        pass_name, features = cfg["pass"], feats_of[sk]
+        configs = [json.loads(k) for k in by_cfg]
+        heur = heuristic_config(pass_name, features, configs)
+        if heur is None:
+            continue
+        comparable += 1
+        preds = []
+        for k in by_cfg:
+            p = model.predict_s(pass_name, features, json.loads(k))
+            preds.append((p if p is not None else float("inf"), k))
+        picked = min(preds)[1]
+        heur_k = json.dumps(heur, sort_keys=True)
+        picked_s = statistics.median(by_cfg[picked])
+        heur_s = statistics.median(by_cfg[heur_k])
+        if picked == heur_k or picked_s == heur_s:
+            verdict = "tie"
+            ties += 1
+        elif picked_s < heur_s:
+            verdict = "win"
+            wins += 1
+        else:
+            verdict = "loss"
+            losses += 1
+        rows.append({
+            "pass": pass_name,
+            "features": features,
+            "configs": len(by_cfg),
+            "model-config": json.loads(picked),
+            "model-median-s": round(picked_s, 6),
+            "heuristic-config": heur,
+            "heuristic-median-s": round(heur_s, 6),
+            "verdict": verdict,
+        })
+    return {
+        "buckets": len(shapes),
+        "comparable": comparable,
+        "wins": wins,
+        "losses": losses,
+        "ties": ties,
+        "rows": rows,
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="fit the plan cost model from profiles.jsonl stores"
+    )
+    ap.add_argument("stores", nargs="+",
+                    help="profiles.jsonl paths (telemetry/profile.py)")
+    ap.add_argument("--out", default="costmodel.json",
+                    help="model output path (default costmodel.json)")
+    ap.add_argument("--min-samples", type=int,
+                    default=costmodel.MIN_SAMPLES,
+                    help="per-pass training floor (default "
+                         f"{costmodel.MIN_SAMPLES})")
+    ap.add_argument("--eval", action="store_true",
+                    help="benchmark model vs heuristic knob choices "
+                         "on the recorded shape buckets")
+    ap.add_argument("--require-win", action="store_true",
+                    help="exit 1 unless the model wins >=1 bucket "
+                         "(implies --eval)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the eval report as JSON")
+    args = ap.parse_args()
+
+    records: list[dict] = []
+    for path in args.stores:
+        got = profile.read(path)
+        print(f"# {path}: {len(got)} records")
+        records.extend(got)
+    if not records:
+        print("# no records; nothing to train")
+        return 1
+
+    model = costmodel.fit(records, min_samples=args.min_samples)
+    if not model.passes:
+        print(f"# no pass reached {args.min_samples} samples; "
+              f"no model written (runtime keeps the heuristics)")
+        return 1
+    model.save(args.out)
+    for name in sorted(model.passes):
+        p = model.passes[name]
+        print(f"# trained {name}: n={p['n']} "
+              f"rmse_log={p['rmse_log']:.4f}")
+    print(f"# wrote {args.out}")
+
+    if not (args.eval or args.require_win):
+        return 0
+    report = evaluate(model, records)
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        for r in report["rows"]:
+            print(f"{r['verdict']:>5}  {r['pass']:<10} "
+                  f"{json.dumps(r['features'], sort_keys=True)} "
+                  f"model {r['model-config']} "
+                  f"{r['model-median-s'] * 1000:.1f}ms vs heuristic "
+                  f"{r['heuristic-config']} "
+                  f"{r['heuristic-median-s'] * 1000:.1f}ms")
+    print(f"# {report['comparable']} comparable buckets: "
+          f"{report['wins']} win(s), {report['ties']} tie(s), "
+          f"{report['losses']} loss(es)")
+    if args.require_win and report["wins"] < 1:
+        print("# FAIL: model beats the heuristics on no recorded bucket")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -42,36 +42,44 @@ from jepsen_tpu.telemetry import profile  # noqa: E402
 #: runs of identical work may differ on all of these.
 MEASURED_FEATURES = frozenset((
     "explored", "attempts", "kept_units", "checks", "device_s",
-    "proven", "settled", "merged",
+    "proven", "settled", "merged", "passes", "restarts",
 ))
 
 
 def bucket_key(rec: dict) -> str:
-    feats = {
-        k: v for k, v in (rec.get("features") or {}).items()
-        if k not in MEASURED_FEATURES
-    }
+    # profile.read() normalizes records, but buckets() is also handed
+    # raw dicts in tests — degrade the same way it would: schema-less
+    # records land in the "unknown" bucket rather than raising.
+    name = rec.get("pass")
+    feats = rec.get("features")
+    feats = feats if isinstance(feats, dict) else {}
+    plan = rec.get("plan")
     return json.dumps(
         {
-            "pass": rec.get("pass"),
-            "plan": rec.get("plan") or {},
-            "features": feats,
+            "pass": name if isinstance(name, str) and name else "unknown",
+            "plan": plan if isinstance(plan, dict) else {},
+            "features": {
+                k: v for k, v in feats.items()
+                if k not in MEASURED_FEATURES
+            },
         },
         sort_keys=True, default=repr,
     )
 
 
 def cost_of(rec: dict) -> float:
-    t = rec.get("timing") or {}
-    ex = t.get("execute_s") or 0.0
-    return float(ex if ex > 0 else t.get("total_s") or 0.0)
+    t = rec.get("timing")
+    t = t if isinstance(t, dict) else {}
+    try:
+        ex = float(t.get("execute_s") or 0.0)
+        return ex if ex > 0 else float(t.get("total_s") or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
 
 
 def buckets(path: str) -> dict[str, list[float]]:
     out: dict[str, list[float]] = {}
     for rec in profile.read(path):
-        if not rec.get("pass"):
-            continue
         out.setdefault(bucket_key(rec), []).append(cost_of(rec))
     return out
 

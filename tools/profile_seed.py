@@ -8,7 +8,14 @@ with profiling on, so the per-pass cost records land in
 `tools/profile_diff.py` then buckets this run's records against the
 cached previous run's.
 
+With `--sweep`, additionally runs the stream witness over an
+invalid-heavy multi-key shape at several segment knobs — the
+knob-varied records tools/costmodel_train.py needs: a model can only
+out-pick the hand heuristics on shapes where the store actually
+recorded more than one knob config.
+
 Usage: python tools/profile_seed.py OUT_DIR [keys] [pairs-per-key]
+           [--sweep]
 """
 
 import os
@@ -47,10 +54,42 @@ def seed_history(keys: int, pairs: int) -> History:
     return History(ops)
 
 
+def sweep_stream_knobs(repeats: int = 3) -> int:
+    """Stream-witness passes over one invalid-heavy shape at several
+    segment sizes.  A dead key restarts the stream, and each restart
+    re-plans O(segment) rows — so on this shape the small segment
+    measurably beats the heuristic ~K/8, giving the trained model a
+    recorded bucket to win.  Returns the record count added."""
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.models import cas_register
+    from jepsen_tpu.ops.wgl_stream import check_wgl_witness_stream
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    pm = cas_register().packed()
+    bad = set(range(0, 60, 3))  # 20 of 60 keys defeat the witness
+    packs = []
+    for i in range(60):
+        h = random_register_history(
+            120, procs=4, info_rate=0.05, seed=i, bad=(i in bad),
+        )
+        packs.append(pack_history(h, pm.encode))
+    n = 0
+    restarts = max(8, len(packs) // 2)  # the heuristic cap: only the
+    for _ in range(repeats):            # segment knob varies
+        for seg in (2, 3, 4, 6, 8, 16):
+            check_wgl_witness_stream(
+                packs, pm, segment_keys=seg, max_restarts=restarts,
+            )
+            n += 1
+    return n
+
+
 def main() -> int:
-    out = sys.argv[1] if len(sys.argv) > 1 else "profile-seed"
-    keys = int(sys.argv[2]) if len(sys.argv) > 2 else 8
-    pairs = int(sys.argv[3]) if len(sys.argv) > 3 else 40
+    argv = [a for a in sys.argv[1:] if a != "--sweep"]
+    sweep = "--sweep" in sys.argv[1:]
+    out = argv[0] if len(argv) > 0 else "profile-seed"
+    keys = int(argv[1]) if len(argv) > 1 else 8
+    pairs = int(argv[2]) if len(argv) > 2 else 40
     os.makedirs(out, exist_ok=True)
     telemetry.enable(True)
     telemetry.reset()
@@ -63,6 +102,9 @@ def main() -> int:
         if res.get("valid") is not True:
             print(f"FAIL: seed workload not valid: {res.get('valid')}")
             return 1
+        if sweep:
+            n_sweep = sweep_stream_knobs()
+            print(f"# sweep: {n_sweep} knob-varied stream passes")
         path = profile.store_path()
         n = len(profile.read(path)) if path and os.path.isfile(path) else 0
         if not n:
