@@ -94,6 +94,8 @@ DECLARED_NAMESPACES = {
     "wgl.plan": "checking-plan compiler/executor/cache (plan/)",
     "checker": "checker harness (checker/)",
     "checkerd": "checker daemon fleet (checkerd/)",
+    "checkerd.queue": "crash-safe queue journal (checkerd/journal.py)",
+    "router": "checkerd federation router (checkerd/router.py)",
     "nemesis": "fault injection + ledger + schedule search (nemesis/)",
     "lifecycle": "core.run phases (core.py)",
     "interpreter": "op interpreter + workers (interpreter.py)",

@@ -35,6 +35,12 @@ from __future__ import annotations
 #: Default TCP port for the daemon (client, CLI, and /fleet page agree).
 DEFAULT_PORT = 7462
 
+#: Default TCP port for the federation router (`jepsen checkerd-router`,
+#: router.py): a front-end that places submissions across N daemons by
+#: queue depth and model-cache affinity, fails over mid-run, and
+#: enforces per-tenant admission.
+ROUTER_PORT = 7472
+
 #: Environment variable naming a default daemon address ("host:port").
 #: When set, core.analyze routes every linearizable check through it.
 ADDR_ENV = "JEPSEN_CHECKERD"

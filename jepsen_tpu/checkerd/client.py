@@ -257,7 +257,13 @@ class RemoteChecker(Checker):
         connect_timeout: float = 3.0,
     ):
         self.base = base
-        self.addr = addr
+        #: Comma-separated addresses are a failover chain: a dead
+        #: daemon's ticket is retried against the next sibling (full
+        #: re-submission from the client's own copy of the ops) before
+        #: the in-process fallback.  A federation router counts as one
+        #: address — it fails over internally with its journaled bytes.
+        self.addrs = [a.strip() for a in addr.split(",") if a.strip()]
+        self.addr = self.addrs[0] if self.addrs else addr
         self.run_id = run_id
         self.fallback = fallback
         self.connect_timeout = connect_timeout
@@ -328,33 +334,33 @@ class RemoteChecker(Checker):
         if budget is not None or lin.time_limit_s is not None:
             deadline = (budget or 0.0) + (lin.time_limit_s or 0.0) + 300.0
 
-        # A streaming session may have shipped this exact submission
-        # CHUNK-by-CHUNK while the run was still going (streaming/
-        # remote.py); consume its ticket instead of re-uploading.
-        ticket = None
-        sess = (test or {}).get("streaming-session")
-        if independent and sess is not None:
-            ticket = sess.remote_ticket(
-                self.addr, keys, spec, lin.algorithm, budget,
-                lin.time_limit_s,
-            )
-            if ticket is not None:
-                telemetry.count("checkerd.stream-ticket")
-                log.info("consuming streamed checkerd ticket %s", ticket)
-
-        with CheckerdClient(
-            self.addr, connect_timeout=self.connect_timeout,
-        ) as c:
-            if ticket is None:
-                ticket = c.submit_ops(
-                    run, spec, subs_ops,
-                    algorithm=lin.algorithm,
-                    budget_s=budget,
-                    time_limit_s=lin.time_limit_s,
-                    trace=telemetry.trace_context()
-                    if telemetry.enabled() else None,
+        # The failover chain: each address gets a full attempt (its own
+        # streamed ticket if one exists, else a fresh submission).  A
+        # daemon dying mid-wait surfaces as RemoteUnavailable and the
+        # next sibling re-checks the same ops — per-key verdicts are
+        # deterministic, so the retried result matches what the dead
+        # daemon would have returned.
+        last: Optional[RemoteUnavailable] = None
+        payload = None
+        served_by = self.addr
+        for n, addr in enumerate(self.addrs):
+            if n:
+                telemetry.count("checkerd.failover")
+                log.warning(
+                    "checkerd %s failed (%s); retrying ticket against "
+                    "sibling %s", self.addrs[n - 1], last, addr,
                 )
-            payload = c.wait(ticket, deadline_s=deadline)
+            try:
+                payload = self._attempt(
+                    addr, test, keys, subs_ops, spec, lin, independent,
+                    run, budget, deadline,
+                )
+                served_by = addr
+                break
+            except RemoteUnavailable as e:
+                last = e
+        if payload is None:
+            raise last or RemoteUnavailable("no checkerd address")
 
         krs = payload.get("key-results") or []
         if len(krs) != len(keys):
@@ -363,7 +369,7 @@ class RemoteChecker(Checker):
                 f"{len(keys)} keys"
             )
         meta = payload.get("checkerd") or {}
-        meta["addr"] = self.addr
+        meta["addr"] = served_by
         # Adopt the daemon's spans for this request into our trace, so
         # the run's trace.json (and tools/trace_merge.py) shows the
         # cohort/settle work under the daemon's own pid.
@@ -384,6 +390,48 @@ class RemoteChecker(Checker):
             "results": results,
             "checkerd": meta,
         }
+
+    def _attempt(
+        self,
+        addr: str,
+        test: dict,
+        keys: list,
+        subs_ops: list,
+        spec: dict,
+        lin: Any,
+        independent: bool,
+        run: str,
+        budget: Optional[float],
+        deadline: float,
+    ) -> dict:
+        """One full submit-and-wait against one address."""
+        # A streaming session may have shipped this exact submission
+        # CHUNK-by-CHUNK while the run was still going (streaming/
+        # remote.py); consume its ticket instead of re-uploading.
+        ticket = None
+        sess = (test or {}).get("streaming-session")
+        if independent and sess is not None:
+            ticket = sess.remote_ticket(
+                addr, keys, spec, lin.algorithm, budget,
+                lin.time_limit_s,
+            )
+            if ticket is not None:
+                telemetry.count("checkerd.stream-ticket")
+                log.info("consuming streamed checkerd ticket %s", ticket)
+
+        with CheckerdClient(
+            addr, connect_timeout=self.connect_timeout,
+        ) as c:
+            if ticket is None:
+                ticket = c.submit_ops(
+                    run, spec, subs_ops,
+                    algorithm=lin.algorithm,
+                    budget_s=budget,
+                    time_limit_s=lin.time_limit_s,
+                    trace=telemetry.trace_context()
+                    if telemetry.enabled() else None,
+                )
+            return c.wait(ticket, deadline_s=deadline)
 
 
 def wrap_remote(checker: Checker, addr: str, *,

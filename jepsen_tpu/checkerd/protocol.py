@@ -24,6 +24,15 @@ A submit conversation, client -> server:
                                              "checkerd": {...meta}}
                                    | ERROR {"error"}
     STATS {}                      <- STATS_REPLY {...fleet stats...}
+    RESUME {"session"}            <- RESUME_OK {"received": {i: count},
+                                                "n-keys"}
+                                   | ERROR {"error"}
+
+A streamed SUBMIT may carry a client-minted "session" token; the
+server then parks the half-uploaded submission when the connection
+dies, and a RESUME on a fresh connection re-attaches to it, replying
+with the per-key op counts it already holds (the stable bound) so the
+client re-sends only the tail.
 
 The optional SUBMIT "trace" field is the submitting run's telemetry
 trace context (telemetry.trace_context()).  The daemon stamps the
@@ -61,6 +70,13 @@ F_RESULT = 23
 F_STATS = 24
 F_STATS_REPLY = 25
 F_ERROR = 26
+#: Streaming reconnect (streaming/remote.py): a client whose upload
+#: connection died re-attaches to its parked server-side submission and
+#: learns the daemon's stable bound — per-key received-op counts — so
+#: it re-sends only the tail past the last FULL stable block instead of
+#: re-uploading or falling back to a whole-history recheck.
+F_RESUME = 27       # {"session": token}
+F_RESUME_OK = 28    # {"received": {key-index: op-count}, "n-keys": n}
 
 #: Frame types whose payload is raw bytes, not JSON.
 BINARY_TYPES = frozenset({F_PACKED})

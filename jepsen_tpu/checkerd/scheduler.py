@@ -86,6 +86,14 @@ class Request:
         self.submitted_t = 0.0
         self.started_t = 0.0
         self.done_t = 0.0
+        #: Disconnect-abandonment bookkeeping (non-streaming tickets
+        #: only): the submitting connection's id, whether any OTHER
+        #: connection has polled this ticket (then the submitter dying
+        #: must not cancel it), and whether it is condemned — condemned
+        #: tickets drop out of the queue at the next cohort boundary.
+        self.owner_conn: Optional[int] = None
+        self.adopted = False
+        self.abandoned = False
 
 
 class Scheduler:
@@ -97,6 +105,7 @@ class Scheduler:
         bound: Optional[int] = None,
         profile_dir: Optional[str] = None,
         plan_cache_dir: Optional[str] = None,
+        queue_path: Optional[str] = None,
     ):
         self.batch_window_s = batch_window_s
         self.max_budget_s = max_budget_s
@@ -135,19 +144,79 @@ class Scheduler:
         self._lat_max = 0.0
         self._lat_last = 0.0
         self._runs: dict[str, dict[str, Any]] = {}
+        self.n_abandoned = 0
+        self.n_replayed = 0
+        #: Durable queue: every accepted submission journals before its
+        #: TICKET leaves, every verdict journals before the request is
+        #: marked done, and a restarted daemon re-queues what's left
+        #: (checkerd/journal.py).  None = the old in-memory-only queue.
+        self.journal = None
+        if queue_path:
+            from .journal import QueueJournal
+
+            self.journal = QueueJournal(queue_path)
+            self._replay_journal()
         self._thread = threading.Thread(
             target=self._loop, name="checkerd-worker", daemon=True
         )
         self._thread.start()
 
+    def _replay_journal(self) -> None:
+        """Restores journal state before the worker starts: finished
+        tickets re-answer late polls with their journaled bytes
+        (replay idempotence); unfinished ones re-queue under their
+        ORIGINAL ticket ids and re-form cohorts through the normal
+        worker path — the plan compiler and the plan/XLA caches make
+        the re-check a warm start."""
+        import logging
+
+        from .journal import request_from_record
+
+        log = logging.getLogger(__name__)
+        now = time.monotonic()
+        for ticket, res in self.journal.finished().items():
+            req = Request(run="replayed", model_spec={})
+            req.ticket = ticket
+            req.state = "done"
+            req.result = res
+            req.n_keys = len(res.get("key-results") or [])
+            req.submitted_t = req.done_t = now
+            with self._cond:
+                self._tickets[ticket] = req
+        for ticket, rec in self.journal.unfinished().items():
+            try:
+                req = request_from_record(rec)
+            except Exception as e:  # noqa: BLE001 — one corrupt record
+                # must not stop the rest of the replay.
+                telemetry.count("checkerd.queue.replay-failed")
+                log.warning("queue replay: ticket %s unrecoverable: %r",
+                            ticket, e)
+                continue
+            req.ticket = ticket
+            req.submitted_t = now
+            req.state = "queued"
+            with self._cond:
+                self._tickets[ticket] = req
+                self._queue.append(req)
+                self.n_requests += 1
+                self.n_keys_total += req.n_keys
+                self._run_entry_locked(req.run)["submitted"] += 1
+                self.n_replayed += 1
+            telemetry.count("checkerd.queue.replayed")
+        if self.n_replayed or self._tickets:
+            log.info("queue replay: %d unfinished re-queued, %d finished "
+                     "results restored", self.n_replayed,
+                     len(self._tickets) - self.n_replayed)
+
     # -- admission ----------------------------------------------------------
 
-    def submit(self, req: Request) -> str:
+    def submit(self, req: Request, *, owner_conn: Optional[int] = None) -> str:
         now = time.monotonic()
         with self._cond:
             req.ticket = uuid.uuid4().hex[:12]
             req.submitted_t = now
             req.state = "queued"
+            req.owner_conn = owner_conn
             self._sweep_locked(now)
             self._tickets[req.ticket] = req
             self._queue.append(req)
@@ -156,18 +225,32 @@ class Scheduler:
             r = self._run_entry_locked(req.run)
             r["submitted"] += 1
             self._cond.notify_all()
+        if self.journal is not None:
+            # Durability before acknowledgement: the TICKET reply only
+            # leaves after this returns, so every pollable ticket is a
+            # replayable ticket.  (Journaled outside _cond — an fsync
+            # must not stall pollers.)
+            from .journal import request_to_record
+
+            self.journal.record_submit(req.ticket, request_to_record(req))
         if telemetry.enabled():
             telemetry.count("checkerd.requests")
             telemetry.count("checkerd.keys", req.n_keys)
         return req.ticket
 
-    def poll(self, ticket: str) -> dict:
+    def poll(self, ticket: str, conn_id: Optional[int] = None) -> dict:
         """A POLL reply payload: PENDING-shaped while queued/running,
         the RESULT payload once done, or an error marker."""
         with self._cond:
             req = self._tickets.get(ticket)
             if req is None:
                 return {"_error": f"unknown ticket {ticket!r}"}
+            if (conn_id is not None and req.owner_conn is not None
+                    and conn_id != req.owner_conn):
+                # Someone other than the submitting connection wants
+                # this verdict: the submitter dying no longer abandons
+                # the ticket.
+                req.adopted = True
             if req.state == "done" and req.result is not None:
                 return dict(req.result)
             return {
@@ -175,6 +258,27 @@ class Scheduler:
                 "state": req.state,
                 "queue-depth": len(self._queue),
             }
+
+    def abandon(self, ticket: str, conn_id: Optional[int] = None) -> bool:
+        """Cancels a still-queued ticket whose submitting connection
+        died mid-PENDING, so its keys drop out at the next cohort
+        boundary instead of riding the merged cohort forever.  Running
+        or done tickets are left alone (their work is already spent or
+        delivered), and so are adopted tickets — some other connection
+        is waiting on them."""
+        with self._cond:
+            req = self._tickets.get(ticket)
+            if req is None or req.state != "queued" or req.abandoned:
+                return False
+            if conn_id is not None and (req.owner_conn != conn_id
+                                        or req.adopted):
+                return False
+            req.abandoned = True
+            self.n_abandoned += 1
+        telemetry.count("checkerd.ticket-abandoned")
+        if self.journal is not None:
+            self.journal.record_abandon(ticket)
+        return True
 
     def model_for(self, spec: dict) -> Any:
         """The daemon-wide model instance for a spec (building it on
@@ -199,6 +303,8 @@ class Scheduler:
             self._stop = True
             self._cond.notify_all()
         self._thread.join(timeout=5.0)
+        if self.journal is not None:
+            self.journal.close()
 
     # -- bookkeeping --------------------------------------------------------
 
@@ -268,8 +374,13 @@ class Scheduler:
                     "last-s": round(self._lat_last, 4),
                 },
                 "models-cached": len(self._models),
+                "abandoned": self.n_abandoned,
+                "replayed": self.n_replayed,
                 "runs": runs,
             }
+        out["queue-journal"] = (
+            self.journal.stats() if self.journal is not None else None
+        )
         out["devices"] = _device_info()
         # Observability surface: the degrade ladder's last chip probe
         # verdict and the fleet-wide profile-store aggregate (the
@@ -309,6 +420,29 @@ class Scheduler:
                 # worker's pop.
                 time.sleep(self.batch_window_s)
             with self._cond:
+                # The cohort boundary is where abandoned tickets leave:
+                # their keys never join the merged subs map, so a dead
+                # client can't burn cohort budget.
+                condemned = [r for r in self._queue if r.abandoned]
+                if condemned:
+                    self._queue = [r for r in self._queue
+                                   if not r.abandoned]
+                    now = time.monotonic()
+                    for r in condemned:
+                        r.state = "done"
+                        r.done_t = now
+                        r.result = {
+                            "valid": "unknown",
+                            "error": "checkerd: ticket abandoned "
+                                     "(submitting connection died "
+                                     "before the cohort formed)",
+                            "key-results": [{
+                                "valid": "unknown",
+                                "error": "checkerd: ticket abandoned",
+                            }] * r.n_keys,
+                            "checkerd": {"ticket": r.ticket,
+                                         "abandoned": True},
+                        }
                 if not self._queue:
                     continue
                 head = self._queue[0]
@@ -336,6 +470,14 @@ class Scheduler:
                             "checkerd": {"error": err["error"]},
                         }
             dt = time.monotonic() - t_run
+            if self.journal is not None:
+                # The replay-idempotence rule: a verdict is durable
+                # BEFORE any poll can observe state "done", so a crash
+                # between here and the mark-done below re-serves the
+                # same bytes instead of re-checking.
+                for r in group:
+                    if r.result is not None:
+                        self.journal.record_result(r.ticket, r.result)
             with self._cond:
                 self._busy_s += dt
                 self.n_cohorts += 1

@@ -25,6 +25,8 @@ from .protocol import (
     F_PENDING,
     F_POLL,
     F_RESULT,
+    F_RESUME,
+    F_RESUME_OK,
     F_STATS,
     F_STATS_REPLY,
     F_SUBMIT,
@@ -37,6 +39,11 @@ from .protocol import (
 from .scheduler import Request, Scheduler
 
 log = logging.getLogger(__name__)
+
+#: Parked streaming sessions the daemon keeps for reconnecting
+#: clients; oldest-first eviction past this (a leaked session must not
+#: pin its half-uploaded history forever).
+MAX_PARKED_SESSIONS = 64
 
 
 class _Submission:
@@ -51,8 +58,16 @@ class _Submission:
         #: DEFERRED key count: chunks grow it as keys first appear and
         #: COMMIT's payload finalizes it.
         self.streaming = bool(meta.get("streaming"))
+        #: Client-minted resume token: the submission is parked when
+        #: its connection dies and a RESUME re-attaches to it.
+        self.session = meta.get("session") if self.streaming else None
         self.ops: dict[int, list] = {}
         self.packs: dict[int, Any] = {}
+
+    def received(self) -> dict[str, int]:
+        """Per-key op counts already held — the stable bound a resuming
+        client continues from."""
+        return {str(i): len(ops) for i, ops in self.ops.items()}
 
     def _check_key(self, i: Any) -> int:
         i = int(i)
@@ -128,6 +143,27 @@ class _Handler(socketserver.StreamRequestHandler):
     def handle(self) -> None:
         sched: Scheduler = self.server.scheduler  # type: ignore[attr-defined]
         sub: Optional[_Submission] = None
+        conn_id = id(self)
+        owned: list[str] = []
+        try:
+            self._converse(sched, sub, conn_id, owned)
+        finally:
+            # Disconnect mid-PENDING: a ticket whose submitting
+            # connection died with nobody else polling it would keep
+            # its keys in the merged cohort forever — cancel it instead
+            # (dropped at the next cohort boundary, counted as
+            # checkerd.ticket-abandoned).  Streamed tickets are exempt:
+            # their poller arrives later on a fresh connection.
+            for t in owned:
+                sched.abandon(t, conn_id)
+
+    def _converse(
+        self,
+        sched: Scheduler,
+        sub: Optional[_Submission],
+        conn_id: int,
+        owned: list[str],
+    ) -> None:
         while True:
             try:
                 fr = read_frame(self.rfile)
@@ -140,22 +176,53 @@ class _Handler(socketserver.StreamRequestHandler):
             try:
                 if ftype == F_SUBMIT:
                     sub = _Submission(payload)
+                    if sub.session:
+                        # Streamed with a resume token: survive this
+                        # connection's death so a RESUME re-attaches.
+                        self._park(sub)
                 elif ftype == F_CHUNK:
                     self._need(sub, "CHUNK").add_chunk(payload)
                 elif ftype == F_PACKED:
                     self._need(sub, "PACKED").add_packed(payload)
+                elif ftype == F_RESUME:
+                    token = (payload.get("session")
+                             if isinstance(payload, dict) else None)
+                    parked = self._parked(token)
+                    if parked is None:
+                        self._reply(F_ERROR, {
+                            "error": f"unknown session {token!r} "
+                            "(daemon restarted or session evicted)",
+                        })
+                    else:
+                        sub = parked
+                        self._reply(F_RESUME_OK, {
+                            "received": sub.received(),
+                            "n-keys": sub.n_keys,
+                        })
                 elif ftype == F_COMMIT:
                     s = self._need(sub, "COMMIT")
                     s.finalize_keys(payload)
                     req = s.build(sched)
                     sub = None
-                    ticket = sched.submit(req)
+                    if s.session:
+                        self._unpark(s)
+                    # Detached submissions (the federation router, which
+                    # submits on a short-lived connection and polls on
+                    # fresh ones) opt out of abandon-on-disconnect, as
+                    # do streamed ones (their poller arrives later).
+                    detached = s.streaming or bool(s.meta.get("detached"))
+                    ticket = sched.submit(
+                        req,
+                        owner_conn=None if detached else conn_id,
+                    )
+                    if not detached:
+                        owned.append(ticket)
                     self._reply(F_TICKET, {
                         "ticket": ticket,
                         "queue-depth": sched.queue_depth(),
                     })
                 elif ftype == F_POLL:
-                    r = sched.poll(str(payload.get("ticket")))
+                    r = sched.poll(str(payload.get("ticket")), conn_id)
                     if "_error" in r:
                         self._reply(F_ERROR, {"error": r["_error"]})
                     elif r.pop("_pending", None):
@@ -183,6 +250,24 @@ class _Handler(socketserver.StreamRequestHandler):
             raise ProtocolError(f"{what} before SUBMIT")
         return sub
 
+    def _park(self, sub: _Submission) -> None:
+        srv = self.server
+        with srv.sessions_lock:  # type: ignore[attr-defined]
+            srv.sessions[sub.session] = sub  # type: ignore[attr-defined]
+            while len(srv.sessions) > MAX_PARKED_SESSIONS:  # type: ignore[attr-defined]
+                victim = next(iter(srv.sessions))  # type: ignore[attr-defined]
+                del srv.sessions[victim]  # type: ignore[attr-defined]
+
+    def _parked(self, token: Any) -> Optional[_Submission]:
+        srv = self.server
+        with srv.sessions_lock:  # type: ignore[attr-defined]
+            return srv.sessions.get(token)  # type: ignore[attr-defined]
+
+    def _unpark(self, sub: _Submission) -> None:
+        srv = self.server
+        with srv.sessions_lock:  # type: ignore[attr-defined]
+            srv.sessions.pop(sub.session, None)  # type: ignore[attr-defined]
+
     def _reply(self, ftype: int, payload: Any) -> None:
         try:
             write_frame(self.wfile, ftype, payload)
@@ -195,6 +280,9 @@ class CheckerdServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
     scheduler: Scheduler
+    #: Parked streaming submissions by resume token (F_RESUME).
+    sessions: dict
+    sessions_lock: threading.Lock
 
 
 def make_server(
@@ -206,14 +294,18 @@ def make_server(
     bound: Optional[int] = None,
     profile_dir: Optional[str] = None,
     plan_cache_dir: Optional[str] = None,
+    queue_path: Optional[str] = None,
 ) -> CheckerdServer:
     srv = CheckerdServer((host, port), _Handler)
+    srv.sessions = {}
+    srv.sessions_lock = threading.Lock()
     srv.scheduler = Scheduler(
         batch_window_s=batch_window_s,
         max_budget_s=max_budget_s,
         bound=bound,
         profile_dir=profile_dir,
         plan_cache_dir=plan_cache_dir,
+        queue_path=queue_path,
     )
     return srv
 
@@ -286,6 +378,7 @@ def serve(
     metrics_port: Optional[int] = None,
     profile_dir: Optional[str] = None,
     plan_cache_dir: Optional[str] = None,
+    queue_path: Optional[str] = None,
 ) -> None:
     """Blocking entrypoint for `jepsen checkerd`."""
     srv = make_server(
@@ -293,6 +386,7 @@ def serve(
         batch_window_s=batch_window_s, max_budget_s=max_budget_s,
         profile_dir=profile_dir,
         plan_cache_dir=plan_cache_dir,
+        queue_path=queue_path,
     )
     bound_port = srv.server_address[1]
     msrv = None
@@ -360,6 +454,13 @@ def main(argv: Optional[list[str]] = None) -> int:
         "cache: a restarted daemon re-checking byte-identical "
         "histories warm-starts from it (jepsen_tpu/plan/cache.py)",
     )
+    p.add_argument(
+        "--queue", default=None, metavar="PATH",
+        help="crash-safe queue journal file (checkerd.queue): every "
+        "accepted submission and verdict is journaled + fsynced, and "
+        "a restarted daemon replays unfinished tickets under their "
+        "original ids — zero in-flight verdicts lost",
+    )
     opts = p.parse_args(argv)
     logging.basicConfig(
         level=logging.INFO,
@@ -376,5 +477,6 @@ def main(argv: Optional[list[str]] = None) -> int:
         metrics_port=None if opts.metrics_port < 0 else opts.metrics_port,
         profile_dir=opts.profile_dir,
         plan_cache_dir=opts.plan_cache,
+        queue_path=opts.queue,
     )
     return 0

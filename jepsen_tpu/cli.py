@@ -297,7 +297,53 @@ def single_test_cmd(
         "--platform", default=None, choices=["cpu", "tpu"],
         help="pin the JAX backend for the daemon's devices",
     )
+    cd.add_argument(
+        "--queue", default=None, metavar="PATH",
+        help="crash-safe queue journal (checkerd.queue): a restarted "
+        "daemon replays unfinished tickets under their original ids",
+    )
+    cd.add_argument(
+        "--metrics-port", type=int, default=None, metavar="P",
+        help="HTTP port for the Prometheus /metrics scrape surface",
+    )
     cd.set_defaults(_run=_run_checkerd)
+
+    from .checkerd import ROUTER_PORT as _ROUTER_PORT
+
+    rt = sub.add_parser(
+        "checkerd-router",
+        help="run the federation router: one --remote address fronting "
+        "N checkerd daemons with failover + admission control",
+    )
+    rt.add_argument("--port", "-p", type=int, default=_ROUTER_PORT)
+    rt.add_argument("--host", "-b", default="0.0.0.0")
+    rt.add_argument(
+        "--daemon", "-d", action="append", default=[], metavar="ADDR",
+        help="a daemon address (host:port); repeatable",
+    )
+    rt.add_argument(
+        "--tenant-quota", type=int, default=None, metavar="N",
+        help="max in-flight tickets per run name (over it: a "
+        "deterministic checkerd.admission-rejected error)",
+    )
+    rt.add_argument(
+        "--max-inflight", type=int, default=None, metavar="N",
+        help="max in-flight tickets fleet-wide (bounded queue depth)",
+    )
+    rt.add_argument(
+        "--probe-interval", type=float, default=2.0, metavar="S",
+        help="health-probe cadence for suspect/quarantined daemons",
+    )
+    rt.add_argument(
+        "--metrics-port", type=int, default=None, metavar="P",
+        help="HTTP port for the router's Prometheus /metrics surface",
+    )
+    rt.add_argument(
+        "--queue", default=None, metavar="PATH",
+        help="crash-safe ticket journal: a restarted router keeps "
+        "answering polls for every journaled ticket",
+    )
+    rt.set_defaults(_run=_run_checkerd_router)
 
     ln = sub.add_parser(
         "lint",
@@ -529,6 +575,28 @@ def _run_checkerd(opts) -> int:
         opts.host, opts.port,
         batch_window_s=opts.batch_window,
         max_budget_s=opts.max_budget,
+        metrics_port=opts.metrics_port,
+        queue_path=opts.queue,
+    )
+    return EXIT_VALID
+
+
+def _run_checkerd_router(opts) -> int:
+    """`jepsen checkerd-router`: the federation front-end.  Blocks
+    until interrupted."""
+    from .checkerd.router import serve as serve_router
+
+    if not opts.daemon:
+        print("checkerd-router: at least one --daemon ADDR is required")
+        return EXIT_UNKNOWN
+    serve_router(
+        opts.host, opts.port,
+        daemons=opts.daemon,
+        tenant_quota=opts.tenant_quota,
+        max_inflight=opts.max_inflight,
+        probe_interval_s=opts.probe_interval,
+        metrics_port=opts.metrics_port,
+        queue_path=opts.queue,
     )
     return EXIT_VALID
 

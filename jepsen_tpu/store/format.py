@@ -52,6 +52,11 @@ BLOCK_LEDGER = 5
 #: verdict per block, keyed by packed digest + plan knobs, so restarted
 #: checker processes warm-start past already-decided work.
 BLOCK_PLAN = 6
+#: Checkerd queue-journal record (checkerd/journal.py): one accepted
+#: submission, result, or abandonment per block, appended + fsynced
+#: before the daemon acknowledges, so a restarted daemon (or router)
+#: replays every in-flight ticket instead of dropping it.
+BLOCK_QUEUE = 7
 
 #: Ops per sealed history chunk (format.clj:372-375).
 CHUNK_SIZE = 16384

@@ -12,6 +12,17 @@ iff the submission it WOULD have made matches what was streamed (same
 address, keys, model spec, algorithm, budget); any mismatch or feed
 death just means the ordinary post-hoc submission happens — streaming
 the upload can cost bandwidth, never the verdict.
+
+Reconnect: the SUBMIT carries a client-minted session token and the
+feed retains every op dict it has handed to the socket.  When the
+connection dies mid-run, a RESUME on a fresh connection re-attaches to
+the daemon's parked submission and learns its stable bound — the
+per-key op counts that made it into FULL frames server-side — and the
+feed re-sends only each key's tail past that bound
+(`wgl.online.remote-resumed`), instead of abandoning the upload and
+falling back to a whole-history post-hoc submit.  No encoder interner
+state crosses the wire for this: ops mode re-encodes deterministically
+daemon-side, so the received-op counts ARE the snapshot.
 """
 
 from __future__ import annotations
@@ -76,6 +87,8 @@ class RemoteFeed:
     def __init__(self, addr: str, *, run: str, model_spec: dict,
                  algorithm: str, budget_s: Optional[float],
                  time_limit_s: Optional[float]):
+        import uuid
+
         self.addr = addr
         self.run = run
         self.model_spec = model_spec
@@ -86,6 +99,15 @@ class RemoteFeed:
         self.dead: Optional[str] = None
         self.ticket: Optional[str] = None
         self.ops_sent = 0
+        self.resumes = 0
+        self.ops_resent = 0
+        #: Resume token minted per feed; the daemon parks the
+        #: submission under it when our connection dies.
+        self.session = uuid.uuid4().hex
+        #: Everything handed to the socket, per key index — the local
+        #: half of the resume protocol.  The dicts are the same objects
+        #: the queue held, so the cost is one list slot per op.
+        self._sent_ops: dict[int, list[dict]] = {}
 
         self._client = None
         self._keys: list = []            # first-seen order == key index
@@ -103,13 +125,13 @@ class RemoteFeed:
 
     def put(self, key: Any, op: Op) -> None:
         """Enqueues one routed per-key op for upload."""
-        if self.dead:
-            return
         i = self._index.get(key)
         if i is None:
             i = self._index[key] = len(self._keys)
             self._keys.append(key)
         with self._lock:
+            if self.dead:
+                return
             self._queue.append((i, op.to_dict()))
             if len(self._queue) >= FLUSH_OPS:
                 self._wake.set()
@@ -121,27 +143,45 @@ class RemoteFeed:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=60.0)
-        if self.dead:
-            return
+        with self._lock:
+            if self.dead:
+                return
         if keys != self._keys:
             self._die("key order diverged from the session's")
             return
         try:
-            self._flush()
-            if self._client is None:
-                self._die("nothing was streamed")
-                return
-            from ..checkerd.protocol import F_COMMIT, F_TICKET
-            self._client._send(F_COMMIT, {"n-keys": len(self._keys)})
-            ftype, payload = self._client._recv()
-            if ftype != F_TICKET:
-                raise RuntimeError(f"expected TICKET, got {ftype}")
-            self.ticket = payload["ticket"]
-            telemetry.count("wgl.online.remote-committed")
-            log.info("streamed %d ops / %d keys to %s (ticket %s)",
-                     self.ops_sent, len(self._keys), self.addr, self.ticket)
+            self.ticket = self._commit_once()
         except Exception as e:  # noqa: BLE001
-            self._die(f"{type(e).__name__}: {e}")
+            # One reconnect attempt before giving up: COMMIT rides the
+            # resumed connection, which already holds the full upload.
+            if self._sent_ops and self._resume(f"{type(e).__name__}: {e}"):
+                try:
+                    self.ticket = self._commit_once()
+                except Exception as e2:  # noqa: BLE001
+                    self._die(f"{type(e2).__name__}: {e2}")
+                    return
+            else:
+                self._die(f"{type(e).__name__}: {e}")
+                return
+        telemetry.count("wgl.online.remote-committed")
+        with self._lock:
+            sent = self.ops_sent
+        log.info("streamed %d ops / %d keys to %s (ticket %s)",
+                 sent, len(self._keys), self.addr, self.ticket)
+
+    def _commit_once(self) -> str:
+        from ..checkerd.protocol import F_COMMIT, F_TICKET
+
+        self._flush()
+        with self._lock:
+            c = self._client
+        if c is None:
+            raise RuntimeError("nothing was streamed")
+        c._send(F_COMMIT, {"n-keys": len(self._keys)})
+        ftype, payload = c._recv()
+        if ftype != F_TICKET:
+            raise RuntimeError(f"expected TICKET, got {ftype}")
+        return payload["ticket"]
 
     def ticket_for(self, addr: str, keys: list, model_spec: dict,
                    algorithm: str, budget_s: Any,
@@ -157,30 +197,35 @@ class RemoteFeed:
         return self.ticket
 
     def stats(self) -> dict:
-        out: dict = {"addr": self.addr, "ops-sent": self.ops_sent,
-                     "keys": len(self._keys)}
-        if self.ticket is not None:
-            out["ticket"] = self.ticket
-        if self.dead:
-            out["dead"] = self.dead
+        with self._lock:
+            out: dict = {"addr": self.addr, "ops-sent": self.ops_sent,
+                         "keys": len(self._keys)}
+            if self.ticket is not None:
+                out["ticket"] = self.ticket
+            if self.resumes:
+                out["resumes"] = self.resumes
+                out["ops-resent"] = self.ops_resent
+            if self.dead:
+                out["dead"] = self.dead
         return out
 
     # -- uploader thread -----------------------------------------------------
 
     def _die(self, reason: str) -> None:
-        self.dead = reason
+        with self._lock:
+            self.dead = reason
+            self._queue = []
+            c, self._client = self._client, None
         telemetry.count("wgl.online.remote-dead")
         log.info("streaming upload abandoned (post-hoc submit will "
                  "cover it): %s", reason)
-        with self._lock:
-            self._queue = []
-        if self._client is not None:
-            self._client.close()
-            self._client = None
+        if c is not None:
+            c.close()
 
     def _ensure_client(self) -> None:
-        if self._client is not None:
-            return
+        with self._lock:
+            if self._client is not None:
+                return
         from ..checkerd.client import CheckerdClient
         from ..checkerd.protocol import F_SUBMIT
 
@@ -192,6 +237,7 @@ class RemoteFeed:
             "n-keys": 0,
             "packed": False,
             "streaming": True,
+            "session": self.session,
             "budget-s": self.budget_s,
             "time-limit-s": self.time_limit_s,
             # The run's trace context rides the streamed submission
@@ -201,7 +247,8 @@ class RemoteFeed:
             if telemetry.enabled() else None,
         })
         c.wf.flush()
-        self._client = c
+        with self._lock:
+            self._client = c
 
     def _flush(self) -> None:
         from ..checkerd.protocol import F_CHUNK
@@ -210,7 +257,14 @@ class RemoteFeed:
             batch, self._queue = self._queue, []
         if not batch:
             return
+        # Record intent before the socket sees anything: whatever the
+        # send loses, the daemon's RESUME_OK counts tell us where in
+        # these lists to restart from.
+        for i, od in batch:
+            self._sent_ops.setdefault(i, []).append(od)
         self._ensure_client()
+        with self._lock:
+            c = self._client
         # Coalesce runs of same-key ops into one CHUNK frame each.
         i0, ops = batch[0][0], []
         runs = []
@@ -221,19 +275,72 @@ class RemoteFeed:
             ops.append(od)
         runs.append((i0, ops))
         for i, ops in runs:
-            self._client._send(F_CHUNK, {"key": i, "ops": ops})
-        self._client.wf.flush()
-        self.ops_sent += len(batch)
+            c._send(F_CHUNK, {"key": i, "ops": ops})
+        c.wf.flush()
+        with self._lock:
+            self.ops_sent += len(batch)
         telemetry.count("wgl.online.remote-ops", len(batch))
+
+    def _resume(self, why: str) -> bool:
+        """Reconnects, re-attaches to the parked daemon-side submission
+        via the session token, and re-sends each key's tail past the
+        daemon's stable bound.  False means the fallback path (post-hoc
+        submit) takes over."""
+        from ..checkerd.client import CHUNK_OPS, CheckerdClient
+        from ..checkerd.protocol import F_CHUNK, F_RESUME, F_RESUME_OK
+
+        telemetry.count("wgl.online.remote-resume")
+        log.info("streamed upload to %s interrupted (%s); resuming "
+                 "session %s", self.addr, why, self.session[:8])
+        with self._lock:
+            c_old, self._client = self._client, None
+        if c_old is not None:
+            c_old.close()
+        c = None
+        try:
+            c = CheckerdClient(self.addr)
+            c._send(F_RESUME, {"session": self.session})
+            ftype, payload = c._recv()
+            if ftype != F_RESUME_OK:
+                raise RuntimeError(f"expected RESUME_OK, got {ftype}")
+            received = payload.get("received") or {}
+            resent = 0
+            for i, ops in sorted(self._sent_ops.items()):
+                have = int(received.get(str(i)) or 0)
+                for lo in range(have, len(ops), CHUNK_OPS):
+                    c._send(F_CHUNK, {
+                        "key": i, "ops": ops[lo:lo + CHUNK_OPS],
+                    })
+                    resent += len(ops[lo:lo + CHUNK_OPS])
+            c.wf.flush()
+        except Exception as e:  # noqa: BLE001
+            if c is not None:
+                c.close()
+            log.info("resume of session %s failed (%s); abandoning the "
+                     "stream", self.session[:8], e)
+            return False
+        with self._lock:
+            self._client = c
+            self.resumes += 1
+            self.ops_resent += resent
+        telemetry.count("wgl.online.remote-resumed")
+        log.info("resumed session %s: re-sent %d of %d ops",
+                 self.session[:8], resent,
+                 sum(len(o) for o in self._sent_ops.values()))
+        return True
 
     def _loop(self) -> None:
         while not self._stop.is_set():
             self._wake.wait(FLUSH_INTERVAL_S)
             self._wake.clear()
-            if self.dead:
-                return
+            with self._lock:
+                if self.dead:
+                    return
             try:
                 self._flush()
             except Exception as e:  # noqa: BLE001
+                if self._sent_ops and \
+                        self._resume(f"{type(e).__name__}: {e}"):
+                    continue
                 self._die(f"{type(e).__name__}: {e}")
                 return

@@ -124,6 +124,7 @@ FLEET_COUNTER_PREFIXES = (
     "wgl.online.",
     "wgl.plan.",
     "checkerd.",
+    "router.",
 )
 
 

@@ -251,6 +251,15 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 f"</p>" + _slo_panel() + lint_tbl + hint,
             ))
             return
+        if stats.get("router"):
+            # The address is a federation router (checkerd/router.py):
+            # render the fleet-wide panel instead of single-daemon stats.
+            self._send(200, _page(
+                "checker federation",
+                self._federation_panel(addr, stats)
+                + _slo_panel() + lint_tbl + hint,
+            ))
+            return
         devs = stats.get("devices") or {}
         lat = stats.get("verdict-latency") or {}
         overview = [
@@ -323,6 +332,63 @@ class Handler(http.server.BaseHTTPRequestHandler):
             f"<table>{orows}</table>" + runs_tbl + plan_tbl
             + _slo_panel() + lint_tbl + hint,
         ))
+
+    def _federation_panel(self, addr: str, stats: dict) -> str:
+        """The /fleet body for a federation router: router overview
+        (placement, failover, admission counters) plus one row per
+        daemon with its health state, queue depth and cache warmth."""
+        quota = stats.get("quota") or {}
+        qj = stats.get("queue-journal") or {}
+        overview = [
+            ("router", addr),
+            ("uptime s", stats.get("uptime-s")),
+            ("daemons", len(stats.get("daemons") or {})),
+            ("fleet queue depth", stats.get("queue-depth")),
+            ("tickets in flight", stats.get("inflight")),
+            ("submits placed", stats.get("submits")),
+            ("results relayed", stats.get("results")),
+            ("failovers", stats.get("failovers")),
+            ("admission rejected", stats.get("admission-rejected")),
+            ("replayed from journal", stats.get("replayed")),
+            ("tenant quota", quota.get("tenant-quota") or "unlimited"),
+            ("max in-flight", quota.get("max-inflight") or "unlimited"),
+            ("ticket journal", qj.get("path") or "(not configured)"),
+        ]
+        orows = "".join(
+            f"<tr><td>{html.escape(str(k))}</td>"
+            f"<td>{html.escape(str(v))}</td></tr>"
+            for k, v in overview
+        )
+        health = stats.get("health") or {}
+        # Model-cache affinity inverted: daemon -> spec count (which
+        # caches placement considers warm there).
+        warm: dict = {}
+        for _spec, d in (stats.get("affinity") or {}).items():
+            warm[d] = warm.get(d, 0) + 1
+        drows = ""
+        for d, st in sorted((stats.get("daemons") or {}).items()):
+            h = health.get(str(d)) or {}
+            if not isinstance(st, dict) or st.get("unreachable"):
+                drows += (
+                    f"<tr><td>{html.escape(str(d))}</td>"
+                    f"<td>{html.escape(str(h.get('state') or '?'))}</td>"
+                    f"<td colspan=4>unreachable</td></tr>"
+                )
+                continue
+            drows += (
+                f"<tr><td>{html.escape(str(d))}</td>"
+                f"<td>{html.escape(str(h.get('state') or 'healthy'))}</td>"
+                f"<td>{html.escape(str(st.get('queue-depth')))}</td>"
+                f"<td>{html.escape(str(st.get('requests')))}</td>"
+                f"<td>{html.escape(str(st.get('models-cached')))}</td>"
+                f"<td>{html.escape(str(warm.get(str(d), 0)))}</td></tr>"
+            )
+        daemons_tbl = (
+            "<h2>daemons</h2><table><tr><th>daemon</th><th>health</th>"
+            "<th>queue depth</th><th>requests</th><th>models cached</th>"
+            "<th>affinity specs</th></tr>" + drows + "</table>"
+        )
+        return f"<table>{orows}</table>" + daemons_tbl
 
     def _metrics(self) -> None:
         """Prometheus text scrape surface: this process's telemetry

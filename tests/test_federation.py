@@ -1,0 +1,459 @@
+"""Durable checkerd federation: the failure lattice.
+
+Every rung kills something mid-flight and asserts the verdict path
+degrades the way the design says it must — replayed, failed over, or
+honestly unknown, never silently wrong or lost:
+
+  * torn journal tail truncated cleanly, accepted records survive;
+  * kill the scheduler mid-cohort -> restart on the same journal ->
+    the ORIGINAL ticket replays to the uninterrupted verdict;
+  * submitting connection dies mid-PENDING -> ticket abandoned,
+    honest-unknown results, counted;
+  * streaming upload connection severed mid-run -> RESUME re-sends
+    only the tail past the daemon's stable bound;
+  * router failover mid-run keeps per-key parity;
+  * admission rejection is deterministic and surfaces as an honest
+    unknown at a fallback-less client;
+  * a restarted router re-serves journaled results for old tickets.
+"""
+
+import os
+import socket
+import threading
+import time
+
+import pytest
+
+from conftest import free_port  # noqa: F401 — fixture-style helper
+
+from jepsen_tpu.checker.linearizable import Linearizable
+from jepsen_tpu.checkerd.client import (
+    CheckerdClient,
+    RemoteChecker,
+    fetch_stats,
+)
+from jepsen_tpu.checkerd.journal import (
+    QueueJournal,
+    request_from_record,
+    request_to_record,
+)
+from jepsen_tpu.checkerd.protocol import model_to_spec
+from jepsen_tpu.checkerd.router import Router, make_router_server
+from jepsen_tpu.checkerd.scheduler import Request, Scheduler
+from jepsen_tpu.checkerd.server import make_server
+from jepsen_tpu.history.core import History
+from jepsen_tpu.models.registers import Register
+from jepsen_tpu.parallel.independent import (
+    KV,
+    IndependentChecker,
+    subhistories,
+)
+
+
+# ---------------------------------------------------------------------
+# History builders (the mixed-validity register shape the checkerd
+# tests use: per-key parity checks must bite on BOTH verdicts).
+
+
+def _reg_ops(key, pairs, start_index=0, process=0):
+    ops = []
+    i = start_index
+    for wrote, read in pairs:
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": "write", "value": KV(key, wrote), "time": i})
+        i += 1
+        ops.append({"index": i, "type": "ok", "process": process,
+                    "f": "write", "value": KV(key, wrote), "time": i})
+        i += 1
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": "read", "value": KV(key, None), "time": i})
+        i += 1
+        ops.append({"index": i, "type": "ok", "process": process,
+                    "f": "read", "value": KV(key, read), "time": i})
+        i += 1
+    return ops
+
+
+def _mixed_history(prefix="k"):
+    ops = _reg_ops(f"{prefix}-good", [(1, 1), (2, 2)])
+    ops += _reg_ops(f"{prefix}-bad", [(1, 7)], start_index=len(ops),
+                    process=1)
+    return History(ops)
+
+
+def _in_process():
+    return IndependentChecker(Linearizable(Register()))
+
+
+def _spec():
+    return model_to_spec(Register())
+
+
+def _request(run="r", h=None):
+    h = h if h is not None else _mixed_history()
+    subs = subhistories(h)
+    return list(subs), Request(
+        run=run,
+        model_spec=_spec(),
+        n_keys=len(subs),
+        subs={i: History([o.to_dict() for o in subs[k]], reindex=False)
+              for i, k in enumerate(subs)},
+    )
+
+
+def _serve(srv):
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    return t
+
+
+def _stop_daemon(srv, t=None):
+    srv.shutdown()
+    srv.server_close()
+    srv.scheduler.stop()
+    if t is not None:
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------
+# Journal durability
+
+
+def test_journal_torn_tail_truncated(tmp_path):
+    """A crash mid-append leaves a torn frame; reopen must truncate it
+    and keep every record accepted before the tear."""
+    path = str(tmp_path / "q.queue")
+    j = QueueJournal(path)
+    _, req = _request("torn")
+    assert j.record_submit("t-whole", request_to_record(req))
+    j.close()
+    whole = os.path.getsize(path)
+    with open(path, "ab") as f:
+        f.write(b"\x07\x00\x00torn-frame-garbage")
+
+    j2 = QueueJournal(path)
+    try:
+        assert os.path.getsize(path) <= whole  # tail gone (compaction
+        # may shrink further); the accepted record survived it:
+        unfinished = j2.unfinished()
+        assert list(unfinished) == ["t-whole"]
+        replayed = request_from_record(unfinished["t-whole"])
+        assert replayed.run == "torn"
+        assert replayed.n_keys == req.n_keys
+        # ...and the truncated journal accepts appends again.
+        assert j2.record_result("t-whole", {"valid": True,
+                                            "key-results": []})
+        assert "t-whole" in j2.finished()
+    finally:
+        j2.close()
+
+
+def test_request_record_roundtrip_preserves_ops():
+    keys, req = _request("codec")
+    rec = request_to_record(req)
+    back = request_from_record(rec)
+    assert back.run == req.run
+    assert back.compat == req.compat
+    assert sorted(back.subs) == sorted(req.subs)
+    for i in req.subs:
+        assert back.subs[i].to_dicts() == req.subs[i].to_dicts()
+
+
+# ---------------------------------------------------------------------
+# Crash -> restart replay (in-process scheduler, no subprocess: the
+# subprocess kill -9 version is tools/federation_smoke.py)
+
+
+def test_scheduler_restart_replays_unfinished(tmp_path):
+    path = str(tmp_path / "sched.queue")
+    h = _mixed_history("replay")
+    expected = _in_process().check({"name": "replay"}, h, {})
+
+    # Window far past the test horizon: the ticket is journaled but no
+    # cohort ever forms — the "crash landed mid-window" frame.
+    sched1 = Scheduler(batch_window_s=600.0, queue_path=path)
+    keys, req = _request("replay", h)
+    ticket = sched1.submit(req)
+    # Simulate kill -9: no stop(), no journal close — just abandon the
+    # instance (its worker parks on the condition until process exit).
+    del sched1
+
+    sched2 = Scheduler(batch_window_s=0.0, queue_path=path)
+    try:
+        assert sched2.n_replayed == 1
+        deadline = time.monotonic() + 120
+        while True:
+            res = sched2.poll(ticket)
+            if not res.get("_pending"):
+                break
+            assert time.monotonic() < deadline, "replayed ticket stuck"
+            time.sleep(0.05)
+        assert "_error" not in res
+        krs = res["key-results"]
+        assert len(krs) == len(keys)
+        for k, kr in zip(keys, krs):
+            assert kr["valid"] == expected["results"][k]["valid"], k
+        # Idempotence: the verdict was journaled before done — a THIRD
+        # incarnation must serve the same payload without re-checking.
+        stats2 = sched2.stats()
+        assert stats2["replayed"] == 1
+    finally:
+        sched2.stop()
+
+    sched3 = Scheduler(batch_window_s=600.0, queue_path=path)
+    try:
+        res3 = sched3.poll(ticket)
+        assert res3 == res
+        assert sched3.n_replayed == 0  # finished, not re-queued
+    finally:
+        sched3.stop()
+
+
+# ---------------------------------------------------------------------
+# Cohort-work leak: disconnect mid-PENDING
+
+
+def test_ticket_abandoned_on_disconnect():
+    srv = make_server("127.0.0.1", 0, batch_window_s=1.0)
+    t = _serve(srv)
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        subs = subhistories(_mixed_history("gone"))
+        c = CheckerdClient(addr)
+        ticket = c.submit_ops(
+            "gone", _spec(),
+            [[o.to_dict() for o in ops] for ops in subs.values()])
+        # Sever, don't close: makefile objects keep the fd alive.
+        c.sock.shutdown(socket.SHUT_RDWR)
+        c.close()
+
+        with CheckerdClient(addr) as c2:
+            payload = c2.wait(ticket, deadline_s=60)
+        for kr in payload["key-results"]:
+            assert kr["valid"] == "unknown"
+            assert "abandoned" in kr["error"]
+        stats = srv.scheduler.stats()
+        assert stats["abandoned"] == 1
+    finally:
+        _stop_daemon(srv, t)
+
+
+def test_adopted_ticket_survives_submitter_death():
+    """A second connection polling the ticket adopts it: the submitter
+    dying afterwards must NOT cancel the work."""
+    srv = make_server("127.0.0.1", 0, batch_window_s=1.0)
+    t = _serve(srv)
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    try:
+        subs = subhistories(_mixed_history("adopt"))
+        keys = list(subs)
+        c = CheckerdClient(addr)
+        ticket = c.submit_ops(
+            "adopt", _spec(),
+            [[o.to_dict() for o in subs[k]] for k in keys])
+        c2 = CheckerdClient(addr)
+        c2.poll(ticket)  # adopt before the submitter dies
+        c.sock.shutdown(socket.SHUT_RDWR)
+        c.close()
+        payload = c2.wait(ticket, deadline_s=60)
+        c2.close()
+        expected = _in_process().check(
+            {"name": "adopt"}, _mixed_history("adopt"), {})
+        for k, kr in zip(keys, payload["key-results"]):
+            assert kr["valid"] == expected["results"][k]["valid"], k
+        assert srv.scheduler.stats()["abandoned"] == 0
+    finally:
+        _stop_daemon(srv, t)
+
+
+# ---------------------------------------------------------------------
+# Streaming reconnect: resume from the stable bound
+
+
+def test_streaming_resume_resends_only_tail():
+    from jepsen_tpu.streaming.remote import RemoteFeed
+
+    srv = make_server("127.0.0.1", 0, batch_window_s=0.0)
+    t = _serve(srv)
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    feed = None
+    try:
+        h = _mixed_history("res")
+        subs = subhistories(h)
+        keys = list(subs)
+        lin = Linearizable(Register())
+        feed = RemoteFeed(addr, run="resume", model_spec=_spec(),
+                          algorithm=lin.algorithm, budget_s=None,
+                          time_limit_s=lin.time_limit_s)
+        # Drive flushes by hand: the uploader thread's pacing would
+        # race the severed socket.
+        feed._stop.set()
+        feed._wake.set()
+        feed._thread.join(timeout=10)
+
+        per_key = {k: list(subs[k]) for k in keys}
+        head = {k: ops[: len(ops) // 2] for k, ops in per_key.items()}
+        tail = {k: ops[len(ops) // 2:] for k, ops in per_key.items()}
+        for k in keys:
+            for op in head[k]:
+                feed.put(k, op)
+        feed._flush()
+        sent_before = feed.ops_sent
+        assert sent_before > 0
+        time.sleep(0.3)  # let the daemon ingest the head
+
+        feed._client.sock.shutdown(socket.SHUT_RDWR)
+        for k in keys:
+            for op in tail[k]:
+                feed.put(k, op)
+        # commit() hits the dead socket, resumes, re-sends ONLY the
+        # ops past the daemon's stable bound, then commits.
+        feed.commit(keys)
+        assert not feed.dead, feed.dead
+        assert feed.resumes == 1
+        total = sum(len(o) for o in per_key.values())
+        assert 0 < feed.ops_resent < total
+        assert feed.ticket is not None
+
+        with CheckerdClient(addr) as c:
+            payload = c.wait(feed.ticket, deadline_s=120)
+        expected = _in_process().check({"name": "resume"}, h, {})
+        for k, kr in zip(keys, payload["key-results"]):
+            assert kr["valid"] == expected["results"][k]["valid"], k
+        st = feed.stats()
+        assert st["resumes"] == 1 and st["ops-resent"] == feed.ops_resent
+    finally:
+        if feed is not None and feed._client is not None:
+            feed._client.close()
+        _stop_daemon(srv, t)
+
+
+# ---------------------------------------------------------------------
+# Router: failover, admission, journal restore
+
+
+@pytest.fixture()
+def router_pair():
+    d1 = make_server("127.0.0.1", 0, batch_window_s=2.0)
+    d2 = make_server("127.0.0.1", 0, batch_window_s=2.0)
+    threads = [_serve(d1), _serve(d2)]
+    addrs = [f"127.0.0.1:{d.server_address[1]}" for d in (d1, d2)]
+    rt = make_router_server("127.0.0.1", 0, daemons=addrs,
+                            probe_interval_s=0.2)
+    threads.append(_serve(rt))
+    raddr = f"127.0.0.1:{rt.server_address[1]}"
+    stopped = []
+    try:
+        yield (d1, d2), addrs, rt, raddr, stopped
+    finally:
+        rt.shutdown()
+        rt.server_close()
+        rt.router.stop()
+        for d in (d1, d2):
+            if d not in stopped:
+                _stop_daemon(d)
+        for th in threads:
+            th.join(timeout=5)
+
+
+def test_router_failover_midrun_parity(router_pair):
+    daemons, addrs, rt, raddr, stopped = router_pair
+    h = _mixed_history("fo")
+    expected = _in_process().check({"name": "fo"}, h, {})
+    results = {}
+
+    def run():
+        rc = RemoteChecker(_in_process(), raddr, run_id="fo",
+                           fallback=False)
+        results["fo"] = rc.check({"name": "fo"}, h, {})
+
+    th = threading.Thread(target=run)
+    th.start()
+    # Wait for placement, then tear down the daemon holding the ticket
+    # while it sits in the 2 s batch window.
+    deadline = time.monotonic() + 30
+    while not rt.router._affinity:
+        assert time.monotonic() < deadline, "router never placed"
+        time.sleep(0.05)
+    time.sleep(0.2)
+    victim_addr = next(iter(rt.router._affinity.values()))
+    victim = daemons[addrs.index(victim_addr)]
+    _stop_daemon(victim)
+    stopped.append(victim)
+
+    th.join(timeout=120)
+    res = results["fo"]
+    assert res["valid"] == expected["valid"]
+    for k in expected["results"]:
+        assert res["results"][k]["valid"] == \
+            expected["results"][k]["valid"], k
+    assert "fallback" not in res["checkerd"]
+    st = fetch_stats(raddr)
+    assert st["router"] is True
+    assert st["failovers"] >= 1
+
+
+def test_router_admission_rejection_deterministic(router_pair):
+    _, _, rt, raddr, _ = router_pair
+    rt.router.tenant_quota = 0  # every tenant always over quota
+    h = _mixed_history("adm")
+    res = RemoteChecker(_in_process(), raddr, run_id="adm",
+                        fallback=False).check({"name": "adm"}, h, {})
+    # Honest unknown at the client, deterministic reason on the wire.
+    assert res["valid"] == "unknown"
+    assert "checkerd.admission-rejected" in res["error"]
+    res2 = RemoteChecker(_in_process(), raddr, run_id="adm",
+                         fallback=False).check({"name": "adm"}, h, {})
+    assert "checkerd.admission-rejected" in res2["error"]
+    assert fetch_stats(raddr)["admission-rejected"] >= 2
+
+
+def test_router_restart_serves_journaled_results(tmp_path, router_pair):
+    """A router restart must re-serve finished tickets from its journal
+    — the client keeps polling the same router address after a crash."""
+    (d1, d2), addrs, rt, raddr, _ = router_pair
+    path = str(tmp_path / "router.queue")
+    r1 = Router(addrs, queue_path=path, probe_interval_s=0.2)
+    try:
+        # Drive a submission through the shared router server (it owns
+        # the wire conversation), then transplant the finished record
+        # into the journaled router via its own submit/poll surface.
+        h = _mixed_history("rj")
+        res = RemoteChecker(_in_process(), raddr, run_id="rj",
+                            fallback=False).check({"name": "rj"}, h, {})
+        assert res["valid"] is False
+        # Journal a finished ticket directly (what _finish persists).
+        payload = {"valid": res["valid"], "key-results": [
+            {"valid": kr["valid"]} for kr in res["results"].values()]}
+        r1.journal.record_submit("rst-1", {"run": "rj", "frames": []})
+        r1.journal.record_result("rst-1", payload)
+    finally:
+        r1.stop()
+
+    r2 = Router(addrs, queue_path=path, probe_interval_s=0.2)
+    try:
+        assert "rst-1" in r2._tickets  # restored from the journal
+        ftype, got = r2.poll("rst-1")
+        from jepsen_tpu.checkerd.protocol import F_RESULT
+        assert ftype == F_RESULT
+        assert got["valid"] == payload["valid"]
+        assert len(got["key-results"]) == len(payload["key-results"])
+    finally:
+        r2.stop()
+
+
+# ---------------------------------------------------------------------
+# The CI smoke, pytest-reachable
+
+
+@pytest.mark.slow
+def test_federation_smoke_tool():
+    """tools/federation_smoke.py (its own tier1 step): subprocess
+    daemons, real SIGKILL, restart replay + router failover."""
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import federation_smoke
+
+    assert federation_smoke.run() == 0

@@ -42,7 +42,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 LOG = os.path.join(REPO, "CHIP_LOG.md")
 sys.path.insert(0, REPO)
 
-from bench import probe_chip  # noqa: E402
+from bench import probe_chip, reset_chip  # noqa: E402
 
 
 def log_line(text: str) -> None:
@@ -154,7 +154,22 @@ def main() -> int:
         t0 = time.time()
         result = probe_chip()
         log_line(f"probe={result} ({time.time() - t0:.1f}s)")
-        while result == "ok":
+        if result == "wedged":
+            # A wedged tunnel used to mean "sleep and hope" — every
+            # bench since r03 logged probe=wedged without ever trying
+            # the reset rung that landed for exactly this.  Sweep the
+            # stale libtpu lockfiles (ops/degrade.py reset_chip) and
+            # re-probe: a recovered window is recorded as
+            # ok-after-reset and gets a fresh capture battery.
+            note = reset_chip()
+            t1 = time.time()
+            reprobe = probe_chip()
+            if reprobe == "ok":
+                result = "ok-after-reset"
+            log_line(f"reset attempt ({note}) -> "
+                     f"probe={result if reprobe == 'ok' else reprobe} "
+                     f"({time.time() - t1:.1f}s)")
+        while result in ("ok", "ok-after-reset"):
             pending = [step for step in BATTERY
                        if not os.path.exists(os.path.join(REPO,
                                                           step[2]))]

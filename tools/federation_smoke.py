@@ -1,0 +1,293 @@
+#!/usr/bin/env python
+"""CI smoke for the durable checkerd federation (tier1.yml step).
+
+Phase 1 — router failover, zero lost verdicts: two daemons behind a
+`checkerd-router` (with a ticket journal and a /metrics port), two
+concurrent runs through the router with in-process fallback DISABLED,
+SIGKILL the daemon the router placed the tickets on while they sit in
+its batch window.  Asserts both runs still produce verdicts identical
+per-key to in-process checking, the router's failover counter fired,
+and the /metrics scrape exposes the router gauges.
+
+Phase 2 — daemon crash + restart replay (the acceptance criterion):
+one daemon with a --queue journal and a long batch window, submit,
+SIGKILL mid-window (ticket accepted and journaled, verdict not yet
+computed), restart the daemon on the same port with the same journal,
+poll the ORIGINAL ticket.  Asserts the replayed verdict matches the
+uninterrupted in-process result per key — zero in-flight verdicts
+lost.
+
+Exit 0 + "PASS" on success, exit 1 with a reason otherwise.  CPU-only:
+the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.checkerd.client import (  # noqa: E402
+    CheckerdClient,
+    RemoteChecker,
+    fetch_stats,
+)
+from jepsen_tpu.history.core import History  # noqa: E402
+from jepsen_tpu.models.registers import Register  # noqa: E402
+from jepsen_tpu.parallel.independent import (  # noqa: E402
+    KV,
+    IndependentChecker,
+)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def history(prefix: str) -> History:
+    """One good register key and one that reads a never-written value —
+    a per-key valid/invalid mix so parity checks bite."""
+    ops = []
+
+    def add(process, f, key, value):
+        i = len(ops)
+        ops.append({"index": i, "type": "invoke", "process": process,
+                    "f": f, "value": KV(key, None if f == "read" else value),
+                    "time": i})
+        ops.append({"index": i + 1, "type": "ok", "process": process,
+                    "f": f, "value": KV(key, value), "time": i + 1})
+
+    add(0, "write", f"{prefix}-good", 1)
+    add(0, "read", f"{prefix}-good", 1)
+    add(1, "write", f"{prefix}-bad", 1)
+    add(1, "read", f"{prefix}-bad", 9)
+    return History(ops)
+
+
+class Failure(Exception):
+    pass
+
+
+def wait_listening(port: int, proc: subprocess.Popen, what: str,
+                   deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            if proc.poll() is not None:
+                raise Failure(f"{what} exited early rc={proc.returncode}")
+            if time.monotonic() > deadline:
+                raise Failure(f"{what} never started listening")
+            time.sleep(0.2)
+
+
+def start_daemon(port: int, queue: str, batch_window: float) -> subprocess.Popen:
+    return subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.checkerd",
+         "--host", "127.0.0.1", "--port", str(port),
+         "--batch-window", str(batch_window), "--platform", "cpu",
+         "--metrics-port", "-1", "--queue", queue],
+    )
+
+
+def stop(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def expected_results(runs: dict) -> dict:
+    return {
+        name: IndependentChecker(Linearizable(Register())).check(
+            {"name": name}, h, {})
+        for name, h in runs.items()
+    }
+
+
+def assert_parity(name: str, got: dict, exp: dict) -> None:
+    if got is None:
+        raise Failure(f"{name}: no result")
+    if "fallback" in (got.get("checkerd") or {}):
+        raise Failure(f"{name}: fell back in-process: {got['checkerd']}")
+    if got.get("valid") != exp.get("valid"):
+        raise Failure(f"{name}: valid {got.get('valid')} != "
+                      f"{exp.get('valid')}")
+    for k, kr in exp["results"].items():
+        if got["results"][k]["valid"] != kr["valid"]:
+            raise Failure(f"{name}/{k}: {got['results'][k]['valid']} "
+                          f"!= {kr['valid']}")
+
+
+def phase_router_failover(tmp: str) -> str:
+    """2 daemons + router; SIGKILL the placed daemon mid-window; both
+    runs must still verdict correctly via failover."""
+    ports = [free_port(), free_port()]
+    addrs = [f"127.0.0.1:{p}" for p in ports]
+    rport, mport = free_port(), free_port()
+    raddr = f"127.0.0.1:{rport}"
+    daemons = [
+        start_daemon(ports[i], os.path.join(tmp, f"d{i}.queue"), 2.0)
+        for i in range(2)
+    ]
+    router = subprocess.Popen(
+        [sys.executable, "-m", "jepsen_tpu.checkerd.router",
+         "--host", "127.0.0.1", "--port", str(rport),
+         "--daemon", addrs[0], "--daemon", addrs[1],
+         "--metrics-port", str(mport),
+         "--queue", os.path.join(tmp, "router.queue")],
+    )
+    try:
+        for i, d in enumerate(daemons):
+            wait_listening(ports[i], d, f"daemon {i}")
+        wait_listening(rport, router, "router")
+
+        runs = {"fed-a": history("a"), "fed-b": history("b")}
+        expected = expected_results(runs)
+        results: dict = {}
+        barrier = threading.Barrier(len(runs) + 1)
+
+        def submit(name: str, h: History) -> None:
+            rc = RemoteChecker(
+                IndependentChecker(Linearizable(Register())),
+                raddr, run_id=name, fallback=False)
+            barrier.wait()
+            results[name] = rc.check({"name": name}, h, {})
+
+        threads = [threading.Thread(target=submit, args=(n, h))
+                   for n, h in runs.items()]
+        for t in threads:
+            t.start()
+        barrier.wait()
+        # Let both submissions land in a daemon's batch window, then
+        # SIGKILL the daemon the router placed them on.
+        time.sleep(0.8)
+        st = fetch_stats(raddr, timeout=5.0)
+        placed = set((st.get("affinity") or {}).values())
+        if not placed:
+            raise Failure("router placed nothing (affinity empty)")
+        victim_addr = placed.pop()
+        victim = daemons[addrs.index(victim_addr)]
+        os.kill(victim.pid, signal.SIGKILL)
+        victim.wait(timeout=10)
+
+        for t in threads:
+            t.join(timeout=300)
+        for name, exp in expected.items():
+            assert_parity(name, results.get(name), exp)
+
+        st = fetch_stats(raddr, timeout=5.0)
+        if not st.get("failovers"):
+            raise Failure(f"router failovers {st.get('failovers')} not > 0")
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{mport}/metrics", timeout=10,
+        ).read().decode()
+        for gauge in ("jepsen_router_daemons", "jepsen_router_failovers",
+                      "jepsen_router_queue_depth"):
+            if gauge not in body:
+                raise Failure(f"/metrics scrape missing {gauge}")
+        return (f"failover: {st['failovers']} failover(s), both runs "
+                f"verdict-correct after SIGKILL of {victim_addr}")
+    finally:
+        stop(router)
+        for d in daemons:
+            stop(d)
+
+
+def phase_restart_replay(tmp: str) -> str:
+    """SIGKILL a daemon mid-cohort; restart with the same journal; the
+    ORIGINAL ticket must produce the uninterrupted verdict."""
+    port = free_port()
+    addr = f"127.0.0.1:{port}"
+    queue = os.path.join(tmp, "replay.queue")
+    h = history("r")
+    exp = IndependentChecker(Linearizable(Register())).check(
+        {"name": "replay"}, h, {})
+
+    from jepsen_tpu.parallel.independent import subhistories
+    subs = subhistories(h)
+    keys = list(subs)
+    subs_ops = [[o.to_dict() for o in subs[k]] for k in keys]
+
+    daemon = start_daemon(port, queue, 5.0)
+    client = None
+    try:
+        wait_listening(port, daemon, "daemon")
+        # Keep the submitting connection open across the kill: closing
+        # it first would (correctly) abandon the ticket.
+        client = CheckerdClient(addr)
+        spec = {"type": "register", "value": None}
+        ticket = client.submit_ops("replay", spec, subs_ops)
+        # The ticket is journaled+fsynced before the TICKET reply, so
+        # the kill can land any time from here on.
+        time.sleep(0.3)
+        os.kill(daemon.pid, signal.SIGKILL)
+        daemon.wait(timeout=10)
+        client.close()
+        client = None
+
+        daemon = start_daemon(port, queue, 0.05)
+        wait_listening(port, daemon, "restarted daemon")
+        with CheckerdClient(addr) as c:
+            payload = c.wait(ticket, deadline_s=120)
+        krs = payload.get("key-results") or []
+        if len(krs) != len(keys):
+            raise Failure(f"replayed ticket returned {len(krs)} keys "
+                          f"for {len(keys)}")
+        got = {k: r for k, r in zip(keys, krs)}
+        for k, kr in exp["results"].items():
+            if got[k]["valid"] != kr["valid"]:
+                raise Failure(f"replay/{k}: {got[k]['valid']} != "
+                              f"{kr['valid']}")
+        # Replay idempotence: a second restart must serve the SAME
+        # journaled bytes for the same ticket.
+        stop(daemon)
+        daemon = start_daemon(port, queue, 0.05)
+        wait_listening(port, daemon, "re-restarted daemon")
+        with CheckerdClient(addr) as c:
+            again = c.wait(ticket, deadline_s=60)
+        if json.dumps(again, sort_keys=True) != \
+                json.dumps(payload, sort_keys=True):
+            raise Failure("replayed result changed across restarts")
+        return (f"replay: ticket {ticket} survived SIGKILL + restart, "
+                f"{len(keys)} key verdicts match uninterrupted run, "
+                f"byte-identical across a second restart")
+    finally:
+        if client is not None:
+            client.close()
+        stop(daemon)
+
+
+def run() -> int:
+    tmp = tempfile.mkdtemp(prefix="federation-smoke-")
+    try:
+        msg2 = phase_restart_replay(tmp)
+        print(f"  {msg2}")
+        msg1 = phase_router_failover(tmp)
+        print(f"  {msg1}")
+    except Failure as e:
+        print(f"FAIL: {e}")
+        return 1
+    print("PASS: daemon crash-replay parity + router failover with "
+          "zero lost verdicts")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())
