@@ -108,6 +108,8 @@ DECLARED_NAMESPACES = {
     "bench": "bench.py sweeps",
     "forensics": "anomaly dossiers (forensics.py)",
     "slo": "SLO alert engine (telemetry/slo.py)",
+    "monitor": "standing continuous verification (monitor/)",
+    "alert": "alert router sink deliveries (monitor/alerts.py)",
 }
 
 #: Fleet-scoped modules: counters here survive scoped_reset only when

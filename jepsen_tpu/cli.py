@@ -354,6 +354,53 @@ def single_test_cmd(
     add_lint_args(ln)
     ln.set_defaults(_run=_run_lint)
 
+    mo = sub.add_parser(
+        "monitor",
+        help="standing continuous verification: paced workload, "
+        "rolling-window online checking, durable time-series history, "
+        "SLO alert routing",
+    )
+    mo.add_argument("--store-dir", default="store/monitor",
+                    help="durable state root (series files, slo.jsonl, "
+                    "forensics, postmortems)")
+    mo.add_argument("--rate", type=float, default=1000.0, metavar="OPS",
+                    help="target completed ops per second (default 1000)")
+    mo.add_argument("--duration", type=float, default=0.0, metavar="S",
+                    help="seconds to run; 0 = until interrupted")
+    mo.add_argument("--keys", type=int, default=8,
+                    help="independent register keys (default 8)")
+    mo.add_argument("--procs-per-key", type=int, default=4,
+                    help="concurrent worker processes per key (default 4)")
+    mo.add_argument("--cadence", type=float, default=5.0, metavar="S",
+                    help="sample/evaluate/alert cadence (default 5)")
+    mo.add_argument("--sink", action="append", default=[],
+                    metavar="SPEC",
+                    help="alert sink: file:/path, webhook:URL, or "
+                    "exec:/script (repeatable)")
+    mo.add_argument("--endpoint", default=None, metavar="ADDR",
+                    help="checkerd/router address to tee op windows to "
+                    "for independent post-hoc verdicts")
+    mo.add_argument("--serve-port", type=int, default=None, metavar="P",
+                    help="embed the web dashboard (/monitor) on this port")
+    mo.add_argument("--no-discard", action="store_true",
+                    help="retain full history (parity/debug mode; "
+                    "memory grows)")
+    mo.add_argument("--advance-rows", type=int, default=1024,
+                    help="rows between frontier advances (default 1024)")
+    mo.add_argument("--bars-per-block", type=int, default=64,
+                    help="barriers per frontier block (default 64)")
+    mo.add_argument("--inject-slo", type=float, default=0.0, metavar="S",
+                    help="fire a synthetic SLO for the first S seconds "
+                    "then clear it (smoke/drill)")
+    mo.add_argument("--max-ops", type=int, default=None,
+                    help="stop after this many completed ops")
+    mo.add_argument("--seed", type=int, default=45100)
+    mo.add_argument("--info-rate", type=float, default=0.0,
+                    help="fraction of ops completing indeterminate")
+    mo.add_argument("--platform", default=None, choices=["cpu", "tpu"],
+                    help="pin the JAX backend")
+    mo.set_defaults(_run=_run_monitor)
+
     return parser
 
 
@@ -607,6 +654,51 @@ def _run_lint(opts) -> int:
     from .analysis.core import main as lint_main
 
     return lint_main(opts)
+
+
+def _run_monitor(opts) -> int:
+    """`jepsen monitor`: blocks until --duration / --max-ops / SIGINT.
+    Exit 0 when every key's verdict stayed proven, 2 when any epoch
+    ended unknown (an alert fired for it — unknown is a page, not a
+    pass)."""
+    import threading
+
+    from .monitor import MonitorConfig, run_monitor
+
+    cfg = MonitorConfig(
+        store_dir=opts.store_dir,
+        rate=opts.rate,
+        duration_s=opts.duration,
+        keys=opts.keys,
+        procs_per_key=opts.procs_per_key,
+        cadence_s=opts.cadence,
+        seed=opts.seed,
+        info_rate=opts.info_rate,
+        max_ops=opts.max_ops,
+        bars_per_block=opts.bars_per_block,
+        advance_rows=opts.advance_rows,
+        discard=not opts.no_discard,
+        sinks=tuple(opts.sink),
+        inject_slo_s=opts.inject_slo,
+        endpoint=opts.endpoint,
+        serve_port=opts.serve_port,
+    )
+    stop = threading.Event()
+    try:
+        summary = run_monitor(cfg, stop)
+    except KeyboardInterrupt:
+        # run_monitor's finally already flushed + wrote the summary.
+        print("monitor interrupted; state flushed")
+        return EXIT_VALID
+    print(
+        f"==> monitor: {summary['ops']} ops over "
+        f"{summary['duration_s']}s "
+        f"({summary['rate_measured']} ops/s), "
+        f"{summary['ok_keys']} keys proven, "
+        f"{summary['unknown_keys']} unknown; "
+        f"series in {opts.store_dir}"
+    )
+    return EXIT_VALID if summary["unknown_keys"] == 0 else EXIT_UNKNOWN
 
 
 def run(parser: argparse.ArgumentParser, argv: Optional[Sequence[str]] = None) -> int:

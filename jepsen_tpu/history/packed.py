@@ -322,6 +322,121 @@ class PackedBuilder:
         fresh.sort(key=lambda r: r[0])
         self._stable.extend(fresh)
 
+    def discard_stable_prefix(
+        self, *, bars_per_block: int, blocks_done: int
+    ) -> tuple[int, int, int]:
+        """Rolling-window discard: drops the longest prefix of the
+        stable rows that the frontier consumer can never need again,
+        renumbers the surviving event indices down to a dense range,
+        and returns ``(rows_dropped, bars_dropped, event_shift)`` so
+        the caller can `FrontierCarry.rebase()` in lockstep.
+
+        A prefix of length d is discardable when:
+
+          1. every dropped row is ST_OK — an ST_INFO row has
+             ret = NO_RET and stays a candidate entrant of every
+             future block, so it pins the discard point (documented
+             limitation: an indeterminate op early in the run caps how
+             much history can ever be dropped before an epoch restart);
+          2. max(ret over the prefix) < min(ret over every retained
+             stable OK row) — then the prefix's barriers are EXACTLY
+             the global barrier ranks [0, d) (bars sort by ret), so
+             retained bar ranks shift uniformly by d;
+          3. d is a multiple of `bars_per_block` — block boundaries
+             stay aligned after the shift;
+          4. d <= (blocks_done - 1) * bars_per_block — the most recent
+             PROCESSED block must stay resident, because the carried
+             frontier window (`_prev_active`) references that block's
+             own rows; discarding them would orphan the member matrix.
+
+        Under those conditions every device-side comparison the
+        frontier makes (bar rank vs k0, inv vs barrier ret, window
+        regather by row index) is invariant under the uniform shift —
+        tests/test_monitor.py asserts verdict byte-parity.
+
+        Event renumbering (the returned `event_shift`) subtracts the
+        minimum surviving event index from every retained inv/ret and
+        from the event counter, so a paced week-long run never walks
+        the int32 timeline off its cliff (~2.1e9 events)."""
+        if self._finished:
+            raise RuntimeError("PackedBuilder already finished")
+        K = bars_per_block
+        max_bars = max(0, (blocks_done - 1)) * K
+        if K <= 0 or max_bars <= 0 or not self._stable:
+            return 0, 0, 0
+        # Longest all-OK prefix of the stable rows.
+        n_ok_prefix = 0
+        for r in self._stable:
+            if r[3] != ST_OK:
+                break
+            n_ok_prefix += 1
+        if n_ok_prefix == 0:
+            return 0, 0, 0
+        # Condition 2: the prefix must be ret-closed against every
+        # retained OK row — stable tail AND unsorted tail (a row with
+        # inv >= s may still have completed before a stable row did,
+        # so tail rets compete for low barrier ranks too).  Pending
+        # ops complete at future events > every existing ret.
+        min_ret_rest = min(
+            min(
+                (r[1] for r in self._stable[n_ok_prefix:] if r[3] == ST_OK),
+                default=NO_RET,
+            ),
+            min(
+                (r[1] for r in self._rows if r[3] == ST_OK),
+                default=NO_RET,
+            ),
+        )
+        rets = sorted(r[1] for r in self._stable[:n_ok_prefix])
+        d = n_ok_prefix
+        while d > 0 and rets[d - 1] >= min_ret_rest:
+            d -= 1
+        d = min(d, max_bars)
+        d -= d % K
+        if d <= 0:
+            return 0, 0, 0
+        # The dropped rows' rets must be exactly ranks [0, d): every
+        # retained ret larger than all dropped rets.  After trimming d
+        # to ret-order (rets is sorted; rows aren't), re-check that the
+        # first d rows *by ret* are a row prefix too — for register
+        # workloads rows are emitted completion-ordered so this holds;
+        # bail (discard nothing) when it doesn't rather than risk a
+        # rank permutation.
+        cut = rets[d - 1]
+        prefix = self._stable[:d]
+        if any(r[1] > cut for r in prefix) or any(
+            r[1] <= cut for r in self._stable[d:n_ok_prefix]
+        ):
+            return 0, 0, 0
+        # Event renumbering: shift so the first retained row lands at
+        # event 0 (or keep the counter dense when nothing is retained).
+        rest = self._stable[d:]
+        candidates = [r[0] for r in rest] + [r[0] for r in self._rows]
+        candidates += [inv_e for inv_e, _ in self._pending.values()]
+        e_shift = min(candidates) if candidates else self._e
+        self._stable = [
+            (
+                r[0] - e_shift,
+                r[1] - e_shift if r[1] != NO_RET else NO_RET,
+                r[2], r[3], r[4], r[5], r[6], r[7],
+            )
+            for r in rest
+        ]
+        self._rows = [
+            (
+                r[0] - e_shift,
+                r[1] - e_shift if r[1] != NO_RET else NO_RET,
+                r[2], r[3], r[4], r[5], r[6], r[7],
+            )
+            for r in self._rows
+        ]
+        self._pending = {
+            p: (inv_e - e_shift, op)
+            for p, (inv_e, op) in self._pending.items()
+        }
+        self._e -= e_shift
+        return d, d, e_shift
+
     def snapshot(self) -> tuple["PackedOps", int]:
         """(stable-prefix PackedOps, s).  The pack covers exactly the
         rows with inv < s and is WITNESS-ONLY: preds/horizon are left

@@ -1,0 +1,19 @@
+"""Always-on continuous verification (`jepsen monitor`).
+
+The composition layer ROADMAP item 5 names as the product: a paced
+generator, rolling-window online checking with constant memory
+(rolling.py), a durable time-series observatory
+(telemetry/timeseries.py), SLO evaluation with alert routing
+(alerts.py), and the standing loop that ties them together (loop.py).
+"""
+
+from .alerts import AlertRouter
+from .loop import MonitorConfig, run_monitor
+from .rolling import RollingChecker
+
+__all__ = [
+    "AlertRouter",
+    "MonitorConfig",
+    "RollingChecker",
+    "run_monitor",
+]

@@ -57,6 +57,11 @@ BLOCK_PLAN = 6
 #: before the daemon acknowledges, so a restarted daemon (or router)
 #: replays every in-flight ticket instead of dropping it.
 BLOCK_QUEUE = 7
+#: Time-series sample batch (telemetry/timeseries.py): one cadence tick
+#: of gauge/counter/SLO/profile samples per block.  A monitor process
+#: killed mid-write loses at most the torn tail, which BlockWriter
+#: truncates on reopen.
+BLOCK_SERIES = 8
 
 #: Ops per sealed history chunk (format.clj:372-375).
 CHUNK_SIZE = 16384

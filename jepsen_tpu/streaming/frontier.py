@@ -285,6 +285,41 @@ class FrontierCarry:
 
     # -- API ----------------------------------------------------------------
 
+    def rebase(self, rows_dropped: int, bars_dropped: int) -> None:
+        """Shifts the carry after the builder discarded a stable prefix
+        (PackedBuilder.discard_stable_prefix): row indices fall by
+        `rows_dropped`, barrier ranks by `bars_dropped`.  Sound because
+        the discard conditions guarantee (a) dropped rows are a
+        row-index prefix with the lowest `bars_dropped` barrier ranks,
+        so every retained rank/index shifts uniformly, (b) at least the
+        most recent processed block stays resident, so the carried
+        window (`_prev_active`) references only retained rows — the
+        device-side member/states/alive arrays hold no row indices or
+        event values and carry over untouched."""
+        if self.dead or rows_dropped <= 0:
+            return
+        if bars_dropped % self.K != 0:
+            self._die(
+                f"rebase of {bars_dropped} bars misaligned to K={self.K}"
+            )
+            return
+        blocks_gone = bars_dropped // self.K
+        if blocks_gone >= self.blocks_done:
+            self._die(
+                f"rebase would drop {blocks_gone} of "
+                f"{self.blocks_done} processed blocks"
+            )
+            return
+        self.blocks_done -= blocks_gone
+        self.bars_done -= bars_dropped
+        if self._prev_active is not None:
+            if self._prev_active.size and int(self._prev_active.min()) < rows_dropped:
+                self._die("rebase dropped a row still in the carry window")
+                return
+            self._prev_active = self._prev_active - rows_dropped
+        telemetry.count("wgl.online.rebase")
+        telemetry.count("wgl.online.rebase-bars", bars_dropped)
+
     def advance(self, packed: PackedOps, s: int) -> None:
         """Consumes the newly decidable barriers of a stable-prefix
         snapshot (`packed`, stable bound `s` — see PackedBuilder).

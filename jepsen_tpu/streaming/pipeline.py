@@ -421,6 +421,16 @@ class StreamingSession:
             self._remote.commit(list(self._builders))
         self.verdict_lag_s = time.monotonic() - t0
         telemetry.gauge("wgl.online.verdict-lag-s", self.verdict_lag_s)
+        # Also into the quantile ring: the SLO p95 rule and the
+        # /metrics summary family threshold on the distribution over
+        # recent sessions, not this one sample.
+        try:
+            from ..telemetry import timeseries
+
+            timeseries.observe("wgl.online.verdict-lag-s",
+                               self.verdict_lag_s)
+        except Exception:  # noqa: BLE001 — observability is side output
+            pass
         # The verdict-lag SLO samples the gauge the instant it lands:
         # a blown lag budget dumps its postmortem here, at finish time,
         # not on the next telemetry flush.

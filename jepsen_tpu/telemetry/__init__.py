@@ -680,6 +680,30 @@ def prometheus_text(
         pn = _prom_name(name)
         lines.append(f"# TYPE {pn} gauge")
         lines.append(f"{pn} {v}")
+    # Histogram-style quantile export (Prometheus summary families)
+    # from the in-process time-series rings: one `<name>_dist` family
+    # per observed series (the `_dist` suffix keeps the family distinct
+    # from the same series' last-sample gauge), e.g.
+    # jepsen_wgl_online_verdict_lag_s_dist{quantile="0.95"} — so
+    # dashboards and SLO rules see the recent distribution instead of
+    # a single sample.  Empty until something observes.
+    try:
+        from . import timeseries as _ts
+
+        _qmap = {"p50": "0.5", "p95": "0.95", "p99": "0.99"}
+        for sname in _ts.ring_names():
+            qs = _ts.quantiles(sname)
+            if not qs:
+                continue
+            pn = _prom_name(sname) + "_dist"
+            lines.append(f"# TYPE {pn} summary")
+            for label in ("p50", "p95", "p99"):
+                if label in qs:
+                    lines.append(
+                        f'{pn}{{quantile="{_qmap[label]}"}} {qs[label]}'
+                    )
+    except Exception:  # noqa: BLE001 — scrape must render regardless
+        pass
     if lint_findings:
         lines.append("# TYPE jepsen_lint_findings gauge")
         for sev in sorted(lint_findings):

@@ -105,6 +105,23 @@ DEFAULT_RULES: tuple[Rule, ...] = (
     Rule("chip-health", "chip-unhealthy"),
 )
 
+#: Rules the standing monitor (jepsen_tpu/monitor/) layers on top of
+#: the defaults.  The p95 rule thresholds on the quantile gauges the
+#: time-series rings export (telemetry/timeseries.quantile_gauges(),
+#: passed as evaluation extras) instead of a single last-sample gauge
+#: — one slow verdict no longer pages; a shifted distribution does.
+#: The drift rule watches the PR 12 cost model's predictions against
+#: measured pass costs (monitor.cost-drift-ratio, a rolling median of
+#: measured/predicted) and fires when retraining is due.
+MONITOR_RULES: tuple[Rule, ...] = (
+    Rule("monitor-verdict-lag", "gauge-above", "monitor.verdict-lag-s",
+         60.0, for_count=2),
+    Rule("verdict-lag-p95", "gauge-above",
+         "wgl.online.verdict-lag-s.p95", 30.0),
+    Rule("cost-drift", "gauge-above", "monitor.cost-drift-ratio",
+         3.0, for_count=3),
+)
+
 
 class SLOEngine:
     """Evaluates a rule set against registry snapshots and journals

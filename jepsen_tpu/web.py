@@ -27,8 +27,132 @@ td, th { padding: 0.3em 1em; border-bottom: 1px solid #ddd; text-align: left; }
 .valid-true { color: #0a0; } .valid-false { color: #a00; }
 .valid-unknown { color: #a60; }
 a { text-decoration: none; }
+.spark-row { display: flex; align-items: center; margin: 2px 0; }
+.spark-label { width: 22em; font-family: monospace; font-size: 0.85em;
+               overflow: hidden; text-overflow: ellipsis; }
+.spark-val { margin-left: 0.8em; font-family: monospace;
+             font-size: 0.85em; color: #555; }
+.spark-row canvas { border-bottom: 1px solid #eee; }
 """
 
+
+#: The /monitor page's client: one canvas sparkline per series,
+#: bootstrapped from /api/series, then appended live from the SSE
+#: stream.  Vanilla JS, no external assets — the dashboard must render
+#: inside an airgapped pod.
+_MONITOR_JS = """
+<script>
+(function () {
+  var qs = new URLSearchParams(location.search);
+  var dirq = qs.get('dir') ? '&dir=' + encodeURIComponent(qs.get('dir')) : '';
+  var tier = qs.get('tier') || '0';
+  var PIN = [
+    'monitor.verdict-lag-s', 'wgl.online.verdict-lag-s.p95',
+    'monitor.ops-per-s', 'checkerd.queue-depth',
+    'monitor.resident-history-bytes', 'monitor.series-disk-bytes',
+    'monitor.cost-drift-ratio', 'monitor.epoch-restarts',
+    'monitor.discards', 'chip.health'
+  ];
+  var MAXPTS = 300;
+  var charts = {};
+  function rank(n) {
+    var i = PIN.indexOf(n);
+    if (i >= 0) return i;
+    if (n.indexOf('monitor.') === 0) return 100;
+    if (n.indexOf('slo.') === 0) return 200;
+    return 300;
+  }
+  function fmt(v) {
+    if (v == null) return '-';
+    if (Math.abs(v) >= 1048576) return (v / 1048576).toFixed(1) + 'M';
+    if (Math.abs(v) >= 1024) return (v / 1024).toFixed(1) + 'k';
+    return (Math.round(v * 1000) / 1000).toString();
+  }
+  function addChart(name) {
+    if (charts[name]) return charts[name];
+    var row = document.createElement('div');
+    row.className = 'spark-row';
+    var label = document.createElement('span');
+    label.className = 'spark-label';
+    label.textContent = name;
+    var canvas = document.createElement('canvas');
+    canvas.width = 320; canvas.height = 40;
+    var val = document.createElement('span');
+    val.className = 'spark-val';
+    row.appendChild(label); row.appendChild(canvas); row.appendChild(val);
+    var box = document.getElementById('charts');
+    var rows = box.children, r = rank(name), inserted = false;
+    for (var i = 0; i < rows.length; i++) {
+      var other = rows[i].getAttribute('data-rank');
+      if (r < +other ||
+          (r === +other && name < rows[i].getAttribute('data-name'))) {
+        box.insertBefore(row, rows[i]); inserted = true; break;
+      }
+    }
+    if (!inserted) box.appendChild(row);
+    row.setAttribute('data-rank', r);
+    row.setAttribute('data-name', name);
+    charts[name] = {pts: [], canvas: canvas, val: val};
+    return charts[name];
+  }
+  function draw(name) {
+    var c = charts[name], ctx = c.canvas.getContext('2d');
+    var w = c.canvas.width, h = c.canvas.height, pts = c.pts;
+    ctx.clearRect(0, 0, w, h);
+    if (!pts.length) { c.val.textContent = '-'; return; }
+    var lo = Infinity, hi = -Infinity;
+    for (var i = 0; i < pts.length; i++) {
+      if (pts[i][1] < lo) lo = pts[i][1];
+      if (pts[i][1] > hi) hi = pts[i][1];
+    }
+    if (hi === lo) { hi += 1; lo -= 1; }
+    ctx.strokeStyle = '#47a'; ctx.lineWidth = 1.5; ctx.beginPath();
+    for (var j = 0; j < pts.length; j++) {
+      var x = pts.length > 1 ? j * (w - 4) / (pts.length - 1) + 2 : w / 2;
+      var y = h - 3 - (pts[j][1] - lo) * (h - 6) / (hi - lo);
+      if (j === 0) ctx.moveTo(x, y); else ctx.lineTo(x, y);
+    }
+    ctx.stroke();
+    c.val.textContent = fmt(pts[pts.length - 1][1]) +
+      ' (' + fmt(lo) + '..' + fmt(hi) + ')';
+  }
+  function push(name, t, v) {
+    if (v && typeof v === 'object') {
+      v = v.mean != null ? v.mean : v.last;
+    }
+    if (typeof v !== 'number' || !isFinite(v)) return;
+    var c = addChart(name);
+    if (c.pts.length && c.pts[c.pts.length - 1][0] >= t) return;
+    c.pts.push([t, v]);
+    if (c.pts.length > MAXPTS) c.pts.shift();
+    draw(name);
+  }
+  fetch('/api/series?tier=' + tier + dirq)
+    .then(function (r) { return r.json(); })
+    .then(function (d) {
+      (d.names || []).forEach(function (n) {
+        addChart(n);
+        fetch('/api/series?name=' + encodeURIComponent(n) +
+              '&tier=' + tier + '&limit=' + MAXPTS + dirq)
+          .then(function (r) { return r.json(); })
+          .then(function (s) {
+            charts[n].pts = (s.points || []).concat(charts[n].pts);
+            if (charts[n].pts.length > MAXPTS) {
+              charts[n].pts = charts[n].pts.slice(-MAXPTS);
+            }
+            draw(n);
+          });
+      });
+      var es = new EventSource('/api/series/stream?tier=' + tier + dirq);
+      es.onmessage = function (ev) {
+        var p = JSON.parse(ev.data);
+        var s = p.s || {};
+        Object.keys(s).forEach(function (n) { push(n, p.t, s[n]); });
+      };
+    });
+})();
+</script>
+"""
 
 #: {run_dir: (jtpu mtime, validity)} so the index doesn't re-scan every
 #: test file on every page load (web.clj:51-66 caches its rows too).
@@ -127,9 +251,15 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._fleet()
             elif path.rstrip("/") == "/metrics":
                 self._metrics()
+            elif path.rstrip("/") == "/monitor":
+                self._monitor()
+            elif path.rstrip("/") == "/api/series/stream":
+                self._series_stream()
+            elif path.rstrip("/") == "/api/series":
+                self._series_api()
             else:
                 self._send(404, _page("404", "<p>not found</p>"))
-        except BrokenPipeError:
+        except (BrokenPipeError, ConnectionResetError):
             pass
         except Exception as e:  # noqa: BLE001
             log.exception("web error")
@@ -180,7 +310,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                     f"<td><a href='/zip/{q}'>zip</a></td></tr>"
                 )
         body = (
-            "<p><a href='/fleet'>checker fleet</a></p>"
+            "<p><a href='/fleet'>checker fleet</a> · "
+            "<a href='/monitor'>monitor observatory</a></p>"
             + (
                 "<h2>fault searches</h2><ul>" + "".join(searches)
                 + "</ul>" if searches else ""
@@ -439,6 +570,162 @@ class Handler(http.server.BaseHTTPRequestHandler):
             lint_findings=lint_counts,
         ).encode()
         self._send(200, body, ctype="text/plain; version=0.0.4")
+
+    def _series_root(self) -> Optional[str]:
+        """Directory holding the monitor's series-t*.jtpu files: the
+        store dir itself (a co-hosted `jepsen monitor --serve-port`),
+        an explicit ?dir= subdir, or the first subdir that has them (a
+        detached `jepsen serve store` over `store/monitor`)."""
+        from .telemetry import timeseries
+
+        root = os.path.realpath(self.store_dir)
+        sub = (self._query.get("dir") or [""])[0].strip("/")
+        if sub:
+            cand = os.path.realpath(os.path.join(root, sub))
+            if cand == root or cand.startswith(root + os.sep):
+                return cand
+            return None
+        if os.path.isfile(timeseries.series_path(root, 0)):
+            return root
+        if os.path.isdir(root):
+            for name in sorted(os.listdir(root)):
+                d = os.path.join(root, name)
+                if os.path.isfile(timeseries.series_path(d, 0)):
+                    return d
+        return None
+
+    def _series_api(self) -> None:
+        """JSON read API over the durable series store, straight from
+        disk so it works cross-process and across monitor restarts.
+        Without ?name= lists series names; with it returns points."""
+        from .telemetry import timeseries
+
+        root = self._series_root()
+        if root is None:
+            self._send(404, b'{"error": "no series store found"}',
+                       "application/json")
+            return
+        q = self._query
+        try:
+            tier = min(2, max(0, int((q.get("tier") or ["0"])[0])))
+        except ValueError:
+            tier = 0
+        name = (q.get("name") or [""])[0]
+        if not name:
+            body: dict = {
+                "tier": tier,
+                "names": timeseries.read_disk_names(root, tier),
+            }
+        else:
+            try:
+                since = (float(q["since"][0])
+                         if q.get("since") else None)
+            except ValueError:
+                since = None
+            try:
+                limit = int((q.get("limit") or ["0"])[0])
+            except ValueError:
+                limit = 0
+            body = {
+                "name": name,
+                "tier": tier,
+                "points": timeseries.read_disk_series(
+                    root, name, tier=tier, since=since, limit=limit
+                ),
+            }
+        self._send(200, json.dumps(body).encode(), "application/json")
+
+    def _series_stream(self) -> None:
+        """Server-sent events: tails the tier file and pushes each new
+        sample payload ({"t": ..., "s": {name: value}}) as one event.
+        The dashboard's EventSource reconnects on its own, so each
+        connection is capped rather than held forever."""
+        import time as _time
+
+        from .telemetry import timeseries
+
+        root = self._series_root()
+        if root is None:
+            self._send(404, b'{"error": "no series store found"}',
+                       "application/json")
+            return
+        try:
+            tier = min(2, max(0, int(
+                (self._query.get("tier") or ["0"])[0]
+            )))
+        except ValueError:
+            tier = 0
+        tail = timeseries.SeriesTail(timeseries.series_path(root, tier))
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.end_headers()
+        try:
+            deadline = _time.monotonic() + 3600.0
+            while _time.monotonic() < deadline:
+                for payload in tail.poll():
+                    self.wfile.write(
+                        b"data: " + json.dumps(payload).encode() + b"\n\n"
+                    )
+                # Keepalive comment doubles as disconnect detection.
+                self.wfile.write(b": keepalive\n\n")
+                self.wfile.flush()
+                _time.sleep(2.0)
+        finally:
+            tail.close()
+
+    def _monitor(self) -> None:
+        """Live observatory for a `jepsen monitor` run: one sparkline
+        per stored series (pinned ones first), bootstrapped from
+        /api/series and updated over the SSE stream."""
+        root = self._series_root()
+        if root is None:
+            self._send(200, _page(
+                "monitor observatory",
+                "<p>no series store found under "
+                f"<code>{html.escape(self.store_dir)}</code> — start "
+                "one with <code>jepsen monitor --store-dir "
+                f"{html.escape(self.store_dir)}/monitor</code> or point "
+                "this page at a subdir with <code>?dir=name</code></p>",
+            ))
+            return
+        summ_html = ""
+        spath = os.path.join(root, "monitor-summary.json")
+        try:
+            with open(spath) as f:
+                summ = json.load(f)
+            rows = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(str(summ.get(k)))}</td></tr>"
+                for k in ("ops", "duration_s", "rate_measured",
+                          "ok_keys", "unknown_keys", "verdict_lag_s",
+                          "series_disk_bytes")
+                if k in summ
+            )
+            summ_html = (
+                "<h2>last completed run</h2>"
+                f"<table>{rows}</table>"
+            )
+        except (OSError, ValueError):
+            pass
+        rel = os.path.relpath(root, os.path.realpath(self.store_dir))
+        if rel == ".":
+            rel = ""
+        body = (
+            f"<p>series store: <code>{html.escape(root)}</code> · "
+            f"<a href='/api/series?dir={urllib.parse.quote(rel)}'>"
+            "series API</a> · tiers: "
+            + " ".join(
+                f"<a href='/monitor?tier={t}&dir="
+                f"{urllib.parse.quote(rel)}'>t{t}</a>"
+                for t in (0, 1, 2)
+            )
+            + "</p><div id='charts'></div>"
+            + _MONITOR_JS
+            + summ_html
+            + _slo_panel()
+        )
+        self._send(200, _page("monitor observatory", body))
 
     def _telemetry(self, rel: str) -> None:
         """Renders a run's telemetry.json (written by a

@@ -1,0 +1,395 @@
+"""`jepsen monitor` (jepsen_tpu/monitor/ + telemetry/timeseries.py):
+rolling-window online checking, the durable time-series store, and
+alert routing.
+
+The acceptance bar (ISSUE 14): per-key verdicts with window discards
+enabled are IDENTICAL to the undiscarded run — discarding a stable
+prefix may only ever shed memory, never change a verdict — and a paced
+50k-op monitor run holds resident history bounded well below the full
+history size.
+"""
+
+import json
+import os
+import threading
+import urllib.request
+
+import pytest
+
+from jepsen_tpu.history.core import Op
+from jepsen_tpu.history.packed import NO_RET, PackedBuilder
+from jepsen_tpu.models import cas_register
+from jepsen_tpu.monitor import AlertRouter, MonitorConfig, RollingChecker, run_monitor
+from jepsen_tpu.monitor.loop import _OpSource
+from jepsen_tpu.streaming.frontier import FrontierCarry
+from jepsen_tpu.telemetry import timeseries
+
+
+@pytest.fixture(scope="module")
+def pm():
+    return cas_register().packed()
+
+
+def _rolling(pm, discard, **kw):
+    kw.setdefault("bars_per_block", 16)
+    kw.setdefault("blocks_per_call", 2)
+    kw.setdefault("beam", 6)
+    kw.setdefault("advance_rows", 300)  # misaligned with K*NB=32
+    return RollingChecker(pm, discard=discard, **kw)
+
+
+def _drive(checker, n_events, *, keys=3, info_rate=0.0, seed=11):
+    src = _OpSource(keys, 3, seed, info_rate)
+    for i in range(n_events):
+        key, op = src.next_event()
+        checker.feed(key, op, float(i))
+    return checker.finish(), checker.status()
+
+
+# ---------------------------------------------------------------------------
+# Verdict parity: discard on == discard off
+# ---------------------------------------------------------------------------
+
+
+def test_discard_parity_all_ok(pm):
+    """All-OK streams discard aggressively; the verdict map must be
+    byte-identical to the undiscarded run, and at least one discard
+    must land mid-chunk (not on a K*NB advance boundary)."""
+    c1 = _rolling(pm, True)
+    src = _OpSource(3, 3, 11, 0.0)
+    mid_chunk = False
+    seen_bars = set()
+    for i in range(9000):
+        key, op = src.next_event()
+        c1.feed(key, op, float(i))
+        for ks in c1._keys.values():
+            if ks.discarded_bars and ks.discarded_bars not in seen_bars:
+                seen_bars.add(ks.discarded_bars)
+                if ks.discarded_bars % (16 * 2) != 0:
+                    mid_chunk = True
+    v1 = c1.finish()
+    s1 = c1.status()
+
+    c2 = _rolling(pm, False)
+    v2, s2 = _drive(c2, 9000)
+    assert v1 == v2 == {0: True, 1: True, 2: True}
+    assert s1["discarded-rows"] > 0
+    assert s2["discarded-rows"] == 0
+    assert s1["resident-rows"] < s2["resident-rows"]
+    assert mid_chunk, "no discard ever landed mid-chunk"
+
+
+def test_discard_parity_with_info(pm):
+    """Info ops pin the all-OK prefix (a NO_RET row is a candidate
+    entrant of every later barrier), so discards may be rare or zero —
+    but parity must still hold exactly."""
+    v1, s1 = _drive(_rolling(pm, True), 8000, info_rate=0.15, seed=5)
+    v2, s2 = _drive(_rolling(pm, False), 8000, info_rate=0.15, seed=5)
+    assert v1 == v2
+    assert s1["blocks-done"] >= 0  # both finished without dying
+
+
+def test_discard_parity_invalid_prefix(pm):
+    """A non-linearizable prefix kills the frontier; with history
+    discarded there is no post-hoc escalation, so both modes must
+    settle on "unknown" — never True, never a fabricated invalid."""
+    def run(discard):
+        c = _rolling(pm, discard, advance_rows=200)
+        bad = [
+            Op(type="invoke", f="write", value=1, process=0, index=1),
+            Op(type="ok", f="write", value=1, process=0, index=2),
+            Op(type="invoke", f="read", value=None, process=1, index=3),
+            Op(type="ok", f="read", value=2, process=1, index=4),
+        ]
+        for op in bad:
+            c.feed(0, op, 0.0)
+        src = _OpSource(1, 3, 23, 0.0)
+        for i in range(2000):
+            _, op = src.next_event()
+            c.feed(0, op, float(i))
+        return c.finish(), c.status()
+
+    v1, s1 = run(True)
+    v2, s2 = run(False)
+    assert v1 == v2 == {0: "unknown"}
+    assert s1["epoch-restarts"] >= 1
+    assert s2["epoch-restarts"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# discard_stable_prefix / rebase units
+# ---------------------------------------------------------------------------
+
+
+def _serial_builder(pm, n_pairs):
+    """n_pairs sequential write-op pairs: row i has inv=2i, ret=2i+1."""
+    b = PackedBuilder(pm.encode)
+    for i in range(n_pairs):
+        b.append(Op(type="invoke", f="write", value=i % 5, process=0,
+                    index=2 * i + 1))
+        b.append(Op(type="ok", f="write", value=i % 5, process=0,
+                    index=2 * i + 2))
+    return b
+
+
+def test_discard_prefix_renumbers_events(pm):
+    b = _serial_builder(pm, 200)
+    b.snapshot()  # settles rows into the stable region
+    rows, bars, shift = b.discard_stable_prefix(
+        bars_per_block=4, blocks_done=10
+    )
+    # Cap is (blocks_done-1)*K = 36, already 0 mod 4.
+    assert (rows, bars, shift) == (36, 36, 72)
+    assert b.n_rows == 164
+    # Surviving rows were renumbered from zero: the old row 36
+    # (inv=72, ret=73) is now (0, 1).
+    assert b._stable[0][0] == 0
+    assert b._stable[0][1] == 1
+    packed, s = b.snapshot()
+    assert packed.n == 164
+
+
+def test_discard_prefix_bails_safely(pm):
+    # blocks_done=1: the newest processed block must stay resident.
+    b = _serial_builder(pm, 50)
+    b.snapshot()
+    assert b.discard_stable_prefix(
+        bars_per_block=4, blocks_done=1
+    ) == (0, 0, 0)
+    # A pending (info-ish) invocation at the very front pins everything.
+    b2 = PackedBuilder(pm.encode)
+    b2.append(Op(type="invoke", f="write", value=9, process=7, index=1))
+    for i in range(50):
+        b2.append(Op(type="invoke", f="write", value=i % 5, process=0,
+                     index=2 * i + 2))
+        b2.append(Op(type="ok", f="write", value=i % 5, process=0,
+                     index=2 * i + 3))
+    b2.snapshot()
+    assert b2.discard_stable_prefix(
+        bars_per_block=4, blocks_done=10
+    ) == (0, 0, 0)
+    assert NO_RET in {r[1] for r in b2._rows} or b2._pending
+
+
+def test_rebase_dies_on_misalignment(pm):
+    f = FrontierCarry(pm, beam=4, bars_per_block=4, blocks_per_call=2)
+    b = _serial_builder(pm, 64)
+    packed, s = b.snapshot()
+    f.advance(packed, s)
+    assert not f.dead and f.blocks_done >= 2
+    f.rebase(3, 3)  # 3 bars is not a whole block of 4
+    assert f.dead
+
+
+# ---------------------------------------------------------------------------
+# SeriesStore: durability, rotation, tiers, torn tails
+# ---------------------------------------------------------------------------
+
+
+def test_series_store_roundtrip_and_rebuild(tmp_path):
+    d = str(tmp_path)
+    st = timeseries.SeriesStore(d)
+    for i in range(10):
+        st.append({"m.a": float(i), "m.b": 2.0 * i}, t=1000.0 + i)
+    st.close()
+    assert timeseries.read_disk_names(d) == ["m.a", "m.b"]
+    pts = timeseries.read_disk_series(d, "m.a")
+    assert [v for _, v in pts] == [float(i) for i in range(10)]
+    # A fresh store rebuilds its rings from disk.
+    st2 = timeseries.SeriesStore(d)
+    assert st2.query("m.b")[-1] == (1009.0, 18.0)
+    assert st2.resident_points() > 0
+    st2.close()
+
+
+def test_series_store_tiers_aggregate(tmp_path):
+    d = str(tmp_path)
+    st = timeseries.SeriesStore(d, tier1_s=10.0, tier2_s=100.0)
+    # Two full tier-1 buckets plus one sample to flush the second.
+    for i in range(21):
+        st.append({"m.x": float(i)}, t=1000.0 + i)
+    st.close()  # flushes open buckets
+    t1 = timeseries.read_disk_series(d, "m.x", tier=1)
+    assert len(t1) >= 2
+    # Aggregates read back as bucket means.
+    assert t1[0][1] == pytest.approx(sum(range(10)) / 10.0)
+
+
+def test_series_store_rotation(tmp_path):
+    d = str(tmp_path)
+    st = timeseries.SeriesStore(d, max_tier_bytes=600)
+    for i in range(60):
+        st.append({"m.r": float(i)}, t=1000.0 + i)
+    st.close()
+    assert os.path.exists(timeseries.series_path(d, 0) + ".1")
+    # Disk reads span the rotated generation plus the current file,
+    # oldest first.
+    pts = timeseries.read_disk_series(d, "m.r")
+    vals = [v for _, v in pts]
+    assert vals == sorted(vals) and len(vals) > 10
+
+
+def test_series_store_truncates_torn_tail(tmp_path):
+    d = str(tmp_path)
+    st = timeseries.SeriesStore(d)
+    st.append({"m.t": 1.0}, t=1000.0)
+    st.close()
+    p = timeseries.series_path(d, 0)
+    with open(p, "ab") as f:
+        f.write(b"\x09\x00\x00\x00TORN-TAIL-GARBAGE")
+    # Readers stop at the tear...
+    assert [v for _, v in timeseries.read_disk_series(d, "m.t")] == [1.0]
+    # ...and a restarted writer truncates it before appending.
+    st2 = timeseries.SeriesStore(d)
+    st2.append({"m.t": 2.0}, t=1001.0)
+    st2.close()
+    assert b"TORN" not in open(p, "rb").read()
+    assert [v for _, v in timeseries.read_disk_series(d, "m.t")] == [1.0, 2.0]
+
+
+def test_series_tail_follows_appends(tmp_path):
+    d = str(tmp_path)
+    st = timeseries.SeriesStore(d)
+    st.append({"m.s": 1.0}, t=1000.0)
+    tail = timeseries.SeriesTail(timeseries.series_path(d, 0))
+    assert tail.poll() == []  # history swallowed at open
+    st.append({"m.s": 2.0}, t=1001.0)
+    got = tail.poll()
+    assert len(got) == 1 and got[0]["s"] == {"m.s": 2.0}
+    tail.close()
+    st.close()
+
+
+def test_quantile_rings_and_prometheus_export():
+    from jepsen_tpu import telemetry
+
+    timeseries.reset_rings()
+    for i in range(100):
+        timeseries.observe("test.lag", float(i))
+    q = timeseries.quantiles("test.lag")
+    assert q["p50"] <= q["p95"] <= q["p99"]
+    assert q["p95"] == pytest.approx(94.0, abs=2.0)
+    g = timeseries.quantile_gauges()
+    assert "test.lag.p95" in g
+    text = telemetry.prometheus_text()
+    assert 'jepsen_test_lag_dist{quantile="0.95"}' in text
+    assert "# TYPE jepsen_test_lag_dist summary" in text
+    timeseries.reset_rings()
+
+
+# ---------------------------------------------------------------------------
+# Alert routing
+# ---------------------------------------------------------------------------
+
+
+def _transition(rec, rule="r1", value=1.0, t=100.0):
+    return {"rec": rec, "rule": rule, "kind": "gauge-above",
+            "target": "g", "threshold": 0.5, "value": value, "t": t}
+
+
+def test_alert_router_dedup_and_clear(tmp_path):
+    sink = str(tmp_path / "alerts.jsonl")
+    # Evidence to attach: a forensics file under the store root.
+    fdir = tmp_path / "forensics"
+    fdir.mkdir()
+    (fdir / "dossier.json").write_text("{}")
+    r = AlertRouter((f"file:{sink}",), store_dir=str(tmp_path),
+                    dedup_s=60.0, renotify_s=300.0)
+    r.route([_transition("firing")], now=100.0)
+    r.route([_transition("firing")], now=120.0)  # deduped
+    r.route([_transition("cleared", value=0.0)], now=140.0)
+    events = [json.loads(x) for x in open(sink)]
+    assert [e["rec"] for e in events] == ["firing", "cleared"]
+    assert events[0]["dossier"].endswith("dossier.json")
+    st = r.status()
+    assert st["rules"]["r1"]["firing"] is False
+
+
+def test_alert_router_renotify(tmp_path):
+    sink = str(tmp_path / "alerts.jsonl")
+    r = AlertRouter((f"file:{sink}",), store_dir=str(tmp_path),
+                    dedup_s=10.0, renotify_s=50.0)
+    r.route([_transition("firing")], now=100.0)
+    r.tick({"r1": 1.0}, now=120.0)   # inside renotify window: nothing
+    r.tick({"r1": 1.0}, now=160.0)   # past it: renotified
+    events = [json.loads(x) for x in open(sink)]
+    assert len(events) == 2
+    assert events[1].get("renotify") is True
+
+
+def test_alert_router_rejects_bad_sink(tmp_path):
+    r = AlertRouter(("carrier-pigeon:coop",), store_dir=str(tmp_path))
+    assert r.sinks == []
+
+
+# ---------------------------------------------------------------------------
+# The paced monitor run: memory ceiling + alert round trip + web API
+# ---------------------------------------------------------------------------
+
+
+def test_monitor_memory_ceiling(tmp_path):
+    """Paced 50k-op run: resident history must stay far below the full
+    history size (discards are doing their job) and the resident-bytes
+    gauge must not trend upward across the run."""
+    cfg = MonitorConfig(
+        store_dir=str(tmp_path), rate=200000.0, max_ops=50000,
+        duration_s=0.0, cadence_s=0.3, keys=4, advance_rows=2048,
+        bars_per_block=64, blocks_per_call=4,
+    )
+    summary = run_monitor(cfg)
+    assert summary["ops"] >= 50000
+    assert summary["ok_keys"] == 4 and summary["unknown_keys"] == 0
+    assert summary["checker"]["discarded-rows"] > 10000
+    # ~50k rows total were ingested; resident must stay well under half.
+    assert summary["checker"]["resident-rows"] < 25000
+    pts = timeseries.read_disk_series(
+        str(tmp_path), "monitor.resident-rows"
+    )
+    assert pts and max(v for _, v in pts) < 25000
+
+
+def test_monitor_alert_roundtrip(tmp_path):
+    """One injected SLO: fire -> single deduped sink delivery with the
+    forensics dossier attached -> clear."""
+    sink = str(tmp_path / "alerts.jsonl")
+    cfg = MonitorConfig(
+        store_dir=str(tmp_path), rate=4000.0, max_ops=4000,
+        cadence_s=0.3, keys=2, advance_rows=512, inject_slo_s=0.5,
+        sinks=(f"file:{sink}",),
+    )
+    summary = run_monitor(cfg)
+    events = [json.loads(x) for x in open(sink)]
+    recs = [(e["rec"], e["rule"]) for e in events]
+    assert recs.count(("firing", "monitor-injected")) == 1
+    assert recs.count(("cleared", "monitor-injected")) == 1
+    firing = next(e for e in events if e["rec"] == "firing")
+    assert firing["dossier"] and os.path.isfile(firing["dossier"])
+    assert summary["alerts"]["rules"]["monitor-injected"]["firing"] is False
+
+
+def test_web_series_api(tmp_path):
+    from jepsen_tpu import web
+
+    st = timeseries.SeriesStore(str(tmp_path))
+    for i in range(5):
+        st.append({"monitor.verdict-lag-s": float(i)}, t=1000.0 + i)
+    st.close()
+    srv = web.make_server(str(tmp_path), port=0)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        def get(path):
+            return urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=5
+            ).read()
+
+        names = json.loads(get("/api/series"))["names"]
+        assert "monitor.verdict-lag-s" in names
+        d = json.loads(get(
+            "/api/series?name=monitor.verdict-lag-s&limit=3"
+        ))
+        assert [v for _, v in d["points"]] == [2.0, 3.0, 4.0]
+        page = get("/monitor").decode()
+        assert "EventSource" in page and "series store" in page
+    finally:
+        srv.shutdown()

@@ -1,0 +1,249 @@
+#!/usr/bin/env python
+"""CI smoke for `jepsen monitor` (tier1.yml step).
+
+Phase 1 — durable observatory across SIGKILL: a real monitor
+subprocess runs paced against a store dir until the time-series store
+holds samples, then takes a SIGKILL mid-cadence and gets a garbage
+torn tail appended on top.  Readers must stop cleanly at the tear, a
+restarted monitor on the SAME store must truncate the garbage and
+keep appending, and its embedded dashboard must serve the pre-kill
+samples over /api/series plus a live SSE payload — one continuous
+series across the crash.
+
+Phase 2 — alert round trip + constant memory (the acceptance
+criterion): an in-process run with --inject-slo fires a synthetic SLO
+that must reach a file sink exactly once (deduped) with a forensics
+dossier attached, then clear; every key's verdict stays proven; and
+the resident-history gauge stays flat — rolling-window discards hold
+resident rows under a ceiling a full-retention run would blow
+through.
+
+Exit 0 + "PASS" on success, exit 1 with a reason otherwise.  CPU-only:
+the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.monitor import MonitorConfig, run_monitor  # noqa: E402
+from jepsen_tpu.telemetry.timeseries import (  # noqa: E402
+    read_disk_series,
+    series_path,
+)
+
+SERIES = "monitor.resident-history-bytes"
+TORN = b"\x00\x17GARBAGE-TORN-TAIL-NOT-A-BLOCK"
+
+
+class Failure(Exception):
+    pass
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def start_monitor(store: str, duration: float, port=None) -> subprocess.Popen:
+    cmd = [sys.executable, "-m", "jepsen_tpu.suites.kvdb", "monitor",
+           "--store-dir", store, "--rate", "400", "--duration",
+           str(duration), "--keys", "3", "--procs-per-key", "2",
+           "--cadence", "1"]
+    if port is not None:
+        cmd += ["--serve-port", str(port)]
+    return subprocess.Popen(cmd)
+
+
+def stop(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def wait_samples(store: str, proc: subprocess.Popen, n: int,
+                 deadline_s: float = 90.0) -> list:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise Failure(f"monitor exited early rc={proc.returncode}")
+        pts = read_disk_series(store, SERIES)
+        if len(pts) >= n:
+            return pts
+        time.sleep(0.5)
+    raise Failure(f"{SERIES} never reached {n} samples in the store")
+
+
+def wait_listening(port: int, proc: subprocess.Popen,
+                   deadline_s: float = 60.0) -> None:
+    deadline = time.monotonic() + deadline_s
+    while True:
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=1):
+                return
+        except OSError:
+            if proc.poll() is not None:
+                raise Failure(
+                    f"restarted monitor exited early rc={proc.returncode}")
+            if time.monotonic() > deadline:
+                raise Failure("dashboard never started listening")
+            time.sleep(0.2)
+
+
+def fetch(url: str) -> bytes:
+    return urllib.request.urlopen(url, timeout=15).read()
+
+
+def read_sse_payload(url: str, deadline_s: float = 30.0) -> dict:
+    """First `data:` payload off the stream — the monitor's 1 s cadence
+    guarantees a fresh block well inside the deadline."""
+    resp = urllib.request.urlopen(url, timeout=deadline_s)
+    deadline = time.monotonic() + deadline_s
+    try:
+        while time.monotonic() < deadline:
+            line = resp.readline()
+            if line.startswith(b"data:"):
+                return json.loads(line[5:].strip())
+    finally:
+        resp.close()
+    raise Failure("SSE stream produced no data payload before deadline")
+
+
+def phase_crash_durability(tmp: str) -> str:
+    store = os.path.join(tmp, "store")
+    proc = start_monitor(store, duration=120.0)
+    try:
+        pts = wait_samples(store, proc, n=3)
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        stop(proc)
+    t_kill = max(t for t, _ in pts)
+
+    # A SIGKILL can land mid-write; make the torn tail certain.
+    t0_file = series_path(store)
+    with open(t0_file, "ab") as f:
+        f.write(TORN)
+    survivors = read_disk_series(store, SERIES)
+    if len(survivors) < len(pts):
+        raise Failure(f"reader lost samples at the tear: "
+                      f"{len(survivors)} < {len(pts)}")
+
+    port = free_port()
+    proc = start_monitor(store, duration=25.0, port=port)
+    try:
+        wait_listening(port, proc)
+        # The restarted writer must have truncated the garbage before
+        # appending its first block.
+        wait_samples(store, proc, n=len(pts) + 2)
+        with open(t0_file, "rb") as f:
+            if TORN in f.read():
+                raise Failure("torn tail survived the restart")
+        merged = read_disk_series(store, SERIES)
+        before = [t for t, _ in merged if t <= t_kill]
+        after = [t for t, _ in merged if t > t_kill]
+        if len(before) < len(pts) or not after:
+            raise Failure(f"series not continuous across restart: "
+                          f"{len(before)} pre-kill + {len(after)} post")
+
+        base = f"http://127.0.0.1:{port}"
+        names = json.loads(fetch(f"{base}/api/series"))["names"]
+        if SERIES not in names:
+            raise Failure(f"/api/series names missing {SERIES}")
+        served = json.loads(
+            fetch(f"{base}/api/series?name={SERIES}"))["points"]
+        if min(t for t, _ in served) > t_kill:
+            raise Failure("dashboard lost the pre-kill history")
+        page = fetch(f"{base}/monitor").decode()
+        if "EventSource" not in page or SERIES not in page:
+            raise Failure("/monitor page missing the live-series wiring")
+        payload = read_sse_payload(f"{base}/api/series/stream")
+        if not payload.get("s"):
+            raise Failure(f"SSE payload carried no samples: {payload}")
+
+        rc = proc.wait(timeout=90)
+        if rc != 0:
+            raise Failure(f"restarted monitor exited rc={rc}")
+    finally:
+        stop(proc)
+    summary = json.load(open(os.path.join(store, "monitor-summary.json")))
+    if summary["unknown_keys"] != 0:
+        raise Failure(f"restarted run left unknown keys: {summary}")
+    return (f"crash-durability: {len(before)} pre-kill + {len(after)} "
+            f"post-restart samples in one series, torn tail truncated, "
+            f"dashboard + SSE served both sides of the crash")
+
+
+def phase_alert_and_memory(tmp: str) -> str:
+    store = os.path.join(tmp, "inproc")
+    alerts = os.path.join(tmp, "alerts.jsonl")
+    cfg = MonitorConfig(
+        store_dir=store, rate=20000.0, duration_s=6.0, keys=4,
+        procs_per_key=4, cadence_s=0.3, advance_rows=2048,
+        inject_slo_s=1.0, sinks=(f"file:{alerts}",),
+    )
+    summary = run_monitor(cfg)
+    if summary["ok_keys"] != 4 or summary["unknown_keys"] != 0:
+        raise Failure(f"verdicts not all proven: {summary['verdicts']}")
+    status = summary["checker"]
+    if status["discarded-rows"] <= 0:
+        raise Failure("no rolling-window discards landed")
+
+    events = [json.loads(ln) for ln in open(alerts) if ln.strip()]
+    firing = [e for e in events
+              if e.get("rule") == "monitor-injected"
+              and e.get("rec") == "firing" and not e.get("renotify")]
+    cleared = [e for e in events
+               if e.get("rule") == "monitor-injected"
+               and e.get("rec") == "cleared"]
+    if len(firing) != 1:
+        raise Failure(f"expected exactly 1 deduped firing, got "
+                      f"{len(firing)}: {firing}")
+    if len(cleared) != 1:
+        raise Failure(f"expected exactly 1 cleared, got {len(cleared)}")
+    dossier = firing[0].get("dossier")
+    if not dossier or not os.path.exists(dossier):
+        raise Failure(f"firing alert missing its dossier: {dossier!r}")
+    if not firing[0].get("postmortem"):
+        raise Failure("firing alert missing its flight postmortem")
+
+    rows = [v for _, v in read_disk_series(store, "monitor.resident-rows")]
+    if not rows:
+        raise Failure("monitor.resident-rows series is empty")
+    # ~50k+ rows flowed through; a full-retention run holds them all.
+    if max(rows) >= 25000:
+        raise Failure(f"resident-rows gauge not flat: peak {max(rows)}")
+    return (f"alert+memory: 1 deduped firing (dossier attached) + 1 "
+            f"cleared through the file sink, {summary['ops']} ops with "
+            f"{status['discarded-rows']} rows discarded, resident peak "
+            f"{max(rows)} rows")
+
+
+def run() -> int:
+    tmp = tempfile.mkdtemp(prefix="monitor-smoke-")
+    try:
+        msg2 = phase_alert_and_memory(tmp)
+        print(f"  {msg2}")
+        msg1 = phase_crash_durability(tmp)
+        print(f"  {msg1}")
+    except Failure as e:
+        print(f"FAIL: {e}")
+        return 1
+    print("PASS: monitor store survives SIGKILL with a continuous "
+          "served series, alerts round-trip with evidence, memory flat")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(run())
