@@ -11,15 +11,32 @@ properties *declared and machine-checkable* — the TVM lesson
 (PAPERS.md): passes compose safely because their invariants are
 checked, not remembered.
 
-Three rule families (``rules/``):
+Since v2 the analysis is **interprocedural**: ``effects.py`` builds
+per-function effect summaries (locks acquired, journal appends,
+flush/fsync, frame sends, ``self`` attribute reads/writes with the
+held-lock stack, swallowed exceptions) over a resolved intra-repo call
+graph (self-methods, imports, class-qualified calls, typed locals via
+constructor/annotation, and a unique-method dynamic-dispatch
+fallback), with a bounded fixpoint for transitive effects — so an
+append and its fsync, or a lock and the helper it protects, may live
+in different functions or modules and still be matched up.
+
+Four rule families (``rules/``):
 
   * ``device``      — JAX/device hygiene: unguarded narrowing casts,
     host syncs inside jit-traced code, ``np``/``jnp`` mixing in traced
     functions, device passes outside ``profile.capture``;
-  * ``concurrency`` — a module-level lock-order graph built from
-    ``with lock:`` / ``acquire()`` nesting (cycles are errors), plus
-    attributes written from a thread entry point and read elsewhere
-    with no common lock;
+  * ``concurrency`` — a cross-module lock-order graph from the effect
+    summaries (cycles are errors), checked ``# guarded-by:`` contracts
+    (annotated at the attribute's birth or inferred for
+    thread-spawning classes, verified through the call graph), plus
+    the unsynced-thread-attr advice fallback;
+  * ``durability``  — the crash-durability protocol at every journal
+    site: appends that no function or caller path ever fsyncs,
+    replies/sends reachable before the append's fsync, ``_read_block``
+    results never None-checked (None IS the torn tail), read-back
+    ``.json`` state written without tmp+``os.replace``, and
+    ``BLOCK_*``/``F_*`` wire-id collisions;
   * ``protocol``    — framework contracts: ledger intent before
     session mutation, compensator ctypes that exist in the ledger
     registry, telemetry counter names inside the declared namespaces
@@ -28,9 +45,10 @@ Three rule families (``rules/``):
 
 Infrastructure (``core.py``): a ``Finding`` model with severity,
 ``# jepsenlint: ignore[rule] -- reason`` suppressions (a reason is
-mandatory), a committed ``lint_baseline.json`` of accepted findings
-with written justifications, JSON + human output, and a <30 s
-full-repo runtime contract.  Run it as ``jepsen lint``, via
+mandatory, and a pragma matching nothing is itself an error), a
+committed ``lint_baseline.json`` of accepted findings with written
+justifications, JSON + human + SARIF 2.1.0 output (``--sarif``), and
+a <10 s full-repo runtime contract.  Run it as ``jepsen lint``, via
 ``tools/lint.py``, or ``python -m jepsen_tpu.analysis``.
 """
 

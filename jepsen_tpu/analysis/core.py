@@ -3,8 +3,9 @@
 The analyzer is a pure-``ast`` pass over the repo's own sources — no
 imports of the analyzed code, no third-party dependencies — so it runs
 identically in CI, under pytest, and on a laptop with no JAX installed.
-The whole-repo contract is <30 s; in practice a full parse+analyze of
-~200 files is well under 2 s.
+The whole-repo contract is <10 s (including the interprocedural
+effect-summary build in effects.py); in practice a full parse+analyze
+of ~200 files is well under 3 s.
 
 Three moving parts:
 
@@ -26,6 +27,7 @@ Three moving parts:
 from __future__ import annotations
 
 import ast
+import gc
 import hashlib
 import json
 import os
@@ -40,21 +42,31 @@ from typing import Any, Callable, Iterable, Optional
 SEVERITIES = ("error", "warning", "advice")
 
 #: Whole-repo runtime contract (seconds); run_lint records its own
-#: duration and test_analysis asserts against this.
-RUNTIME_BUDGET_S = 30.0
+#: duration and test_analysis asserts against this.  Tightened from
+#: 30 s when the interprocedural analyzer landed: the tier-1 gate cost
+#: must stay negligible even with effect summaries in the loop.
+RUNTIME_BUDGET_S = 10.0
 
 BASELINE_FILE = "lint_baseline.json"
 
-#: Suppression pragma: ``# jepsenlint: ignore[rule, family] -- reason``
-#: (``:`` also accepted before the reason).  Applies to its own line
-#: and the line below, so it can sit above a long expression.
+#: Suppression pragma — the whole comment, nothing before it:
+#: ``jepsenlint: ignore[rule, family] -- reason`` (``:`` also accepted
+#: before the reason).  Applies to its own line and the line below, so
+#: it can sit above a long expression.  Anchored at the comment start
+#: so prose *about* the pragma syntax (like this very comment) never
+#: parses as a suppression.
 _PRAGMA_RE = re.compile(
-    r"#\s*jepsenlint:\s*ignore\[([^\]]*)\]\s*(?:(?:--|:)\s*(\S.*))?\s*$"
+    r"^#+:?\s*jepsenlint:\s*ignore\[([^\]]*)\]\s*"
+    r"(?:(?:--|:)\s*(\S.*))?\s*$"
 )
 
 #: Directories never scanned (generated, vendored, or test fixtures
-#: that violate rules on purpose).
-_SKIP_DIRS = {"__pycache__", ".git", "tests", "store"}
+#: that violate rules on purpose).  Note jepsen_tpu/store/ — the
+#: framed-file format module — IS scanned: the durability family's
+#: block-id collision rule needs its BLOCK_* constants.  The repo-root
+#: store/ data directory never enters the walk (the default roots are
+#: jepsen_tpu/, tools/, bench.py) and holds no .py files anyway.
+_SKIP_DIRS = {"__pycache__", ".git", "tests"}
 
 
 @dataclass(frozen=True)
@@ -258,10 +270,31 @@ class Suppression:
     used: bool = False
 
 
+def _comment_lines(module: Module) -> dict[int, str]:
+    """{line: comment text} from real COMMENT tokens — a pragma quoted
+    inside a docstring or f-string (docs showing the syntax) must not
+    parse as a suppression, or the unused-suppression rule flags the
+    documentation."""
+    import io
+    import tokenize
+
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(
+                io.StringIO(module.source).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        # Fall back to raw lines on tokenizer trouble; the module
+        # parsed, so this is vanishingly rare.
+        return dict(enumerate(module.lines, start=1))
+    return out
+
+
 def parse_suppressions(module: Module) -> list[Suppression]:
     out = []
-    for i, text in enumerate(module.lines, start=1):
-        m = _PRAGMA_RE.search(text)
+    for i, text in sorted(_comment_lines(module).items()):
+        m = _PRAGMA_RE.match(text.strip())
         if not m:
             continue
         rules = tuple(
@@ -354,6 +387,17 @@ class LintReport:
             out[f.severity] = out.get(f.severity, 0) + 1
         return out
 
+    def family_counts(
+        self, which: Optional[list[Finding]] = None
+    ) -> dict:
+        """{family: {severity: count}} — the shape behind the
+        jepsen_lint_findings{family,severity} gauges."""
+        out: dict[str, dict] = {}
+        for f in (self.all_findings if which is None else which):
+            fam = out.setdefault(f.family, {s: 0 for s in SEVERITIES})
+            fam[f.severity] = fam.get(f.severity, 0) + 1
+        return out
+
     @property
     def clean(self) -> bool:
         return not self.findings
@@ -402,8 +446,17 @@ def run_lint(
     families: Optional[Iterable[str]] = None,
 ) -> LintReport:
     t0 = time.perf_counter()
-    modules = load_modules(root, paths)
-    raw = analyze_modules(modules, families)
+    # The batch allocates millions of AST/summary objects and frees
+    # almost nothing until it returns — generational gc passes over
+    # that live heap are pure overhead (~20% of the runtime budget).
+    gc_was_on = gc.isenabled()
+    gc.disable()
+    try:
+        modules = load_modules(root, paths)
+        raw = analyze_modules(modules, families)
+    finally:
+        if gc_was_on:
+            gc.enable()
 
     # Suppressions: a matching pragma with a reason silences the
     # finding; a matching pragma WITHOUT a reason converts it into a
@@ -429,6 +482,25 @@ def run_lint(
                 message=f"ignore[{f.rule}] pragma has no reason; write "
                         f"`# jepsenlint: ignore[{f.rule}] -- why`",
             ))
+    # A reasoned pragma that matches nothing is debt pretending to be
+    # documentation: the code it silenced was fixed (or the rule id is
+    # wrong) and the pragma now silences whatever lands on that line
+    # next.  Only meaningful on a full run — a subset of paths or
+    # families legitimately leaves pragmas unmatched.
+    if paths is None and families is None:
+        for m in modules:
+            for s in supps.get(m.rel, []):
+                if not s.used:
+                    kept.append(Finding(
+                        rule="lint.unused-suppression",
+                        severity="error", path=m.rel, line=s.line,
+                        symbol="<module>",
+                        message=(
+                            f"ignore[{', '.join(s.rules)}] pragma "
+                            "matches no finding — the debt it "
+                            "documented is gone; delete the pragma"
+                        ),
+                    ))
     kept = assign_fingerprints(kept)
 
     bl_path = baseline or baseline_path(root)
@@ -522,6 +594,7 @@ def write_store_summary(report: LintReport, store_dir: str) -> Optional[str]:
             "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
             "clean": report.clean,
             "counts": report.counts(),
+            "families": report.family_counts(),
             "unbaselined": len(report.findings),
             "baselined": len(report.baselined),
             "suppressed": len(report.suppressed),
@@ -529,9 +602,17 @@ def write_store_summary(report: LintReport, store_dir: str) -> Optional[str]:
             "duration_s": round(report.duration_s, 3),
             "files": report.files,
         }
-        with open(path, "w", encoding="utf-8") as f:
+        # Atomic: the web /fleet page and /metrics scrape read this
+        # back from another process — a torn lint.json must never be
+        # observable (durability.non-atomic-checkpoint, eating our
+        # own dogfood).
+        tmp = path + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
             json.dump(payload, f, indent=2, sort_keys=True)
             f.write("\n")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
         return path
     except OSError:
         return None
@@ -570,7 +651,13 @@ def add_lint_args(p: Any) -> None:
     )
     p.add_argument(
         "--families", default=None,
-        help="comma-separated rule families (device,concurrency,protocol)",
+        help="comma-separated rule families "
+        "(device,concurrency,durability,protocol)",
+    )
+    p.add_argument(
+        "--sarif", default=None, metavar="PATH",
+        help="also write the unbaselined findings as SARIF 2.1.0 "
+        "(for CI PR annotation); exit code is unchanged",
     )
     p.add_argument(
         "--write-counters", nargs="?", const="doc/counters.md",
@@ -636,6 +723,14 @@ def main(opts: Any) -> int:
         print(f"baseline rewritten: {bl_path} "
               f"({len(report.all_findings)} entries)")
         return 0
+
+    sarif_path = getattr(opts, "sarif", None)
+    if sarif_path:
+        from . import sarif
+
+        if not os.path.isabs(sarif_path):
+            sarif_path = os.path.join(root, sarif_path)
+        sarif.write_sarif(report, sarif_path)
 
     store_dir = getattr(opts, "lint_store_dir", None)
     if store_dir:

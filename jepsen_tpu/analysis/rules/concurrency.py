@@ -1,23 +1,23 @@
-"""Concurrency discipline: lock-order cycles, unsynced thread state.
+"""Concurrency discipline: lock-order cycles, guarded-by contracts,
+unsynced thread state.
 
 ~30 modules in this repo spawn threads: the interpreter's
 worker-abandon protocol (lock + push-counter), the streaming front/back
 buffer swap, the checkerd scheduler's condition queue, the health
 monitor's probe loop, telemetry's registry lock with the span-exit hook
-chained through profile captures.  None of their lock discipline was
-machine-checked before this rule family.
+chained through profile captures.  This family machine-checks their
+lock discipline over the shared interprocedural effect summaries
+(analysis/effects.py).
 
-``concurrency.lock-order-cycle`` (error) builds a **module-level
+``concurrency.lock-order-cycle`` (error) builds a **cross-module
 lock-order graph**: every ``with lock:`` / ``lock.acquire()`` defines
 an acquisition scope; acquiring M while holding L adds the edge L→M.
-Calls made while holding a lock propagate through a resolved
-intra-repo call graph (``self.m`` → same class, bare names → same
-module, imported names → the imported module), so the telemetry
-span-exit hook chain — a pass holding its own lock calling
-``telemetry.count`` which takes ``telemetry._lock`` — contributes its
-edges without any annotation.  A cycle in the graph is a deadlock that
-needs only the right interleaving; reentrant self-edges (RLock /
-Condition) are exempt.
+Calls made while holding a lock propagate through the program's
+resolved call graph — including cross-module edges and the
+unique-method dynamic-dispatch fallback — so the telemetry span-exit
+hook chain contributes its edges without any annotation.  A cycle in
+the graph is a deadlock that needs only the right interleaving;
+reentrant self-edges (RLock / Condition) are exempt.
 
 Lock identity is scoped to where the lock object lives: module-level
 creations get ``module.NAME``, instance attributes
@@ -25,14 +25,28 @@ creations get ``module.NAME``, instance attributes
 so two unrelated local ``lock`` variables never alias into a false
 cycle.
 
-``concurrency.unsynced-thread-attr`` (advice) flags instance
-attributes *written inside a ``threading.Thread(target=...)`` entry
-method* and read from other methods with **no common lock** between
-the write sites and the read sites.  That is exactly the shape of a
-torn-state bug between a daemon thread and its controlling API
-(stop flags get a pass: single-word stores the reader re-checks are
-the repo's sanctioned idiom and belong in the baseline with that
-justification, not silently exempted here).
+``concurrency.guarded-by`` (error) is the checked contract that PR 13's
+ad-hoc locking fixes graduate into.  Declare it where the state is
+born::
+
+    self._tickets = {}   # guarded-by: self._lock
+
+and every read or write of ``self._tickets`` anywhere in the class must
+then happen while ``self._lock`` is held — directly, or because every
+resolved caller of the accessing method holds it at the call site (the
+private-helper-under-lock idiom), checked as a fixpoint over the call
+graph.  ``__init__`` is exempt (construction happens-before
+publication), and so are helpers reachable only from ``__init__``.
+The same contract is **inferred** for thread-spawning classes whose
+attribute writes all happen under one common lock: the writes declare
+the protocol, the reads are held to it.
+
+``concurrency.unsynced-thread-attr`` (advice) remains the fallback for
+attributes with *no* lock discipline to infer: written inside a
+``threading.Thread(target=...)`` entry and read from other methods
+with no common lock between write and read sites.  Attributes covered
+by a guarded-by contract (annotated or inferred) are checked by the
+contract instead, not double-reported here.
 """
 
 from __future__ import annotations
@@ -41,12 +55,24 @@ import ast
 from typing import Optional
 
 from ..core import Finding, Module
+from .. import effects
+from ..effects import Event, Key, LockScope, Program, import_map
+
+# Older import sites (rules/device.py) use the leading-underscore
+# names this module exported before the machinery moved to effects.py.
+_Scope = LockScope
+_import_map = import_map
 
 RULES = {
     "concurrency.lock-order-cycle": (
         "error",
         "cycle in the cross-module lock-order graph (deadlock by "
         "interleaving)",
+    ),
+    "concurrency.guarded-by": (
+        "error",
+        "attribute with a guarded-by contract accessed without the "
+        "declared lock held",
     ),
     "concurrency.unsynced-thread-attr": (
         "advice",
@@ -55,238 +81,10 @@ RULES = {
     ),
 }
 
-_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore",
-               "BoundedSemaphore"}
-_REENTRANT_CTORS = {"RLock", "Condition"}
 
-
-def _lockish_text(seg: str) -> bool:
-    low = seg.lower()
-    return ("lock" in low or "cond" in low or "sem" in low) and \
-        "clock" not in low
-
-
-class _Scope:
-    """Lock creations and usages for one module."""
-
-    def __init__(self, m: Module):
-        self.m = m
-        # (scope-symbol or "", name) -> reentrant?
-        self.created: dict[tuple[str, str], bool] = {}
-        self._collect()
-
-    def _collect(self) -> None:
-        for node in ast.walk(self.m.tree):
-            if not isinstance(node, ast.Assign):
-                continue
-            ctor = self._ctor_of(node.value)
-            if ctor is None:
-                continue
-            reentrant = ctor in _REENTRANT_CTORS
-            fn = self.m.enclosing_function(node)
-            for tgt in node.targets:
-                if isinstance(tgt, ast.Name):
-                    scope = self.m.symbol(node) if fn is not None else ""
-                    self.created[(scope, tgt.id)] = reentrant
-                elif (isinstance(tgt, ast.Attribute)
-                      and isinstance(tgt.value, ast.Name)
-                      and tgt.value.id == "self"):
-                    cls = self.m.enclosing_class(node)
-                    if cls is not None:
-                        self.created[(cls.name, tgt.attr)] = reentrant
-
-    def _ctor_of(self, value: ast.AST) -> Optional[str]:
-        # `threading.Lock()`, `Lock()`, and the `x or threading.Lock()`
-        # defaulting idiom all count as creations.
-        if isinstance(value, ast.BoolOp):
-            for v in value.values:
-                c = self._ctor_of(v)
-                if c:
-                    return c
-            return None
-        if not isinstance(value, ast.Call):
-            return None
-        f = value.func
-        name = f.attr if isinstance(f, ast.Attribute) else (
-            f.id if isinstance(f, ast.Name) else None)
-        return name if name in _LOCK_CTORS else None
-
-    def resolve(self, node: ast.AST,
-                expr: ast.AST) -> Optional[tuple[str, bool]]:
-        """(lock-id, reentrant) for a with-item / acquire target, or
-        None when the expression isn't a lock."""
-        # Unwrap `self._lock.read()` / `.write()` style wrappers.
-        if isinstance(expr, ast.Call):
-            expr = expr.func
-            if isinstance(expr, ast.Attribute):
-                expr = expr.value
-        m = self.m
-        if (isinstance(expr, ast.Attribute)
-                and isinstance(expr.value, ast.Name)
-                and expr.value.id == "self"):
-            cls = m.enclosing_class(node)
-            cname = cls.name if cls is not None else "?"
-            key = (cname, expr.attr)
-            if key in self.created:
-                return (f"{m.name}.{cname}.{expr.attr}",
-                        self.created[key])
-            if _lockish_text(expr.attr):
-                return (f"{m.name}.{cname}.{expr.attr}", False)
-            return None
-        if isinstance(expr, ast.Name):
-            # Innermost creating scope wins: function-local locks are
-            # distinct per function, closures see their definer.
-            fn = m.enclosing_function(node)
-            while fn is not None:
-                key = (m.symbol(fn), expr.id)
-                if key in self.created:
-                    return (f"{m.name}.{key[0]}.{expr.id}",
-                            self.created[key])
-                fn = m.enclosing_function(fn)
-            if ("", expr.id) in self.created:
-                return (f"{m.name}.{expr.id}",
-                        self.created[("", expr.id)])
-            if _lockish_text(expr.id):
-                sym = m.symbol(node)
-                scoped = sym if sym != "<module>" else ""
-                return (f"{m.name}{'.' + scoped if scoped else ''}"
-                        f".{expr.id}", False)
-            return None
-        seg = m.seg(expr)
-        if _lockish_text(seg.split("(")[0].split("[")[0]):
-            return (f"{m.name}.{seg.split('(')[0]}", False)
-        return None
-
-
-def _import_map(m: Module) -> dict[str, str]:
-    """alias -> dotted target ("telemetry" -> "jepsen_tpu.telemetry",
-    "_count" -> "jepsen_tpu.telemetry.count", ...)."""
-    out: dict[str, str] = {}
-    pkg_parts = m.name.split(".")
-    for node in ast.walk(m.tree):
-        if isinstance(node, ast.Import):
-            for a in node.names:
-                out[a.asname or a.name.split(".")[0]] = a.name
-        elif isinstance(node, ast.ImportFrom):
-            if node.level:
-                base = pkg_parts[: len(pkg_parts) - node.level]
-            else:
-                base = []
-            mod = ".".join(base + ([node.module] if node.module else []))
-            for a in node.names:
-                if a.name == "*":
-                    continue
-                out[a.asname or a.name] = (
-                    f"{mod}.{a.name}" if mod else a.name
-                )
-    return out
-
-
-class _FnInfo:
-    __slots__ = ("key", "module", "acquires", "calls_under",
-                 "calls_all")
-
-    def __init__(self, key: tuple[str, str], module: Module):
-        self.key = key
-        self.module = module
-        self.acquires: set[str] = set()       # direct lock ids
-        # [(held-tuple, callee-text, line)]
-        self.calls_under: list[tuple[tuple[str, ...], str, int]] = []
-        self.calls_all: list[str] = []        # every callee text
-
-
-def _walk_function(m: Module, scope: _Scope, fn: ast.FunctionDef,
-                   info: _FnInfo,
-                   edges: dict[tuple[str, str], tuple[str, int, str]],
-                   reentrant: set[str]) -> None:
-    """Single in-order pass tracking the held-lock stack.  Nested
-    function bodies are skipped (they run later, not under the lock)."""
-
-    def visit(node: ast.AST, held: tuple[str, ...]) -> None:
-        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
-                             ast.Lambda)) and node is not fn:
-            return
-        if isinstance(node, ast.With):
-            acquired: list[str] = []
-            for item in node.items:
-                r = scope.resolve(node, item.context_expr)
-                if r is None:
-                    continue
-                lock, re_ok = r
-                if re_ok:
-                    reentrant.add(lock)
-                info.acquires.add(lock)
-                for h in held:
-                    edges.setdefault(
-                        (h, lock),
-                        (m.rel, node.lineno, m.symbol(node)),
-                    )
-                acquired.append(lock)
-            inner = held + tuple(acquired)
-            for child in node.body:
-                visit(child, inner)
-            return
-        if isinstance(node, ast.Call):
-            func_seg = m.seg(node.func)
-            if (isinstance(node.func, ast.Attribute)
-                    and node.func.attr == "acquire"):
-                r = scope.resolve(node, node.func.value)
-                if r is not None:
-                    lock, re_ok = r
-                    if re_ok:
-                        reentrant.add(lock)
-                    info.acquires.add(lock)
-                    for h in held:
-                        edges.setdefault(
-                            (h, lock),
-                            (m.rel, node.lineno, m.symbol(node)),
-                        )
-            else:
-                info.calls_all.append(func_seg)
-                if held:
-                    info.calls_under.append(
-                        (held, func_seg, node.lineno)
-                    )
-        for child in ast.iter_child_nodes(node):
-            visit(child, held)
-
-    for stmt in fn.body:
-        visit(stmt, ())
-
-
-def _resolve_callee(
-    text: str, m: Module, imports: dict[str, str],
-    fns: dict[tuple[str, str], _FnInfo],
-) -> Optional[_FnInfo]:
-    """Best-effort: `self.m` -> same-class method, bare name -> same
-    module, `alias.f` -> imported module's f."""
-    text = text.strip()
-    if text.startswith("self."):
-        meth = text[5:].split("(")[0]
-        for (mod, sym), fi in fns.items():
-            if mod == m.name and sym.endswith(f".{meth}"):
-                return fi
-        return None
-    head = text.split("(")[0]
-    if "." not in head:
-        target = imports.get(head, head)
-        if "." in target:           # from x import f
-            mod, _, f = target.rpartition(".")
-            return fns.get((mod, f))
-        return fns.get((m.name, head))
-    alias, _, rest = head.partition(".")
-    base = imports.get(alias)
-    if base is None:
-        return None
-    parts = rest.split(".")
-    # alias may be a module (alias.f) or a package (alias.sub.f).
-    for split in range(len(parts), 0, -1):
-        mod = ".".join([base] + parts[: split - 1])
-        f = parts[split - 1]
-        hit = fns.get((mod, f))
-        if hit is not None:
-            return hit
-    return None
+# ---------------------------------------------------------------------------
+# lock-order-cycle
+# ---------------------------------------------------------------------------
 
 
 def _find_cycles(
@@ -325,57 +123,31 @@ def _find_cycles(
     return list(cycles.values())
 
 
-def _check_lock_order(modules: list[Module]) -> list[Finding]:
-    fns: dict[tuple[str, str], _FnInfo] = {}
-    scopes: dict[str, _Scope] = {}
+def _check_lock_order(prog: Program) -> list[Finding]:
+    """Held×acquired edges straight off the effect summaries: direct
+    acquisitions carry the held stack, and calls made under a lock
+    contribute the callee's *transitive* acquisitions (the program
+    fixpoint — cross-module, recursion-safe)."""
     edges: dict[tuple[str, str], tuple[str, int, str]] = {}
-    reentrant: set[str] = set()
-    mod_by_name = {m.name: m for m in modules}
-
-    for m in modules:
-        scope = _Scope(m)
-        scopes[m.name] = scope
-        for node in ast.walk(m.tree):
-            if isinstance(node, ast.FunctionDef):
-                key = (m.name, m.symbol(node))
-                fi = _FnInfo(key, m)
-                fns[key] = fi
-                _walk_function(m, scope, node, fi, edges, reentrant)
-
-    # Transitive lock acquisition through calls: fixpoint over the
-    # resolved call graph, then held×acquired(callee) edges.
-    imports = {m.name: _import_map(m) for m in modules}
-    trans: dict[tuple[str, str], set[str]] = {
-        k: set(fi.acquires) for k, fi in fns.items()
-    }
-    for _ in range(6):          # bounded: call chains deeper than this
-        changed = False         # don't exist in the lock protocols here
-        for key, fi in fns.items():
-            for text in fi.calls_all:
-                callee = _resolve_callee(
-                    text, fi.module, imports[fi.module.name], fns)
+    for key, fi in prog.fns.items():
+        for ev in fi.events:
+            if ev.kind == "acquire":
+                for h in ev.held:
+                    edges.setdefault(
+                        (h, ev.detail),
+                        (fi.module.rel, ev.line, key[1]))
+            elif ev.kind == "call" and ev.held:
+                callee = prog.resolve(ev.detail, fi.module, fi.cls, fi)
                 if callee is None:
                     continue
-                add = trans[callee.key] - trans[key]
-                if add:
-                    trans[key].update(add)
-                    changed = True
-        if not changed:
-            break
-
-    for key, fi in fns.items():
-        for held, text, line in fi.calls_under:
-            callee = _resolve_callee(
-                text, fi.module, imports[fi.module.name], fns)
-            if callee is None:
-                continue
-            for lock in trans[callee.key]:
-                for h in held:
-                    edges.setdefault(
-                        (h, lock), (fi.module.rel, line, key[1]))
+                for lock in prog.trans_acquires(callee):
+                    for h in ev.held:
+                        edges.setdefault(
+                            (h, lock),
+                            (fi.module.rel, ev.line, key[1]))
 
     out = []
-    for cyc in _find_cycles(edges, reentrant):
+    for cyc in _find_cycles(edges, prog.reentrant):
         ring = cyc + [cyc[0]]
         witnesses = []
         for a, b in zip(ring, ring[1:]):
@@ -383,63 +155,188 @@ def _check_lock_order(modules: list[Module]) -> list[Finding]:
             if w:
                 witnesses.append(f"{a} -> {b} at {w[0]}:{w[1]}")
         first = edges.get((ring[0], ring[1])) or ("<unknown>", 1, "?")
-        mod = mod_by_name.get(
-            next((m.name for m in modules if m.rel == first[0]), ""),
-        )
-        f = Finding(
+        out.append(Finding(
             rule="concurrency.lock-order-cycle", severity="error",
             path=first[0], line=first[1], symbol=first[2],
             message="lock-order cycle: " + "; ".join(witnesses)
                     + " — a timely interleaving deadlocks; impose one "
                     "global order or drop a lock before the call",
-        )
-        _ = mod
-        out.append(f)
+        ))
     return out
 
 
-def _check_thread_attrs(modules: list[Module]) -> list[Finding]:
-    out = []
-    for m in modules:
-        scope = _Scope(m)
+# ---------------------------------------------------------------------------
+# guarded-by contracts
+# ---------------------------------------------------------------------------
+
+
+def _thread_entries(m: Module, cls: ast.ClassDef,
+                    methods: dict[str, ast.FunctionDef]) -> set[str]:
+    """Methods handed to ``threading.Thread(target=self.x)`` plus the
+    intra-class closure of what they call — everything that runs ON
+    the spawned thread."""
+    entries: set[str] = set()
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        seg = m.seg(node.func)
+        if not seg.endswith("Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            t = kw.value
+            if (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"
+                    and t.attr in methods):
+                entries.add(t.attr)
+    frontier = list(entries)
+    while frontier:
+        meth = methods[frontier.pop()]
+        for node in ast.walk(meth):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "self"
+                    and node.func.attr in methods
+                    and node.func.attr not in entries):
+                entries.add(node.func.attr)
+                frontier.append(node.func.attr)
+    return entries
+
+
+def _class_methods(m: Module, cls: ast.ClassDef
+                   ) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in cls.body
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _contracts(prog: Program) -> dict[tuple[str, str],
+                                      dict[str, tuple[str, str]]]:
+    """(module, class) -> {attr: (lock-id, "annotated"|"inferred")}.
+
+    Annotated contracts come from ``# guarded-by:`` comments (parsed
+    during the effect walk).  Inferred ones: in a thread-spawning
+    class, an attribute whose every write outside ``__init__`` holds
+    one common lock has declared its protocol by construction."""
+    out: dict[tuple[str, str], dict[str, tuple[str, str]]] = {}
+    for ck, guards in prog.guards.items():
+        out[ck] = {attr: (lock, "annotated")
+                   for attr, lock in guards.items()}
+    for m in prog.modules:
         for cls in [n for n in ast.walk(m.tree)
                     if isinstance(n, ast.ClassDef)]:
-            methods = {
-                n.name: n for n in cls.body
-                if isinstance(n, ast.FunctionDef)
-            }
-            entries: set[str] = set()
-            for node in ast.walk(cls):
-                if not isinstance(node, ast.Call):
+            methods = _class_methods(m, cls)
+            if not _thread_entries(m, cls, methods):
+                continue
+            ck = (m.name, cls.name)
+            have = out.setdefault(ck, {})
+            # attr -> intersection of held locks over write sites
+            common: dict[str, set[str]] = {}
+            for mname, key in prog.classes.get(ck, {}).items():
+                if mname == "__init__":
                     continue
-                seg = m.seg(node.func)
-                if not seg.endswith("Thread"):
+                fi = prog.fns.get(key)
+                if fi is None:
                     continue
-                for kw in node.keywords:
-                    if kw.arg != "target":
+                for site in fi.attr_sites:
+                    if site.kind != "write":
                         continue
-                    t = kw.value
-                    if (isinstance(t, ast.Attribute)
-                            and isinstance(t.value, ast.Name)
-                            and t.value.id == "self"
-                            and t.attr in methods):
-                        entries.add(t.attr)
+                    held = set(site.held)
+                    if site.attr in common:
+                        common[site.attr] &= held
+                    else:
+                        common[site.attr] = held
+            for attr, locks in sorted(common.items()):
+                if attr in have or not locks:
+                    continue
+                have[attr] = (sorted(locks)[0], "inferred")
+    return out
+
+
+def _check_guarded_by(prog: Program,
+                      contracts: dict) -> list[Finding]:
+    # safe(key, lock): every resolved caller holds `lock` at the call
+    # site, or is __init__ of the owning class, or is itself safe —
+    # the private-helper-under-lock idiom, closed over the call graph.
+    memo: dict[tuple[Key, str, str], bool] = {}
+
+    def safe(key: Key, lock: str, init_key: str,
+             active: frozenset) -> bool:
+        mk = (key, lock, init_key)
+        if mk in memo:
+            return memo[mk]
+        if key in active:
+            return True         # call cycle: don't condemn on it
+        callers = prog.callers().get(key)
+        if not callers:
+            memo[mk] = False
+            return False
+        ok = True
+        for ckey, ev in callers:
+            if ckey[1] == init_key:
+                continue        # construction happens-before publication
+            if lock in ev.held:
+                continue
+            if not safe(ckey, lock, init_key, active | {key}):
+                ok = False
+                break
+        memo[mk] = ok
+        return ok
+
+    out = []
+    for (mod, cname), attrs in sorted(contracts.items()):
+        methods = prog.classes.get((mod, cname), {})
+        init_key = f"{cname}.__init__"
+        for mname, key in sorted(methods.items()):
+            if mname == "__init__":
+                continue
+            fi = prog.fns.get(key)
+            if fi is None:
+                continue
+            flagged: set[str] = set()
+            for site in fi.attr_sites:
+                spec = attrs.get(site.attr)
+                if spec is None or site.attr in flagged:
+                    continue
+                lock, how = spec
+                if lock in site.held:
+                    continue
+                if safe(key, lock, init_key, frozenset()):
+                    continue
+                flagged.add(site.attr)      # one finding per (method, attr)
+                short = lock.rsplit(".", 1)[-1]
+                out.append(Finding(
+                    rule="concurrency.guarded-by", severity="error",
+                    path=fi.module.rel, line=site.line,
+                    symbol=f"{cname}.{mname}",
+                    message=(
+                        f"self.{site.attr} is guarded by self.{short} "
+                        f"({how}) but {site.kind} here without it — "
+                        "hold the lock, or show every caller does"
+                    ),
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unsynced-thread-attr (fallback advice for contract-less attributes)
+# ---------------------------------------------------------------------------
+
+
+def _check_thread_attrs(modules: list[Module],
+                        contracts: dict) -> list[Finding]:
+    out = []
+    for m in modules:
+        scope = LockScope(m)
+        for cls in [n for n in ast.walk(m.tree)
+                    if isinstance(n, ast.ClassDef)]:
+            methods = _class_methods(m, cls)
+            entries = _thread_entries(m, cls, methods)
             if not entries:
                 continue
-            # Everything the entry calls via self.* runs ON the spawned
-            # thread too — close over the intra-class call graph.
-            frontier = list(entries)
-            while frontier:
-                meth = methods[frontier.pop()]
-                for node in ast.walk(meth):
-                    if (isinstance(node, ast.Call)
-                            and isinstance(node.func, ast.Attribute)
-                            and isinstance(node.func.value, ast.Name)
-                            and node.func.value.id == "self"
-                            and node.func.attr in methods
-                            and node.func.attr not in entries):
-                        entries.add(node.func.attr)
-                        frontier.append(node.func.attr)
+            covered = contracts.get((m.name, cls.name), {})
 
             def _locks_held(node: ast.AST) -> set[str]:
                 held = set()
@@ -479,6 +376,8 @@ def _check_thread_attrs(modules: list[Module]) -> list[Finding]:
                                     prev[2] and bool(held),
                                 )
             for attr, (wlocks, wline, _all) in sorted(writes.items()):
+                if attr in covered:
+                    continue        # the contract checks this one
                 for mname, meth in methods.items():
                     if mname in entries or mname == "__init__":
                         continue
@@ -512,6 +411,9 @@ def _check_thread_attrs(modules: list[Module]) -> list[Finding]:
 
 def check(modules: list[Module]) -> list[Finding]:
     scan = [m for m in modules if m.rel.startswith("jepsen_tpu/")]
-    out = _check_lock_order(scan)
-    out.extend(_check_thread_attrs(scan))
+    prog = effects.build(scan)
+    contracts = _contracts(prog)
+    out = _check_lock_order(prog)
+    out.extend(_check_guarded_by(prog, contracts))
+    out.extend(_check_thread_attrs(scan, contracts))
     return out

@@ -171,12 +171,12 @@ class Router:
         self.stats_timeout_s = stats_timeout_s
         self.io_timeout_s = io_timeout_s
         self._lock = threading.Lock()
-        self._tickets: dict[str, _TicketRec] = {}
+        self._tickets: dict[str, _TicketRec] = {}  # guarded-by: self._lock
         #: canonical model spec -> the daemon that last checked it (its
         #: model/settle/XLA caches are warm for that spec).
-        self._affinity: dict[str, str] = {}
-        self._stats_cache: dict[str, tuple[float, dict]] = {}
-        self.sessions: dict = {}
+        self._affinity: dict[str, str] = {}  # guarded-by: self._lock
+        self._stats_cache: dict[str, tuple[float, dict]] = {}  # guarded-by: self._lock
+        self.sessions: dict = {}  # guarded-by: self.sessions_lock
         self.sessions_lock = threading.Lock()
         self.n_submits = 0
         self.n_results = 0

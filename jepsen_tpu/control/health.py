@@ -314,9 +314,6 @@ class HealthMonitor:
     def _ensure_thread(self) -> None:
         if not self._start_thread or self._stop.is_set():
             return
-        t = self._thread
-        if t is not None and t.is_alive():
-            return
         with self._lock:
             if self._thread is not None and self._thread.is_alive():
                 return
@@ -344,7 +341,10 @@ class HealthMonitor:
 
     def stop(self) -> None:
         self._stop.set()
-        t = self._thread
+        with self._lock:
+            t = self._thread
+        # join OUTSIDE the lock: the monitor thread takes it each
+        # sweep, and holding it across the join would deadlock.
         if t is not None and t.is_alive():
             t.join(timeout=2.0)
 

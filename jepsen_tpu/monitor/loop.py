@@ -213,6 +213,17 @@ class _Tee:
                             self.endpoint, e)
 
 
+def _atomic_json(path: str, doc: dict) -> None:
+    """tmp + fsync + rename: readers (the web UI, `jepsen fleet`) see
+    either the old document or the new one, never a torn tail."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(doc, f, indent=2, default=repr)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def _write_dossier(store_dir: str, stem: str, doc: dict) -> Optional[str]:
     """One JSON dossier under the forensics root the alert router
     attaches evidence from."""
@@ -222,8 +233,7 @@ def _write_dossier(store_dir: str, stem: str, doc: dict) -> Optional[str]:
     path = os.path.join(d, f"{stem}.json")
     try:
         os.makedirs(d, exist_ok=True)
-        with open(path, "w") as f:
-            json.dump(doc, f, indent=2, default=repr)
+        _atomic_json(path, doc)
         return path
     except OSError as e:
         log.warning("monitor dossier write failed: %r", e)
@@ -416,9 +426,8 @@ def run_monitor(cfg: MonitorConfig,
             "slo": slo.status(),
         }
         try:
-            with open(os.path.join(cfg.store_dir, SUMMARY_FILE),
-                      "w") as f:
-                json.dump(summary, f, indent=2, default=repr)
+            _atomic_json(os.path.join(cfg.store_dir, SUMMARY_FILE),
+                         summary)
         except OSError as e:
             log.warning("monitor summary write failed: %r", e)
         store.close()

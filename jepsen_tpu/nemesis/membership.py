@@ -201,10 +201,14 @@ class MembershipNemesis(Nemesis):
         self._stop.set()
         for t in self._pollers:
             t.join(timeout=2.0)
-        self.state.teardown(test)
+        with self.lock:
+            state = self.state
+        state.teardown(test)
 
     def fs(self) -> set:
-        return set(self.state.fs())
+        with self.lock:
+            state = self.state
+        return set(state.fs())
 
 
 class MembershipGenerator(Generator):

@@ -639,8 +639,10 @@ def prometheus_text(
     `gauge`.  `extra_gauges` ({name: number}) lets a server mix in
     surface-local values (queue depth, utilization); `chip_state`
     renders the one-hot `jepsen_chip_health{state=...}` family;
-    `lint_findings` ({severity: count}, from a jepsenlint store
-    summary) renders `jepsen_lint_findings{severity=...}` gauges;
+    `lint_findings` (from a jepsenlint store summary: either the flat
+    {severity: count} or the nested {family: {severity: count}} shape)
+    renders `jepsen_lint_findings{...}` gauges — nested input adds the
+    `family` label;
     `slo_firing` ({rule: 0|1}) renders the
     `jepsen_slo_firing{rule=...}` family — when omitted, the default
     SLO engine's current state (telemetry/slo.py) is exported, so every
@@ -706,12 +708,22 @@ def prometheus_text(
         pass
     if lint_findings:
         lines.append("# TYPE jepsen_lint_findings gauge")
-        for sev in sorted(lint_findings):
-            v = lint_findings[sev]
+        for key in sorted(lint_findings):
+            v = lint_findings[key]
+            if isinstance(v, dict):
+                # {family: {severity: count}} from summary["families"].
+                for sev in sorted(v):
+                    n = v[sev]
+                    if not isinstance(n, (int, float)):
+                        continue
+                    lines.append(
+                        f'jepsen_lint_findings{{family="{key}",'
+                        f'severity="{sev}"}} {n}')
+                continue
             if not isinstance(v, (int, float)):
                 continue
             lines.append(
-                f'jepsen_lint_findings{{severity="{sev}"}} {v}')
+                f'jepsen_lint_findings{{severity="{key}"}} {v}')
     if chip_state is not None:
         lines.append("# TYPE jepsen_chip_health gauge")
         known = chip_state in CHIP_HEALTH_STATES

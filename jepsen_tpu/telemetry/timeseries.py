@@ -363,10 +363,10 @@ class SeriesStore:
         self._lock = threading.Lock()
         os.makedirs(directory, exist_ok=True)
         #: tier -> {series name -> deque[(t, value)]}
-        self._mem: list[dict[str, collections.deque]] = [{}, {}, {}]
+        self._mem: list[dict[str, collections.deque]] = [{}, {}, {}]  # guarded-by: self._lock
         #: open aggregation buckets for tiers 1 and 2 (index by tier).
-        self._aggs: list[Optional[_Agg]] = [None, None, None]
-        self._writers: list[Optional[BlockWriter]] = [None, None, None]
+        self._aggs: list[Optional[_Agg]] = [None, None, None]  # guarded-by: self._lock
+        self._writers: list[Optional[BlockWriter]] = [None, None, None]  # guarded-by: self._lock
         self._rebuild()
 
     # -- paths / files ------------------------------------------------------
@@ -424,6 +424,11 @@ class SeriesStore:
         try:
             if w.f.tell() < self.max_tier_bytes:
                 return
+            # fsync before the close+rename: the rotated-out `.1`
+            # generation is the archive readers trust — renaming bytes
+            # the kernel hasn't durably written would let a power cut
+            # eat the end of a file we just promoted to "sealed".
+            w.sync()
             w.close()
         except (OSError, ValueError):
             pass

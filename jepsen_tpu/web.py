@@ -351,6 +351,7 @@ class Handler(http.server.BaseHTTPRequestHandler):
             summary = None
         if summary:
             counts = summary.get("counts") or {}
+            families = summary.get("families") or {}
             lrows = "".join(
                 f"<tr><td>{html.escape(str(k))}</td>"
                 f"<td>{html.escape(str(v))}</td></tr>"
@@ -366,9 +367,22 @@ class Handler(http.server.BaseHTTPRequestHandler):
                     ("duration s", summary.get("duration_s")),
                 ]
             )
+            frows = "".join(
+                f"<tr><td>{html.escape(str(fam))}</td>"
+                f"<td>{sevs.get('error', 0)}</td>"
+                f"<td>{sevs.get('warning', 0)}</td>"
+                f"<td>{sevs.get('advice', 0)}</td></tr>"
+                for fam, sevs in sorted(families.items())
+                if isinstance(sevs, dict)
+            )
+            fam_tbl = (
+                "<h3>by family</h3><table><tr><th>family</th>"
+                "<th>errors</th><th>warnings</th><th>advice</th></tr>"
+                f"{frows}</table>" if frows else ""
+            )
             lint_tbl = (
                 "<h2>static analysis (jepsenlint)</h2>"
-                f"<table>{lrows}</table>"
+                f"<table>{lrows}</table>" + fam_tbl
             )
         try:
             from .checkerd.client import fetch_stats
@@ -553,7 +567,10 @@ class Handler(http.server.BaseHTTPRequestHandler):
 
             summary = read_store_summary(self.store_dir)
             if summary:
-                lint_counts = summary.get("counts")
+                # Prefer the per-family breakdown (adds the `family`
+                # label); older summaries only carry flat counts.
+                lint_counts = (summary.get("families")
+                               or summary.get("counts"))
         except Exception:  # noqa: BLE001 — scrape must not 500
             pass
         # Evaluate the SLO rules with the freshest samples this scrape

@@ -272,6 +272,446 @@ def test_swallowed_teardown_fires():
 
 
 # --------------------------------------------------------------------------
+# durability family
+# --------------------------------------------------------------------------
+
+def _sev(findings, rule):
+    return {f.severity for f in findings if f.rule == rule}
+
+
+def test_fsync_missing_fires():
+    found = lint_source(textwrap.dedent("""
+        BLOCK_CHUNK = 3
+
+        class Journal:
+            def put(self, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+                self.writer.flush()
+    """))
+    assert "durability.fsync-missing" in _rules(found)
+    assert _sev(found, "durability.fsync-missing") == {"error"}
+
+
+def test_fsync_same_function_is_clean():
+    found = lint_source(textwrap.dedent("""
+        BLOCK_CHUNK = 3
+
+        class Journal:
+            def put(self, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+                self.writer.sync()
+    """))
+    assert "durability.fsync-missing" not in _rules(found)
+
+
+def test_fsync_in_caller_absolves_helper():
+    # The ledger idiom: a bare append helper whose every caller owns
+    # the sync stays clean with no annotation.
+    found = lint_source(textwrap.dedent("""
+        BLOCK_CHUNK = 3
+
+        class Journal:
+            def _put(self, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+
+            def put(self, rec):
+                self._put(rec)
+                self.writer.sync()
+    """))
+    assert "durability.fsync-missing" not in _rules(found)
+
+
+def test_reply_before_fsync_fires():
+    found = lint_source(textwrap.dedent("""
+        BLOCK_CHUNK = 3
+
+        class Server:
+            def handle(self, sock, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+                sock.sendall(b"ok")
+                self.writer.sync()
+    """))
+    assert "durability.reply-before-fsync" in _rules(found)
+    assert _sev(found, "durability.reply-before-fsync") == {"error"}
+
+
+def test_reply_after_fsync_is_clean():
+    found = lint_source(textwrap.dedent("""
+        BLOCK_CHUNK = 3
+
+        class Server:
+            def handle(self, sock, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+                self.writer.sync()
+                sock.sendall(b"ok")
+    """))
+    assert "durability.reply-before-fsync" not in _rules(found)
+
+
+def test_reply_in_helper_still_caught():
+    # The send lives in a callee: folded in via transitive kinds.
+    found = lint_source(textwrap.dedent("""
+        BLOCK_CHUNK = 3
+
+        class Server:
+            def _ack(self, sock):
+                sock.sendall(b"ok")
+
+            def handle(self, sock, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+                self._ack(sock)
+                self.writer.sync()
+    """))
+    assert "durability.reply-before-fsync" in _rules(found)
+
+
+def test_jsonl_append_without_fsync_fires():
+    found = lint_source(textwrap.dedent("""
+        def log(rec):
+            with open("events.jsonl", "a") as f:
+                f.write(rec)
+    """))
+    assert "durability.fsync-missing" in _rules(found)
+
+
+def test_jsonl_append_with_flush_fsync_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import os
+
+        def log(rec):
+            with open("events.jsonl", "a") as f:
+                f.write(rec)
+                f.flush()
+                os.fsync(f.fileno())
+    """))
+    assert "durability.fsync-missing" not in _rules(found)
+
+
+def test_torn_tail_unhandled_fires():
+    found = lint_source(textwrap.dedent("""
+        def scan(f):
+            rec = _read_block(f)
+            return rec["t"]
+    """))
+    assert "durability.torn-tail-unhandled" in _rules(found)
+    assert _sev(found, "durability.torn-tail-unhandled") == {"warning"}
+
+
+def test_torn_tail_checked_is_clean():
+    found = lint_source(textwrap.dedent("""
+        def scan(f):
+            rec = _read_block(f)
+            if rec is None:
+                return None
+            return rec["t"]
+    """))
+    assert "durability.torn-tail-unhandled" not in _rules(found)
+
+
+def test_non_atomic_checkpoint_fires():
+    found = lint_source(textwrap.dedent("""
+        import json
+
+        def save(state):
+            with open("state.json", "w") as f:
+                json.dump(state, f)
+
+        def load():
+            with open("state.json") as f:
+                return json.load(f)
+    """))
+    assert "durability.non-atomic-checkpoint" in _rules(found)
+    assert _sev(found, "durability.non-atomic-checkpoint") == {"warning"}
+
+
+def test_atomic_checkpoint_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import json
+        import os
+
+        def save(state):
+            with open("state.json", "w") as f:
+                json.dump(state, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace("state.json", "state.json")
+
+        def load():
+            with open("state.json") as f:
+                return json.load(f)
+    """))
+    assert "durability.non-atomic-checkpoint" not in _rules(found)
+
+
+def test_write_only_json_is_clean():
+    # No read-back site anywhere: a rendered report, not a checkpoint.
+    found = lint_source(textwrap.dedent("""
+        import json
+
+        def save(state):
+            with open("report.json", "w") as f:
+                json.dump(state, f)
+    """))
+    assert "durability.non-atomic-checkpoint" not in _rules(found)
+
+
+def test_block_type_collision_fires():
+    found = lint_source(textwrap.dedent("""
+        BLOCK_A = 1
+        BLOCK_B = 1
+    """))
+    assert "durability.block-type-collision" in _rules(found)
+    assert _sev(found, "durability.block-type-collision") == {"error"}
+
+
+def test_frame_vs_block_collision_fires():
+    found = lint_source(
+        "BLOCK_A = 7\n",
+        extra={"jepsen_tpu/checkerd/protocol.py": "F_HELLO = 7\n"},
+    )
+    assert "durability.block-type-collision" in _rules(found)
+
+
+def test_distinct_block_ids_are_clean():
+    found = lint_source(textwrap.dedent("""
+        BLOCK_A = 1
+        BLOCK_B = 2
+    """))
+    assert "durability.block-type-collision" not in _rules(found)
+
+
+def test_durability_fingerprints_are_line_stable(tmp_path):
+    src = """
+        BLOCK_CHUNK = 3
+
+        class Journal:
+            def put(self, rec):
+                self.writer.append(BLOCK_CHUNK, rec)
+    """
+    root = _root(tmp_path, textwrap.dedent(src))
+    before = [f for f in run_lint(root).findings
+              if f.rule == "durability.fsync-missing"]
+    fx = tmp_path / "jepsen_tpu" / "fixture.py"
+    fx.write_text("# leading comment shifts every line\n"
+                  + fx.read_text())
+    after = [f for f in run_lint(root).findings
+             if f.rule == "durability.fsync-missing"]
+    assert before and [f.fingerprint for f in before] == \
+        [f.fingerprint for f in after]
+    assert before[0].line != after[0].line
+
+
+# --------------------------------------------------------------------------
+# guarded-by contracts
+# --------------------------------------------------------------------------
+
+def test_guarded_by_annotated_violation_fires():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tickets = {}  # guarded-by: self._lock
+
+            def get(self, t):
+                return self._tickets.get(t)
+    """))
+    assert "concurrency.guarded-by" in _rules(found)
+    assert _sev(found, "concurrency.guarded-by") == {"error"}
+
+
+def test_guarded_by_held_access_is_clean():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tickets = {}  # guarded-by: self._lock
+
+            def get(self, t):
+                with self._lock:
+                    return self._tickets.get(t)
+    """))
+    assert "concurrency.guarded-by" not in _rules(found)
+
+
+def test_guarded_by_helper_under_lock_is_clean():
+    # The private-helper idiom: every caller holds the lock at the
+    # call site, proven through the call graph.
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tickets = {}  # guarded-by: self._lock
+
+            def _get(self, t):
+                return self._tickets.get(t)
+
+            def get(self, t):
+                with self._lock:
+                    return self._get(t)
+    """))
+    assert "concurrency.guarded-by" not in _rules(found)
+
+
+def test_guarded_by_init_only_helper_is_clean():
+    # Construction happens-before publication: helpers reachable only
+    # from __init__ need no lock.
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Router:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._tickets = {}  # guarded-by: self._lock
+                self._restore()
+
+            def _restore(self):
+                self._tickets["a"] = 1
+    """))
+    assert "concurrency.guarded-by" not in _rules(found)
+
+
+def test_guarded_by_inferred_for_thread_spawner():
+    found = lint_source(textwrap.dedent("""
+        import threading
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                with self._lock:
+                    self._n += 1
+
+            def peek(self):
+                return self._n
+    """))
+    assert "concurrency.guarded-by" in _rules(found)
+    # The contract subsumes the weaker advice — not double-reported.
+    assert "concurrency.unsynced-thread-attr" not in _rules(found)
+
+
+# --------------------------------------------------------------------------
+# effect summaries / call graph (analysis/effects.py)
+# --------------------------------------------------------------------------
+
+def _prog(sources):
+    from jepsen_tpu.analysis.core import Module
+    from jepsen_tpu.analysis import effects
+
+    mods = [Module(rel, rel, textwrap.dedent(src))
+            for rel, src in sources.items()]
+    return effects.build(mods), mods
+
+
+def test_effects_recursion_terminates():
+    prog, _ = _prog({"jepsen_tpu/fx.py": """
+        def a(n):
+            if n:
+                a(n - 1)
+    """})
+    key = ("jepsen_tpu.fx", "a")
+    assert prog.trans_kinds(key) is not None
+    assert key in prog.edges().get(key, [])
+
+
+def test_effects_cycle_folds_kinds():
+    prog, _ = _prog({"jepsen_tpu/fx.py": """
+        def a(w):
+            b(w)
+
+        def b(w):
+            a(w)
+            w.sync()
+    """})
+    assert "fsync" in prog.trans_kinds(("jepsen_tpu.fx", "a"))
+    assert "fsync" in prog.trans_kinds(("jepsen_tpu.fx", "b"))
+
+
+def test_dispatch_fallback_unique_method():
+    prog, mods = _prog({
+        "jepsen_tpu/one.py": """
+            class A:
+                def frob(self):
+                    pass
+        """,
+        "jepsen_tpu/two.py": """
+            def use(x):
+                x.frob()
+        """,
+    })
+    caller = prog.fns[("jepsen_tpu.two", "use")]
+    assert prog.resolve("x.frob", mods[1], None, caller) == \
+        ("jepsen_tpu.one", "A.frob")
+
+
+def test_dispatch_fallback_skips_ambient_names():
+    prog, mods = _prog({
+        "jepsen_tpu/one.py": """
+            class A:
+                def close(self):
+                    pass
+        """,
+        "jepsen_tpu/two.py": """
+            def use(x):
+                x.close()
+        """,
+    })
+    caller = prog.fns[("jepsen_tpu.two", "use")]
+    assert prog.resolve("x.close", mods[1], None, caller) is None
+
+
+def test_attr_call_does_not_alias_methods():
+    # self._writer.close() is a call through an attribute, not a call
+    # of some class's _writer() method.
+    prog, mods = _prog({
+        "jepsen_tpu/one.py": """
+            class S:
+                def _writer(self):
+                    pass
+        """,
+        "jepsen_tpu/two.py": """
+            class Q:
+                def close(self):
+                    self._writer.close()
+        """,
+    })
+    caller = prog.fns[("jepsen_tpu.two", "Q.close")]
+    assert prog.resolve(
+        "self._writer.close", mods[1], "Q", caller) is None
+
+
+def test_typed_local_dispatch():
+    prog, mods = _prog({"jepsen_tpu/fx.py": """
+        class HW:
+            def checkpoint(self):
+                self.w.sync()
+
+        class HW2:
+            def checkpoint(self):
+                pass
+
+        class Handle:
+            def _ensure(self) -> HW:
+                return HW()
+
+            def save(self):
+                hw = self._ensure()
+                hw.checkpoint()
+    """})
+    caller = prog.fns[("jepsen_tpu.fx", "Handle.save")]
+    assert prog.resolve("hw.checkpoint", mods[0], "Handle", caller) == \
+        ("jepsen_tpu.fx", "HW.checkpoint")
+
+
+# --------------------------------------------------------------------------
 # suppressions
 # --------------------------------------------------------------------------
 
@@ -301,6 +741,30 @@ def test_suppression_without_reason_is_an_error(tmp_path):
     report = run_lint(root)
     assert not report.clean
     assert "lint.suppression-missing-reason" in _rules(report.findings)
+
+
+def test_unused_suppression_is_an_error(tmp_path):
+    root = _root(tmp_path, textwrap.dedent("""
+        # jepsenlint: ignore[device.unguarded-narrowing] -- old debt
+        x = 1
+    """))
+    report = run_lint(root)
+    assert not report.clean
+    hits = [f for f in report.findings
+            if f.rule == "lint.unused-suppression"]
+    assert hits and hits[0].severity == "error"
+    assert "device.unguarded-narrowing" in hits[0].message
+
+
+def test_pragma_in_docstring_is_not_a_suppression(tmp_path):
+    # Prose *about* the pragma syntax must neither suppress anything
+    # nor count as an unused pragma.
+    root = _root(tmp_path, '''
+"""Docs: write `# jepsenlint: ignore[rule] -- why` to suppress."""
+x = 1
+''')
+    report = run_lint(root)
+    assert "lint.unused-suppression" not in _rules(report.findings)
 
 
 # --------------------------------------------------------------------------
@@ -383,6 +847,48 @@ def test_store_summary_and_prometheus_gauges(tmp_path):
     text = telemetry.prometheus_text(lint_findings=summary["counts"])
     assert 'jepsen_lint_findings{severity="warning"} 1' in text
     assert 'jepsen_lint_findings{severity="error"} 0' in text
+    # The per-family breakdown adds the family label.
+    assert summary["families"]
+    text = telemetry.prometheus_text(lint_findings=summary["families"])
+    assert ('jepsen_lint_findings{family="device",severity="warning"} 1'
+            in text)
+
+
+def test_sarif_output(tmp_path):
+    from jepsen_tpu.analysis.sarif import render_sarif
+
+    root = _root(tmp_path, _NARROW.format(pragma=""))
+    report = run_lint(root)
+    doc = render_sarif(report)
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "jepsenlint"
+    results = run["results"]
+    assert len(results) == 1
+    r = results[0]
+    assert r["ruleId"] == "device.unguarded-narrowing"
+    assert r["level"] == "warning"
+    assert r["partialFingerprints"]["jepsenlint/v1"] == \
+        report.findings[0].fingerprint
+    loc = r["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "jepsen_tpu/fixture.py"
+    rule_ids = {ru["id"] for ru in run["tool"]["driver"]["rules"]}
+    assert "device.unguarded-narrowing" in rule_ids
+
+
+def test_sarif_baselined_results_are_suppressed(tmp_path):
+    from jepsen_tpu.analysis.sarif import render_sarif
+
+    root = _root(tmp_path, _NARROW.format(pragma=""))
+    report = run_lint(root)
+    save_baseline(baseline_path(root), report.findings,
+                  justification="fixture: accepted")
+    report = run_lint(root)
+    assert report.clean
+    doc = render_sarif(report)
+    results = doc["runs"][0]["results"]
+    assert len(results) == 1
+    assert results[0]["suppressions"][0]["kind"] == "external"
 
 
 # --------------------------------------------------------------------------
