@@ -357,6 +357,67 @@ SCALE_LAST_GOOD_PATH = os.path.join(
 )
 
 
+def _roofline_probe(pm) -> "Optional[dict]":
+    """Post-metric roofline probe: one small device check with
+    telemetry + a throwaway profile store enabled, summarized per pass
+    (telemetry/roofline.py).  Runs AFTER the timed reps so the scale
+    metric's measurement conditions stay identical to every prior
+    BENCH_r* trajectory; restores telemetry state on exit."""
+    import tempfile
+
+    from jepsen_tpu import telemetry
+    from jepsen_tpu.ops.wgl import check_wgl_device
+    from jepsen_tpu.telemetry import profile, roofline
+    from jepsen_tpu.utils.histgen import random_register_packed
+
+    prev_enabled = telemetry.enabled()
+    prev_store = profile.store_path()
+    tmp = tempfile.mkdtemp(prefix="bench-roofline-")
+    telemetry.enable(True)
+    profile.set_store(tmp)
+    try:
+        probe = random_register_packed(
+            100_000, procs=int(knob("JEPSEN_BENCH_PROCS")),
+            info_rate=float(knob("JEPSEN_BENCH_INFO")),
+            seed=11, model=pm,
+        )
+        check_wgl_device(probe, pm, time_limit_s=60.0)
+        recs = profile.read(os.path.join(tmp, profile.PROFILE_FILE))
+        if not recs:
+            return None
+        return {
+            "probe_ops": int(probe.n),
+            "passes": roofline.summarize(recs),
+        }
+    finally:
+        telemetry.enable(prev_enabled)
+        profile.set_store(
+            os.path.dirname(prev_store) if prev_store else None)
+
+
+def _measure_ingest(pm) -> "Optional[int]":
+    """Measured ingest throughput: ops/s through the PackedBuilder
+    append -> snapshot -> finish path (the streaming checker's ingest
+    primitive), over a pre-built op list so op generation stays out of
+    the measurement."""
+    from jepsen_tpu.history.packed import PackedBuilder
+    from jepsen_tpu.utils.histgen import random_register_history
+
+    ops = list(random_register_history(
+        200_000, procs=int(knob("JEPSEN_BENCH_PROCS")),
+        info_rate=float(knob("JEPSEN_BENCH_INFO")), seed=13,
+    ))
+    b = PackedBuilder(pm.encode)
+    t0 = time.monotonic()
+    for i, o in enumerate(ops):
+        b.append(o)
+        if (i + 1) % 50_000 == 0:
+            b.snapshot()
+    b.finish()
+    dt = time.monotonic() - t0
+    return round(len(ops) / dt) if dt > 0 else None
+
+
 def run_scale() -> int:
     """Scale-point child (JEPSEN_BENCH_SCALE_CHILD=1): one big
     history, one verdict, one JSON line."""
@@ -475,6 +536,24 @@ def run_scale() -> int:
             rec["max_ops_at_300s"] = int(rate * 300.0)
         else:
             rec["error"] = f"verdict {res.valid} ({res.reason})"
+        # Roofline + ingest observability fields (advisory: a probe
+        # failure never costs the scale point its primary metric).
+        try:
+            rec["roofline"] = _roofline_probe(pm)
+        except Exception:  # noqa: BLE001
+            rec["roofline"] = None
+        try:
+            ing = _measure_ingest(pm)
+            rec["ingest_ops_per_s"] = ing
+            if res.valid is True and ing:
+                # The share of end-to-end verdict lag the ingest path
+                # would claim at this point's scale (ROADMAP item 5's
+                # "profile before attacking" number).
+                ingest_s = packed.n / ing
+                rec["ingest_share_of_verdict_lag"] = round(
+                    ingest_s / (ingest_s + dt), 4)
+        except Exception:  # noqa: BLE001
+            rec["ingest_ops_per_s"] = None
         print(json.dumps(rec))
         return 0 if res.valid is True else 1
     except Exception as e:  # noqa: BLE001 — the JSON line must print

@@ -92,6 +92,11 @@ RULES = {
 DECLARED_NAMESPACES = {
     "wgl": "device checker passes (ops/, streaming/, parallel/)",
     "wgl.plan": "checking-plan compiler/executor/cache (plan/)",
+    "wgl.roofline": "achieved-vs-peak roofline gauges "
+                    "(telemetry/roofline.py)",
+    "ingest": "history ingest path: builder append/snapshot, remote "
+              "framing, daemon decode (history/, streaming/, "
+              "checkerd/)",
     "checker": "checker harness (checker/)",
     "checkerd": "checker daemon fleet (checkerd/)",
     "checkerd.queue": "crash-safe queue journal (checkerd/journal.py)",

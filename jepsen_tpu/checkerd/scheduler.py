@@ -391,6 +391,18 @@ class Scheduler:
         out["chip-health"] = degrade.chip_state()
         out["profile-records"] = profile.count_records()
         out["profile-by-pass"] = profile.by_pass()
+        # Roofline summary over the store's recent tail: per-pass
+        # achieved-vs-peak medians (telemetry/roofline.py), capped so a
+        # long-lived daemon's STATS stays O(tail) not O(history).
+        try:
+            from ..telemetry import roofline
+
+            p = profile.store_path()
+            recs = profile.read(p)[-2000:] if p else []
+            out["roofline"] = roofline.summarize(recs) if recs else None
+        except Exception:  # noqa: BLE001 — STATS must never fail on
+            # an advisory summary
+            out["roofline"] = None
         # Plan-layer health: routing flag, persistent cache hit rates,
         # and which passes the cost model covers — the /fleet plan
         # panel renders this block.

@@ -37,6 +37,7 @@ from .protocol import (
     write_frame,
 )
 from .scheduler import Request, Scheduler
+from .. import telemetry
 
 log = logging.getLogger(__name__)
 
@@ -98,6 +99,7 @@ class _Submission:
         if not isinstance(ops, list):
             raise ProtocolError("CHUNK without an ops list")
         self.ops.setdefault(i, []).extend(ops)
+        telemetry.count("ingest.decode.ops", len(ops))
 
     def add_packed(self, data: bytes) -> None:
         from ..history.packed import packed_from_bytes
@@ -108,8 +110,15 @@ class _Submission:
             self.packs[i] = packed_from_bytes(body)
         except ValueError as e:
             raise ProtocolError(f"key {i}: {e}") from e
+        telemetry.count("ingest.decode.packs")
+        telemetry.count("ingest.decode.pack-bytes", len(body))
 
     def build(self, scheduler: Scheduler) -> Request:
+        with telemetry.span("ingest.decode.build",
+                            keys=len(self.ops) + len(self.packs)):
+            return self._build(scheduler)
+
+    def _build(self, scheduler: Scheduler) -> Request:
         from ..history.core import History
 
         meta = self.meta

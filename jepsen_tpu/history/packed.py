@@ -34,6 +34,8 @@ import numpy as np
 
 from .core import FAIL, INFO, INVOKE, OK, History, Op
 
+from .. import telemetry
+
 #: Sentinel for "never returns" event index.
 NO_RET = np.iinfo(np.int64).max
 
@@ -211,7 +213,8 @@ class PackedBuilder:
     (streaming/frontier.py) carry device state across chunks.
     """
 
-    __slots__ = ("encode", "_e", "_pending", "_rows", "_stable", "_finished")
+    __slots__ = ("encode", "_e", "_pending", "_rows", "_stable",
+                 "_finished", "_counted")
 
     def __init__(self, encode: OpEncoderFn):
         self.encode = encode
@@ -225,6 +228,10 @@ class PackedBuilder:
         #: inv-sorted prefix of rows proven stable by a past snapshot().
         self._stable: list[tuple[int, int, int, int, int, int, int, int]] = []
         self._finished = False
+        #: client events already flushed to the ingest.append.ops
+        #: counter (append itself is too hot for per-op telemetry:
+        #: deltas flush at snapshot/finish instead).
+        self._counted = 0
 
     # -- introspection ------------------------------------------------------
 
@@ -435,16 +442,32 @@ class PackedBuilder:
             for p, (inv_e, op) in self._pending.items()
         }
         self._e -= e_shift
+        # The ingest flush watermark tracks the (renumbered) counter.
+        self._counted = max(0, self._counted - e_shift)
         return d, d, e_shift
+
+    def _flush_ingest(self) -> None:
+        """Publishes the client events consumed since the last flush
+        (keeps `append` itself telemetry-free — the hot path's cost
+        contract)."""
+        if not telemetry.enabled():
+            return
+        d = self._e - self._counted
+        if d > 0:
+            telemetry.count("ingest.append.ops", d)
+        self._counted = self._e
 
     def snapshot(self) -> tuple["PackedOps", int]:
         """(stable-prefix PackedOps, s).  The pack covers exactly the
         rows with inv < s and is WITNESS-ONLY: preds/horizon are left
         zero (the witness event walk never reads them; a full pack
         comes from finish())."""
-        s = self.stable_bound()
-        self._advance_stable(s)
-        return _rows_to_packed(self._stable, with_preds=False), s
+        self._flush_ingest()
+        with telemetry.span("ingest.snapshot", rows=self.n_rows):
+            telemetry.count("ingest.snapshots")
+            s = self.stable_bound()
+            self._advance_stable(s)
+            return _rows_to_packed(self._stable, with_preds=False), s
 
     def finish(self) -> "PackedOps":
         """Closes the builder: unfinished invocations become
@@ -453,14 +476,16 @@ class PackedBuilder:
         if self._finished:
             raise RuntimeError("PackedBuilder already finished")
         self._finished = True
-        # Unfinished invocations are indeterminate (pending dict order,
-        # matching pack_history's final loop).
-        for inv_e, inv_op in self._pending.values():
-            self._emit(inv_e, inv_op, -1, None)
-        self._pending.clear()
-        rows = self._stable + self._rows
-        rows.sort(key=lambda r: r[0])
-        return _rows_to_packed(rows, with_preds=True)
+        self._flush_ingest()
+        with telemetry.span("ingest.finish", rows=self.n_rows):
+            # Unfinished invocations are indeterminate (pending dict
+            # order, matching pack_history's final loop).
+            for inv_e, inv_op in self._pending.values():
+                self._emit(inv_e, inv_op, -1, None)
+            self._pending.clear()
+            rows = self._stable + self._rows
+            rows.sort(key=lambda r: r[0])
+            return _rows_to_packed(rows, with_preds=True)
 
 
 def _require_i32(arr: "np.ndarray") -> None:

@@ -313,6 +313,8 @@ def run_monitor(cfg: MonitorConfig,
     epoch_dossiers = 0
     rate_window: collections.deque = collections.deque(maxlen=8)
     rate_window.append((t0, 0))
+    ingest_window = collections.deque(maxlen=rate_window.maxlen)
+    ingest_window.append((t0, telemetry.counter_value("ingest.append.ops")))
     burst = max(1, min(512, int(cfg.rate * cfg.cadence_s / 50) or 1))
     telemetry.count("monitor.runs")
 
@@ -327,10 +329,19 @@ def run_monitor(cfg: MonitorConfig,
         telemetry.gauge("monitor.resident-rows", checker.resident_rows())
         telemetry.gauge("monitor.series-disk-bytes", store.disk_bytes())
         rate_window.append((now, completed))
+        ingest_window.append(
+            (now, telemetry.counter_value("ingest.append.ops")))
         (tA, cA), (tB, cB) = rate_window[0], rate_window[-1]
         if tB > tA:
             telemetry.gauge("monitor.ops-per-s",
                             round((cB - cA) / (tB - tA), 1))
+        # Measured ingest throughput (ingest.append.ops delta over the
+        # same rolling window): the PackedBuilder-side rate the
+        # roofline/ingest work optimizes against.
+        (tI, iA), (tJ, iB) = ingest_window[0], ingest_window[-1]
+        if tJ > tI and iB > iA:
+            telemetry.gauge("monitor.ingest-ops-per-s",
+                            round((iB - iA) / (tJ - tI), 1))
         if cfg.inject_slo_s > 0:
             telemetry.gauge(
                 "monitor.injected",

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Any, Optional
 
 from .. import telemetry
@@ -177,7 +178,6 @@ def reset_chip(pattern: str = LOCKFILE_GLOB) -> str:
     settles briefly, and returns a note describing what was done
     (bench.py records it in its JSON)."""
     import glob
-    import time
 
     removed = []
     for path in glob.glob(pattern):
@@ -197,7 +197,13 @@ def probe_chip(timeout_s: float = 90.0) -> str:
     timeout.  Returns "ok", "wedged" (hang/timeout), or "absent" (no
     accelerator backend).  90 s covers a cold first compile (~20-40 s
     observed) with slack; a wedged tunnel hangs for hours, so the two
-    are cleanly separable."""
+    are cleanly separable.
+
+    Every probe leaves a structured trace in `_last_probe` (timing,
+    returncode, trimmed output); a "wedged" result additionally writes
+    the forensics dossier (`write_chip_dossier`) when
+    JEPSEN_CHIP_DOSSIER_DIR points somewhere — machine-readable
+    evidence for the still-open wedged-TPU investigation."""
     import subprocess
     import sys
 
@@ -207,19 +213,32 @@ def probe_chip(timeout_s: float = 90.0) -> str:
         "(x @ x).block_until_ready()\n"
         "print(jax.devices()[0].platform)\n"
     )
+    t0 = time.time()
+    trace: dict[str, Any] = {"at": t0, "timeout_s": timeout_s,
+                             "elapsed_s": None, "returncode": None,
+                             "stdout": None, "stderr": None}
     try:
         proc = subprocess.run(
             [sys.executable, "-c", code],
             timeout=timeout_s, capture_output=True,
         )
     except subprocess.TimeoutExpired:
+        trace["elapsed_s"] = round(time.time() - t0, 3)
+        _note_probe("wedged", trace)
         _set_chip_state("wedged")
+        _maybe_write_dossier()
         return "wedged"
+    trace["elapsed_s"] = round(time.time() - t0, 3)
+    trace["returncode"] = proc.returncode
+    trace["stdout"] = proc.stdout.decode(errors="replace")[-2000:]
+    trace["stderr"] = proc.stderr.decode(errors="replace")[-2000:]
     if proc.returncode != 0:
+        _note_probe("absent", trace)
         _set_chip_state("absent")
         return "absent"
     platform = proc.stdout.decode(errors="replace").strip()
     state = "ok" if platform == "tpu" else "absent"
+    _note_probe(state, trace)
     _set_chip_state(state)
     return state
 
@@ -251,9 +270,111 @@ def try_chip_reset(error: Optional[BaseException] = None) -> bool:
     ok = probe_chip() == "ok"
     if ok:
         _set_chip_state("ok-after-reset")
+    global _last_reset
+    _last_reset = {
+        "at": time.time(),
+        "note": note,
+        "recovered": ok,
+        "after_error": f"{type(error).__name__}: {error}"
+        if error else None,
+    }
     telemetry.count("wgl.degrade.chip-reset")
     record("chip-reset", "recovered" if ok else "still-wedged",
            f"{note}; probe {'ok' if ok else 'failed'}"
            + (f" (after {type(error).__name__})" if error else ""))
     flight.note("chip-reset", recovered=ok, detail=note)
+    if not ok:
+        _maybe_write_dossier()
     return ok
+
+
+# ---------------------------------------------------------------------------
+# Chip forensics dossier
+# ---------------------------------------------------------------------------
+
+#: When set, every "wedged" probe (and every failed reset rung) writes
+#: `chip.json` into this directory — next to CHIP_LOG.md when
+#: tools/chip_watch.py is driving.
+DOSSIER_ENV = "JEPSEN_CHIP_DOSSIER_DIR"
+
+#: Environment variables worth preserving as evidence (prefix match).
+_DOSSIER_ENV_PREFIXES = ("JAX_", "JEPSEN_", "TPU_", "LIBTPU",
+                         "XLA_", "PJRT_")
+
+#: Most recent probe_chip trace / reset-rung outcome (None until run).
+_last_probe: Optional[dict] = None
+_last_reset: Optional[dict] = None
+
+
+def _note_probe(state: str, trace: dict) -> None:
+    global _last_probe
+    trace = dict(trace)
+    trace["state"] = state
+    _last_probe = trace
+
+
+def chip_dossier() -> dict:
+    """The structured forensics snapshot for a wedged-chip report:
+    environment, toolchain versions, lockfile state, last probe timing,
+    and the reset rung's outcome.  Every field is best-effort — a
+    half-broken runtime must still produce evidence."""
+    import glob
+    import sys
+
+    out: dict[str, Any] = {
+        "v": 1,
+        "at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "chip_state": _chip_state,
+        "probe": dict(_last_probe) if _last_probe else None,
+        "reset": dict(_last_reset) if _last_reset else None,
+        "reset_tried": _chip_reset_tried,
+        "env": {k: v for k, v in sorted(os.environ.items())
+                if k.startswith(_DOSSIER_ENV_PREFIXES)},
+        "versions": {"python": sys.version.split()[0]},
+        "lockfiles": [],
+    }
+    for mod in ("jax", "jaxlib", "numpy"):
+        try:
+            out["versions"][mod] = __import__(mod).__version__
+        except Exception:  # noqa: BLE001 — evidence, not a dependency
+            out["versions"][mod] = None
+    try:
+        for path in sorted(glob.glob(LOCKFILE_GLOB)):
+            st = os.stat(path)
+            out["lockfiles"].append(
+                {"path": path, "mtime": st.st_mtime, "size": st.st_size}
+            )
+    except OSError:
+        pass
+    return out
+
+
+def write_chip_dossier(path: Optional[str] = None) -> Optional[str]:
+    """Writes `chip_dossier()` as JSON (atomic tmp+rename).  `path`
+    defaults to `$JEPSEN_CHIP_DOSSIER_DIR/chip.json`; returns the path
+    written, or None (no destination / write failed — forensics never
+    raise)."""
+    import json
+
+    if path is None:
+        d = os.environ.get(DOSSIER_ENV)
+        if not d:
+            return None
+        path = os.path.join(d, "chip.json")
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(chip_dossier(), f, indent=2, sort_keys=True,
+                      default=repr)
+            f.write("\n")
+        os.replace(tmp, path)
+        telemetry.count("wgl.degrade.chip-dossier")
+        return path
+    except (OSError, TypeError, ValueError):
+        return None
+
+
+def _maybe_write_dossier() -> None:
+    if os.environ.get(DOSSIER_ENV):
+        write_chip_dossier()

@@ -29,6 +29,7 @@ from typing import Any, Optional, Sequence
 import numpy as np
 
 from ..checker.elle.graph import DepGraph, check_cycles
+from ..telemetry import roofline
 
 _kernel_cache: dict[tuple, Any] = {}
 
@@ -98,15 +99,15 @@ def _get_kernel(K: int, V: int, mesh=None):
 
         shard_map, rep_kw = shard_map_compat()
 
-        fn = jax.jit(
+        fn = roofline.instrument(jax.jit(
             shard_map(
                 has_cycle, mesh=mesh,
                 in_specs=P("keys"), out_specs=P("keys"),
                 **rep_kw,
             )
-        )
+        ))
     else:
-        fn = jax.jit(has_cycle)
+        fn = roofline.instrument(jax.jit(has_cycle))
     _kernel_cache[key] = fn
     return fn
 
@@ -205,7 +206,7 @@ def _get_extract_kernel(K: int, V: int):
         return found, u.astype(jnp.int32), v.astype(jnp.int32), \
             parent, scc_size.astype(jnp.int32)
 
-    fn = jax.jit(jax.vmap(one))
+    fn = roofline.instrument(jax.jit(jax.vmap(one)))
     _kernel_cache[key] = fn
     return fn
 

@@ -40,7 +40,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
-from ..telemetry import profile
+from ..telemetry import profile, roofline
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
@@ -208,7 +208,7 @@ def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step):
         )
         return jax.lax.while_loop(cond, body, carry)
 
-    return jax.jit(block)
+    return roofline.instrument(jax.jit(block))
 
 
 def _make_block_fn_sharded(B: int, W: int, SW: int, Cmax: int, jax_step,
@@ -319,7 +319,7 @@ def _make_block_fn_sharded(B: int, W: int, SW: int, Cmax: int, jax_step,
         out_specs=(P(axis, None), P(axis, None), pb, pr, pr, pr, pr),
         **rep_kw,
     )
-    return jax.jit(sharded)
+    return roofline.instrument(jax.jit(sharded))
 
 
 def _bucket(x: int, lo: int = 256) -> int:

@@ -32,7 +32,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
-from ..telemetry import profile
+from ..telemetry import profile, roofline
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
 from . import degrade
@@ -245,7 +245,7 @@ def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None):
             batched, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
             **rep_kw,
         )
-    fn = jax.jit(batched)
+    fn = roofline.instrument(jax.jit(batched))
     _kernel_cache[key] = fn
     return fn
 

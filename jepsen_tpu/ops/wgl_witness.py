@@ -66,6 +66,7 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
+from ..telemetry import roofline
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
@@ -834,7 +835,7 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         independently of S: two histories sharing every other shape
         must not re-pay the Mosaic lowering probe because their spans
         bucket differently."""
-        return jax.jit(_chunk_dev_for(S))
+        return roofline.instrument(jax.jit(_chunk_dev_for(S)))
 
     def _chunk_dev_for(S: int):
         def chunk_dev(member, states, alive, failed, prev_act,
@@ -909,7 +910,8 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         )
         return carry
 
-    return jax.jit(chunk), jax.jit(chunk_idx), make_chunk_dev
+    return (roofline.instrument(jax.jit(chunk)),
+            roofline.instrument(jax.jit(chunk_idx)), make_chunk_dev)
 
 
 def check_wgl_witness(

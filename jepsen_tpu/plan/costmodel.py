@@ -67,8 +67,26 @@ SHAPE_KEYS = ("keys", "ops", "ok")
 KNOB_KEYS = ("segment", "max_restarts", "beam", "max_beam", "block")
 
 
-def featurize(features: dict, plan: dict) -> dict[str, float]:
+#: Roofline cost features a v2 profile record can contribute
+#: (telemetry/roofline.py): log-scaled like everything else, and absent
+#: (0.0 via x.get default) when a record predates the roofline block or
+#: the backend could not report cost analysis — so mixed v1/v2 stores
+#: train and predict without special-casing.
+COST_KEYS = ("flops", "bytes_accessed")
+
+
+def featurize(features: dict, plan: dict,
+              cost: Optional[dict] = None) -> dict[str, float]:
     x: dict[str, float] = {}
+    cvals: dict[str, float] = {}
+    for k in COST_KEYS:
+        v = (cost or {}).get(k)
+        if isinstance(v, (int, float)) and v >= 0:
+            cvals[k] = float(v)
+            x[f"log_{k}"] = math.log1p(float(v))
+    if cvals.get("bytes_accessed"):
+        x["log_intensity"] = math.log1p(
+            cvals.get("flops", 0.0) / cvals["bytes_accessed"])
     for k in SHAPE_KEYS:
         v = features.get(k)
         if isinstance(v, (int, float)) and v >= 0:
@@ -119,12 +137,13 @@ class CostModel:
         return pass_name in self.passes
 
     def predict_s(self, pass_name: str, features: dict,
-                  plan: dict) -> Optional[float]:
+                  plan: dict, cost: Optional[dict] = None
+                  ) -> Optional[float]:
         p = self.passes.get(pass_name)
         if p is None:
             return None
         try:
-            x = featurize(features, plan)
+            x = featurize(features, plan, cost)
             coef = p["coef"]
             y = float(coef[0])
             for name, c in zip(p["names"], coef[1:]):
@@ -182,7 +201,9 @@ def fit(records: Iterable[dict], *,
         if cost < 0:
             continue
         plan = rec.get("plan") or {}
-        x = featurize(rec.get("features") or {}, plan)
+        xla_cost = rec.get("cost")
+        x = featurize(rec.get("features") or {}, plan,
+                      xla_cost if isinstance(xla_cost, dict) else None)
         by_pass.setdefault(name, []).append((x, cost))
         sup = support.setdefault(name, {})
         for k in KNOB_KEYS:

@@ -274,12 +274,16 @@ class RemoteFeed:
                 i0, ops = i, []
             ops.append(od)
         runs.append((i0, ops))
-        for i, ops in runs:
-            c._send(F_CHUNK, {"key": i, "ops": ops})
-        c.wf.flush()
+        with telemetry.span("ingest.frame", frames=len(runs),
+                            ops=len(batch)):
+            for i, ops in runs:
+                c._send(F_CHUNK, {"key": i, "ops": ops})
+            c.wf.flush()
         with self._lock:
             self.ops_sent += len(batch)
         telemetry.count("wgl.online.remote-ops", len(batch))
+        telemetry.count("ingest.frame.frames", len(runs))
+        telemetry.count("ingest.frame.ops", len(batch))
 
     def _resume(self, why: str) -> bool:
         """Reconnects, re-attaches to the parked daemon-side submission

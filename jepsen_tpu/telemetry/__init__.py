@@ -125,6 +125,7 @@ FLEET_COUNTER_PREFIXES = (
     "wgl.plan.",
     "checkerd.",
     "router.",
+    "ingest.",
 )
 
 
@@ -289,6 +290,15 @@ def count(name: str, n: Any = 1) -> None:
         return
     with _lock:
         _counters[name] = _counters.get(name, 0) + n
+
+
+def counter_value(name: str) -> float:
+    """The current value of one named counter (0 when absent) — the
+    cheap single-counter read rate derivations (monitor cadence) need
+    without building the whole summary()."""
+    with _lock:
+        v = _counters.get(name, 0)
+    return float(v) if isinstance(v, (int, float)) else 0.0
 
 
 def gauge(name: str, value: Any) -> None:

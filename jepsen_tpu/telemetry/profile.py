@@ -3,7 +3,8 @@
 Every WGL checking pass (witness / stream / frontier / batched / BFS /
 settle / exact-CPU) runs under `capture()`, which assembles one
 structured record — history-shape features, plan knobs, the measured
-compile-vs-execute split, device-memory high-water mark, and the
+compile-vs-execute split, XLA FLOP/byte cost, the roofline position
+against device peaks, device-memory high-water mark, and the
 degradation/outcome — and appends it to a crash-safe JSONL store under
 the run's store dir (checkerd keeps its own store and aggregates
 fleet-wide counts into stats()).
@@ -14,17 +15,33 @@ record, so a SIGKILL mid-run loses at most the line being written;
 learned cost model can therefore always train on whatever survived.
 
 Record schema (`SCHEMA_VERSION`, field-by-field meaning in
-doc/design.md "Fleet observatory"):
+doc/design.md "Roofline observatory"):
 
     {"v", "ts", "trace_id", "pass", "features": {...},
      "plan": {...}, "timing": {"compile_s", "execute_s", "total_s"},
-     "device": {"platform", "peak_bytes"}, "outcome", "degraded"}
+     "cost": {"flops", "bytes_accessed", "transcendentals",
+              "device_calls"},
+     "roofline": {"achieved_flops_per_s", "achieved_bytes_per_s",
+                  "arithmetic_intensity", "flops_ratio",
+                  "bandwidth_ratio", "knee_intensity", "bound",
+                  "peak_flops_per_s", "peak_bytes_per_s",
+                  "peak_source"},
+     "device": {"platform", "device_kind", "peak_bytes"},
+     "outcome", "degraded"}
+
+v1 records (PR 9 .. 15) predate the cost/roofline blocks; `normalize`
+fills them with explicit nulls so mixed stores keep loading.  Every
+cost/roofline field is None — never missing, never a dropped record —
+on backends that can't report cost analysis.
 
 The compile/execute split rides the span taxonomy: span names ending
 ``.compile`` accumulate into compile_s; execute spans (``.chunk`` /
 ``.block``) into execute_s — both folded in via the per-thread
 span-exit hook, so nested passes (a settle cohort running batched
 kernels) see their children's device time without double bookkeeping.
+FLOP/byte cost rides a second per-thread hook the same way:
+roofline-instrumented jit wrappers call `note_cost`, and nested
+captures chain so a settle cohort accumulates its children's FLOPs.
 """
 
 from __future__ import annotations
@@ -47,7 +64,7 @@ from . import count as _count
 
 log = logging.getLogger(__name__)
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 #: File name of the profile store inside a store/run directory.
 PROFILE_FILE = "profiles.jsonl"
@@ -56,8 +73,60 @@ PROFILE_FILE = "profiles.jsonl"
 COMPILE_SUFFIXES = (".compile",)
 EXECUTE_SUFFIXES = (".chunk", ".block")
 
+#: Explicit-null templates for the v2 blocks: a record always carries
+#: every key, with None marking "backend could not report this".
+COST_NULL = {"flops": None, "bytes_accessed": None,
+             "transcendentals": None, "device_calls": 0}
+ROOFLINE_NULL = {
+    "achieved_flops_per_s": None, "achieved_bytes_per_s": None,
+    "arithmetic_intensity": None, "flops_ratio": None,
+    "bandwidth_ratio": None, "knee_intensity": None, "bound": None,
+    "peak_flops_per_s": None, "peak_bytes_per_s": None,
+    "peak_source": None,
+}
+DEVICE_NULL = {"platform": None, "device_kind": None,
+               "peak_bytes": None}
+
 _lock = threading.Lock()
 _store_path: Optional[str] = None
+
+#: Per-thread cost hook: `capture()` installs a callback
+#: `(cost: dict) -> None`; roofline-instrumented jits call `note_cost`
+#: after each device call to fold {flops, bytes_accessed,
+#: transcendentals} into the active pass record.
+_cost_hook = threading.local()
+
+
+def set_cost_hook(cb: Optional[Any]) -> None:
+    """Installs this thread's cost callback (None clears)."""
+    _cost_hook.cb = cb
+
+
+def note_cost(cost: dict) -> None:
+    """Reports one device call's XLA cost to the active capture (no-op
+    outside a capture).  A hook failure never changes the pass."""
+    cb = getattr(_cost_hook, "cb", None)
+    if cb is None:
+        return
+    try:
+        cb(cost)
+    except Exception:  # noqa: BLE001 — profiling must not raise
+        log.debug("cost hook failed", exc_info=True)
+
+
+def note_cost_pending(resolver: Any, key: tuple, specs: tuple) -> None:
+    """Reports one device call whose cost is not yet known: the active
+    capture stores (resolver, key, specs) and calls
+    `resolver.resolve(key, specs)` at record() time — AFTER the pass's
+    clocks are read — so the ~100 ms-per-novel-shape lowering never
+    lands inside a measured span."""
+    cb = getattr(_cost_hook, "pending", None)
+    if cb is None:
+        return
+    try:
+        cb(resolver, key, specs)
+    except Exception:  # noqa: BLE001 — profiling must not raise
+        log.debug("pending-cost hook failed", exc_info=True)
 
 
 def set_store(directory: Optional[str]) -> Optional[str]:
@@ -100,12 +169,14 @@ def append(record: dict) -> Optional[str]:
 
 
 def normalize(rec: dict) -> dict:
-    """A raw store record coerced to the canonical shape every consumer
-    (profile_diff, costmodel_train, the observatory) can index without
-    KeyError.  Stores are written by whichever process version happens
-    to be running — client and daemon records routinely disagree on
-    schema — so missing/mistyped keys degrade to neutral values
-    (pass -> "unknown", dicts -> {}) instead of raising."""
+    """A raw store record coerced to the canonical v2 shape every
+    consumer (profile_diff, costmodel_train, perf_gate, the
+    observatory) can index without KeyError.  Stores are written by
+    whichever process version happens to be running — client and
+    daemon records routinely disagree on schema, and v1 records
+    predate the cost/roofline blocks — so missing/mistyped keys
+    degrade to neutral values (pass -> "unknown", dicts -> {},
+    cost/roofline -> explicit nulls) instead of raising."""
     name = rec.get("pass")
     out = dict(rec)
     out["pass"] = name if isinstance(name, str) and name else "unknown"
@@ -119,6 +190,16 @@ def normalize(rec: dict) -> dict:
         except (TypeError, ValueError):
             continue
     out["timing"] = timing
+    for k, template in (("cost", COST_NULL),
+                        ("roofline", ROOFLINE_NULL),
+                        ("device", DEVICE_NULL)):
+        v = rec.get(k)
+        block = dict(template)
+        if isinstance(v, dict):
+            block.update(v)
+        out[k] = block
+    v = rec.get("v")
+    out["v"] = v if isinstance(v, int) else 1
     return out
 
 
@@ -166,20 +247,33 @@ def by_pass(path: Optional[str] = None) -> dict[str, int]:
 
 
 def _device_info() -> dict:
-    """Best-effort device platform + peak-memory HWM.  CPU backends
-    report no memory_stats; any failure degrades to nulls."""
-    info: dict[str, Any] = {"platform": None, "peak_bytes": None}
+    """Best-effort device platform, kind, and peak-memory HWM.  Each
+    field fails open independently: a backend exposing memory_stats
+    but raising in device_kind (or vice versa) loses only that field,
+    never the whole block."""
+    info: dict[str, Any] = dict(DEVICE_NULL)
     try:
         import jax
 
         dev = jax.local_devices()[0]
+    except Exception:  # noqa: BLE001 — profiling never raises
+        return info
+    try:
         info["platform"] = getattr(dev, "platform", None)
-        stats = dev.memory_stats() if hasattr(dev, "memory_stats") else None
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        info["device_kind"] = getattr(dev, "device_kind", None)
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        stats = dev.memory_stats() if hasattr(dev, "memory_stats") \
+            else None
         if stats:
             info["peak_bytes"] = stats.get(
                 "peak_bytes_in_use", stats.get("bytes_in_use")
             )
-    except Exception:  # noqa: BLE001 — profiling never raises
+    except Exception:  # noqa: BLE001
         pass
     return info
 
@@ -188,7 +282,8 @@ class Capture:
     """The mutable record under assembly; `capture()` yields it."""
 
     __slots__ = ("pass_name", "features", "plan", "outcome", "degraded",
-                 "_compile_ns", "_execute_ns", "_t0")
+                 "_compile_ns", "_execute_ns", "_t0",
+                 "_cost", "_device_calls", "_pending")
 
     def __init__(self, pass_name: str):
         self.pass_name = pass_name
@@ -198,6 +293,9 @@ class Capture:
         self.degraded: Any = None
         self._compile_ns = 0
         self._execute_ns = 0
+        self._cost: dict[str, float] = {}
+        self._device_calls = 0
+        self._pending: dict[tuple, list] = {}
         self._t0 = time.perf_counter_ns()
 
     def feature(self, **kw: Any) -> None:
@@ -212,9 +310,61 @@ class Capture:
         elif name.endswith(EXECUTE_SUFFIXES):
             self._execute_ns += dur_ns
 
+    def add_cost(self, cost: dict, n: int = 1) -> None:
+        """Accumulates `n` device calls' {flops, bytes_accessed,
+        transcendentals} into the pass total (unknown fields skipped)."""
+        self._device_calls += n
+        for key in ("flops", "bytes_accessed", "transcendentals"):
+            v = cost.get(key)
+            if isinstance(v, (int, float)):
+                self._cost[key] = self._cost.get(key, 0.0) + float(v) * n
+
+    def add_pending(self, resolver: Any, key: tuple,
+                    specs: tuple) -> None:
+        """Remembers one call whose cost resolves at record() time
+        (repeat calls with the same signature just bump the count)."""
+        k = (id(resolver), key)
+        ent = self._pending.get(k)
+        if ent is None:
+            self._pending[k] = [resolver, key, specs, 1]
+        else:
+            ent[3] += 1
+
+    def _resolve_pending(self) -> None:
+        for resolver, key, specs, n in self._pending.values():
+            try:
+                cost = resolver.resolve(key, specs)
+            except Exception:  # noqa: BLE001 — cost is advisory
+                cost = None
+            if cost:
+                self.add_cost(cost, n)
+        self._pending.clear()
+
     def record(self) -> dict:
+        # Read the clock BEFORE resolving pending cost analyses: the
+        # deferred lowerings are exactly the work we keep out of the
+        # measured numbers.
         total = time.perf_counter_ns() - self._t0
+        self._resolve_pending()
         dev = _device_info()
+        timing = {
+            "compile_s": round(self._compile_ns / 1e9, 6),
+            "execute_s": round(self._execute_ns / 1e9, 6),
+            "total_s": round(total / 1e9, 6),
+        }
+        cost = dict(COST_NULL)
+        cost["device_calls"] = self._device_calls
+        for k, v in self._cost.items():
+            cost[k] = round(v, 3)
+        roofline = dict(ROOFLINE_NULL)
+        try:
+            from . import roofline as _roofline
+
+            roofline.update(_roofline.annotate(
+                timing, cost if self._cost else None, dev))
+        except Exception:  # noqa: BLE001 — the roofline block is
+            # advisory; its failure must not drop the record
+            log.debug("roofline annotate failed", exc_info=True)
         return {
             "v": SCHEMA_VERSION,
             "ts": time.time(),
@@ -222,11 +372,9 @@ class Capture:
             "pass": self.pass_name,
             "features": dict(self.features),
             "plan": dict(self.plan),
-            "timing": {
-                "compile_s": round(self._compile_ns / 1e9, 6),
-                "execute_s": round(self._execute_ns / 1e9, 6),
-                "total_s": round(total / 1e9, 6),
-            },
+            "timing": timing,
+            "cost": cost,
+            "roofline": roofline,
             "device": dev,
             "outcome": self.outcome,
             "degraded": self.degraded,
@@ -235,24 +383,39 @@ class Capture:
 
 @contextlib.contextmanager
 def capture(pass_name: str, **features: Any) -> Iterator[Capture]:
-    """Profiles one checking pass: installs the span-exit hook (chained
-    with any enclosing capture, so a settle cohort also sees its
-    batched children's compile/execute time), times the body, and
-    appends the assembled record on exit.  Cheap no-op when telemetry
-    is disabled."""
+    """Profiles one checking pass: installs the span-exit and cost
+    hooks (chained with any enclosing capture, so a settle cohort also
+    sees its batched children's compile/execute time and FLOPs), times
+    the body, and appends the assembled record on exit.  Cheap no-op
+    when telemetry is disabled."""
     cap = Capture(pass_name)
     cap.features.update(features)
     if not enabled():
         yield cap
         return
     prev = getattr(_pass_hook, "cb", None)
+    prev_cost = getattr(_cost_hook, "cb", None)
 
     def hook(name: str, dur_ns: int) -> None:
         cap._on_span(name, dur_ns)
         if prev is not None:
             prev(name, dur_ns)
 
+    def cost_cb(cost: dict) -> None:
+        cap.add_cost(cost)
+        if prev_cost is not None:
+            prev_cost(cost)
+
+    prev_pending = getattr(_cost_hook, "pending", None)
+
+    def pending_cb(resolver: Any, key: tuple, specs: tuple) -> None:
+        cap.add_pending(resolver, key, specs)
+        if prev_pending is not None:
+            prev_pending(resolver, key, specs)
+
     set_pass_hook(hook)
+    set_cost_hook(cost_cb)
+    _cost_hook.pending = pending_cb
     try:
         yield cap
     except Exception as e:
@@ -261,4 +424,13 @@ def capture(pass_name: str, **features: Any) -> Iterator[Capture]:
         raise
     finally:
         set_pass_hook(prev)
-        append(cap.record())
+        set_cost_hook(prev_cost)
+        _cost_hook.pending = prev_pending
+        rec = cap.record()
+        append(rec)
+        try:
+            from . import roofline as _roofline
+
+            _roofline.export_gauges(rec)
+        except Exception:  # noqa: BLE001
+            log.debug("roofline gauge export failed", exc_info=True)

@@ -1,0 +1,487 @@
+"""Roofline accounting: per-pass FLOP/byte cost vs device peaks.
+
+The observability half of ROADMAP item 1 ("peak-FLOPs WGL kernels"):
+before any kernel can be *driven* toward peak, the tree must be able to
+say how far from peak each pass runs.  This module
+
+  * pulls XLA's HLO cost analysis off a jitted callable
+    (`cost_analysis`), normalizing the two shapes jax hands back —
+    `Lowered.cost_analysis()` returns a flat dict, and
+    `Compiled.cost_analysis()` a per-computation list of dicts — and
+    failing open to None on any backend that can't report it;
+  * wraps jit creation sites (`instrument`) so every device call notes
+    {flops, bytes_accessed, transcendentals} into the enclosing
+    `profile.capture` via the per-thread cost hook, cached per
+    argument-aval signature so the lowering is paid once per shape;
+  * holds a small device-peak registry (known TPU generations by
+    device_kind substring, plus a CPU fallback calibrated once by a
+    tiny matmul/memcpy probe and cached on disk), and
+  * turns measured execute_s + cost into the roofline block
+    (`annotate`): achieved FLOP/s, bytes/s, arithmetic intensity,
+    fraction-of-peak ratios, the memory/compute knee, and which side of
+    it the pass landed on.
+
+Everything here is advisory: a cost-analysis failure, an unknown
+device, or a cache write error degrades to explicit nulls — never a
+dropped record, never a changed verdict.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Optional
+
+from . import enabled as _enabled
+from . import gauge as _gauge
+
+log = logging.getLogger(__name__)
+
+#: Cost keys every record carries (explicit None when unknown).
+COST_KEYS = ("flops", "bytes_accessed", "transcendentals")
+
+#: XLA cost-analysis key -> record key.  XLA spells the byte counter
+#: with a space ("bytes accessed").
+_XLA_KEYS = {
+    "flops": "flops",
+    "bytes accessed": "bytes_accessed",
+    "transcendentals": "transcendentals",
+}
+
+#: Roofline keys `annotate` emits (explicit None when underivable).
+ROOFLINE_KEYS = (
+    "achieved_flops_per_s", "achieved_bytes_per_s",
+    "arithmetic_intensity", "flops_ratio", "bandwidth_ratio",
+    "knee_intensity", "bound",
+)
+
+#: Peak FLOP/s and HBM bytes/s by TPU generation, matched as a
+#: substring of `device.device_kind` (bf16 matmul peaks per chip, HBM
+#: bandwidth per chip — the published per-generation datasheet
+#: numbers; good to the ~10% a roofline plot needs, not a benchmark).
+TPU_PEAKS = (
+    # (kind substring, peak_flops_per_s, peak_bytes_per_s)
+    ("v6", 918e12, 1640e9),
+    ("v5p", 459e12, 2765e9),
+    ("v5e", 197e12, 819e9),
+    ("v5 lite", 197e12, 819e9),
+    ("v4", 275e12, 1228e9),
+    ("v3", 123e12, 900e9),
+    ("v2", 45e12, 700e9),
+)
+
+#: On-disk cache for the CPU calibration probe (one file per machine;
+#: override with JEPSEN_ROOFLINE_CACHE, empty string disables disk).
+CACHE_ENV = "JEPSEN_ROOFLINE_CACHE"
+_DEFAULT_CACHE = os.path.join(
+    os.path.expanduser("~"), ".cache", "jepsen_tpu", "roofline_cpu.json"
+)
+
+#: Per-instrumented-fn cap on cached aval signatures (a runaway shape
+#: space must not grow memory unboundedly).
+_COST_CACHE_CAP = 64
+
+_lock = threading.Lock()
+_cpu_peaks: Optional[dict] = None  # process-level calibration memo
+
+# ---------------------------------------------------------------- cost
+
+
+def _normalize_cost(raw: Any) -> Optional[dict]:
+    """XLA cost analysis (dict, or Compiled's list of per-computation
+    dicts) -> {flops, bytes_accessed, transcendentals} with numeric
+    values, or None when nothing usable is present."""
+    if isinstance(raw, (list, tuple)):
+        merged: dict[str, float] = {}
+        for entry in raw:
+            got = _normalize_cost(entry)
+            if got:
+                for k, v in got.items():
+                    if v is not None:
+                        merged[k] = merged.get(k, 0.0) + v
+        return merged and {
+            k: merged.get(k) for k in COST_KEYS
+        } or None
+    if not isinstance(raw, dict):
+        return None
+    out: dict[str, Optional[float]] = {}
+    for xla_key, key in _XLA_KEYS.items():
+        v = raw.get(xla_key)
+        if isinstance(v, (int, float)) and v >= 0:
+            out[key] = float(v)
+    if not out:
+        return None
+    return {k: out.get(k) for k in COST_KEYS}
+
+
+def cost_analysis(fn: Any, *args: Any, **kwargs: Any) -> Optional[dict]:
+    """Best-effort {flops, bytes_accessed, transcendentals} for calling
+    `fn(*args, **kwargs)`.  Tries, in order: `fn.cost_analysis()` (fn
+    is already a Lowered/Compiled), `fn.lower(...).cost_analysis()`
+    (fn is a jitted callable; lowering runs HloCostAnalysis without an
+    XLA compile).  Fails open to None."""
+    for attempt in (
+        lambda: fn.cost_analysis(),
+        lambda: fn.lower(*args, **kwargs).cost_analysis(),
+    ):
+        try:
+            got = _normalize_cost(attempt())
+        except Exception:  # noqa: BLE001 — backend support is optional
+            got = None
+        if got is not None:
+            return got
+    return None
+
+
+def _aval_key(args: tuple, kwargs: dict) -> Optional[tuple]:
+    """Hashable (shape, dtype) signature of a call's arguments — the
+    cache key under which one lowering's cost stands for every call
+    with the same avals.  None when an argument defies summarizing."""
+    parts = []
+    try:
+        for a in list(args) + sorted(kwargs.items()):
+            if isinstance(a, tuple):
+                a = a[1]
+            shape = getattr(a, "shape", None)
+            dtype = getattr(a, "dtype", None)
+            if shape is not None:
+                parts.append((tuple(shape), str(dtype)))
+            elif isinstance(a, (int, float, bool)) or a is None:
+                parts.append(("py", repr(a)))
+            else:
+                return None
+        return tuple(parts)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _specs(args: tuple, kwargs: dict) -> tuple:
+    """Replaces array-likes with jax.ShapeDtypeStruct so a deferred
+    lowering needs no live device buffers (scalars pass through)."""
+    import jax
+
+    def spec(a: Any) -> Any:
+        if hasattr(a, "shape") and hasattr(a, "dtype"):
+            return jax.ShapeDtypeStruct(a.shape, a.dtype)
+        return a
+
+    return (tuple(spec(a) for a in args),
+            {k: spec(v) for k, v in kwargs.items()})
+
+
+class _Instrumented:
+    """A jitted callable that notes its XLA cost into the enclosing
+    profile.capture on every call.  Transparent otherwise: `.fn` is
+    the wrapped jit, and lower/trace attributes pass through.
+
+    The expensive part — `fn.lower(...).cost_analysis()`, ~100 ms per
+    novel aval signature — NEVER runs on the call path: an unresolved
+    signature is handed to the capture as a pending entry (aval specs
+    only, no buffers) and resolved at record() time, after the pass's
+    wall clock has been read.  A ~ms lowering inside a measured span
+    would otherwise dominate exactly the small kernels the profile
+    store exists to compare (it visibly skewed the stream-sweep knob
+    medians the cost model trains on)."""
+
+    __slots__ = ("fn", "_costs")
+
+    def __init__(self, fn: Callable):
+        self.fn = fn
+        self._costs: dict[tuple, Optional[dict]] = {}
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Any:
+        out = self.fn(*args, **kwargs)
+        if _enabled():
+            try:
+                self._note(args, kwargs)
+            except Exception:  # noqa: BLE001 — never change the pass
+                log.debug("roofline note failed", exc_info=True)
+        return out
+
+    def _note(self, args: tuple, kwargs: dict) -> None:
+        from . import profile
+
+        key = _aval_key(args, kwargs)
+        if key is None:
+            return
+        if key in self._costs:
+            cost = self._costs[key]
+            if cost is not None:
+                profile.note_cost(cost)
+            return
+        profile.note_cost_pending(self, key, _specs(args, kwargs))
+
+    def resolve(self, key: tuple, specs: tuple) -> Optional[dict]:
+        """Computes (and caches) the cost for one aval signature from
+        its buffer-free specs — called by Capture.record() outside the
+        measured window."""
+        if key not in self._costs:
+            if len(self._costs) >= _COST_CACHE_CAP:
+                self._costs.clear()
+            args, kwargs = specs
+            self._costs[key] = cost_analysis(self.fn, *args, **kwargs)
+        return self._costs[key]
+
+    def lower(self, *args: Any, **kwargs: Any) -> Any:
+        return self.fn.lower(*args, **kwargs)
+
+
+def instrument(fn: Callable) -> Callable:
+    """Wraps a jitted callable so each call reports its XLA FLOP/byte
+    cost to the active capture (idempotent; cheap when disabled)."""
+    if isinstance(fn, _Instrumented):
+        return fn
+    return _Instrumented(fn)
+
+
+# --------------------------------------------------------------- peaks
+
+
+def _cache_path() -> Optional[str]:
+    p = os.environ.get(CACHE_ENV)
+    if p == "":
+        return None
+    return p or _DEFAULT_CACHE
+
+
+def _calibrate_cpu_probe() -> dict:
+    """One tiny matmul + memcpy probe: measured CPU peak FLOP/s and
+    bytes/s for the roofline denominator.  ~100ms once per machine."""
+    import numpy as np
+
+    n = 256
+    a = np.random.default_rng(0).random((n, n), dtype=np.float32)
+    b = a.copy()
+    best_flops = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        a @ b
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            best_flops = max(best_flops, 2.0 * n * n * n / dt)
+    buf = np.zeros(4 << 20, dtype=np.uint8)
+    best_bw = 0.0
+    for _ in range(3):
+        t0 = time.perf_counter()
+        buf.copy()
+        dt = time.perf_counter() - t0
+        if dt > 0:
+            best_bw = max(best_bw, 2.0 * buf.nbytes / dt)
+    return {
+        "peak_flops_per_s": best_flops or None,
+        "peak_bytes_per_s": best_bw or None,
+        "source": "cpu-calibrated",
+        "calibrated_at": time.time(),
+    }
+
+
+def calibrate_cpu(force: bool = False) -> dict:
+    """The calibrated CPU peaks: process memo -> disk cache -> run the
+    probe (then persist both).  `force` re-measures."""
+    global _cpu_peaks
+    with _lock:
+        if _cpu_peaks is not None and not force:
+            return dict(_cpu_peaks)
+    path = _cache_path()
+    if path and not force:
+        try:
+            with open(path) as f:
+                got = json.load(f)
+            if isinstance(got, dict) and got.get("peak_flops_per_s"):
+                with _lock:
+                    _cpu_peaks = got
+                return dict(got)
+        except (OSError, ValueError):
+            pass
+    peaks = _calibrate_cpu_probe()
+    with _lock:
+        _cpu_peaks = peaks
+    if path:
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(peaks, f)
+            os.replace(tmp, path)
+        except OSError:
+            pass
+    return dict(peaks)
+
+
+def peaks_for_device(device: Optional[dict]) -> dict:
+    """{peak_flops_per_s, peak_bytes_per_s, source} for a record's
+    `device` block.  TPU -> generation registry by device_kind
+    substring; CPU -> calibrated probe; anything else -> nulls."""
+    null = {"peak_flops_per_s": None, "peak_bytes_per_s": None,
+            "source": None}
+    if not isinstance(device, dict):
+        return null
+    platform = (device.get("platform") or "").lower()
+    if platform == "tpu":
+        kind = (device.get("device_kind") or "").lower()
+        for sub, flops, bw in TPU_PEAKS:
+            if sub in kind:
+                return {"peak_flops_per_s": flops,
+                        "peak_bytes_per_s": bw,
+                        "source": f"tpu-registry:{sub}"}
+        return null
+    if platform == "cpu":
+        try:
+            got = calibrate_cpu()
+        except Exception:  # noqa: BLE001 — numpy probe must fail open
+            return null
+        return {"peak_flops_per_s": got.get("peak_flops_per_s"),
+                "peak_bytes_per_s": got.get("peak_bytes_per_s"),
+                "source": got.get("source", "cpu-calibrated")}
+    return null
+
+
+# ------------------------------------------------------------ annotate
+
+
+def _num(v: Any) -> Optional[float]:
+    return float(v) if isinstance(v, (int, float)) else None
+
+
+def _sig(v: float) -> float:
+    """6 significant figures: decimal-place rounding flattens
+    achieved/peak ratios (25 B/s over a 1.2 TB/s peak is 2e-11 — zero
+    at round(_, 9)) while keeping the JSON short."""
+    return float(f"{v:.6g}")
+
+
+def annotate(timing: Optional[dict], cost: Optional[dict],
+             device: Optional[dict] = None) -> dict:
+    """The record's `roofline` block: achieved rates, intensity, and
+    position against the device peaks.  Every underivable field is an
+    explicit None so consumers index without KeyError."""
+    out: dict[str, Any] = {k: None for k in ROOFLINE_KEYS}
+    peaks = peaks_for_device(device)
+    pf = _num(peaks.get("peak_flops_per_s"))
+    pb = _num(peaks.get("peak_bytes_per_s"))
+    out["peak_flops_per_s"] = pf
+    out["peak_bytes_per_s"] = pb
+    out["peak_source"] = peaks.get("source")
+    if pf and pb:
+        out["knee_intensity"] = round(pf / pb, 4)
+    ex = _num((timing or {}).get("execute_s"))
+    flops = _num((cost or {}).get("flops"))
+    byt = _num((cost or {}).get("bytes_accessed"))
+    if ex and ex > 0:
+        if flops is not None:
+            out["achieved_flops_per_s"] = round(flops / ex, 3)
+        if byt is not None:
+            out["achieved_bytes_per_s"] = round(byt / ex, 3)
+    if flops is not None and byt:
+        out["arithmetic_intensity"] = round(flops / byt, 6)
+    if out["achieved_flops_per_s"] is not None and pf:
+        out["flops_ratio"] = _sig(out["achieved_flops_per_s"] / pf)
+    if out["achieved_bytes_per_s"] is not None and pb:
+        out["bandwidth_ratio"] = _sig(out["achieved_bytes_per_s"] / pb)
+    ai, knee = out["arithmetic_intensity"], out["knee_intensity"]
+    if ai is not None and knee is not None:
+        out["bound"] = "compute" if ai >= knee else "memory"
+    return out
+
+
+def export_gauges(record: dict) -> None:
+    """Publishes one record's roofline numbers as wgl.roofline.* gauges
+    (pass-scoped), so /metrics scrapes carry the latest achieved-vs-
+    peak position per pass with zero extra plumbing."""
+    if not _enabled():
+        return
+    name = record.get("pass") or "unknown"
+    roof = record.get("roofline")
+    cost = record.get("cost")
+    if not isinstance(roof, dict):
+        return
+    for key in ("achieved_flops_per_s", "achieved_bytes_per_s",
+                "arithmetic_intensity", "flops_ratio",
+                "bandwidth_ratio"):
+        v = roof.get(key)
+        if isinstance(v, (int, float)):
+            _gauge(f"wgl.roofline.{name}.{key}", v)
+    if isinstance(cost, dict):
+        for key in ("flops", "bytes_accessed"):
+            v = cost.get(key)
+            if isinstance(v, (int, float)):
+                _gauge(f"wgl.roofline.{name}.{key}", v)
+
+
+# ------------------------------------------------------------ summarize
+
+
+def _median(vals: list[float]) -> Optional[float]:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    n = len(vals)
+    mid = n // 2
+    if n % 2:
+        return vals[mid]
+    return (vals[mid - 1] + vals[mid]) / 2.0
+
+
+def summarize(records: list[dict]) -> dict:
+    """Per-pass roofline aggregate over normalized records: medians of
+    the achieved/ratio fields, the consensus bound, and coverage (how
+    many records actually carried cost numbers) — the shape the
+    checkerd STATS block, /fleet panel, and bench JSON all share."""
+    by_pass: dict[str, dict[str, list]] = {}
+    for rec in records:
+        name = rec.get("pass") or "unknown"
+        slot = by_pass.setdefault(name, {
+            "n": [], "execute_s": [], "flops": [], "bytes_accessed": [],
+            "achieved_flops_per_s": [], "achieved_bytes_per_s": [],
+            "arithmetic_intensity": [], "flops_ratio": [],
+            "bandwidth_ratio": [], "bound": [], "knee": [],
+        })
+        slot["n"].append(1)
+        cost = rec.get("cost") if isinstance(rec.get("cost"), dict) \
+            else {}
+        roof = rec.get("roofline") \
+            if isinstance(rec.get("roofline"), dict) else {}
+        ex = _num((rec.get("timing") or {}).get("execute_s"))
+        if ex is not None:
+            slot["execute_s"].append(ex)
+        for key in ("flops", "bytes_accessed"):
+            v = _num(cost.get(key))
+            if v is not None:
+                slot[key].append(v)
+        for key in ("achieved_flops_per_s", "achieved_bytes_per_s",
+                    "arithmetic_intensity", "flops_ratio",
+                    "bandwidth_ratio"):
+            v = _num(roof.get(key))
+            if v is not None:
+                slot[key].append(v)
+        if roof.get("bound") in ("compute", "memory"):
+            slot["bound"].append(roof["bound"])
+        v = _num(roof.get("knee_intensity"))
+        if v is not None:
+            slot["knee"].append(v)
+    out: dict[str, dict] = {}
+    for name, slot in sorted(by_pass.items()):
+        bound = None
+        if slot["bound"]:
+            bound = max(set(slot["bound"]), key=slot["bound"].count)
+        out[name] = {
+            "n": len(slot["n"]),
+            "with_cost": len(slot["flops"]),
+            "median_execute_s": _median(slot["execute_s"]),
+            "median_flops": _median(slot["flops"]),
+            "median_bytes_accessed": _median(slot["bytes_accessed"]),
+            "median_achieved_flops_per_s":
+                _median(slot["achieved_flops_per_s"]),
+            "median_achieved_bytes_per_s":
+                _median(slot["achieved_bytes_per_s"]),
+            "median_arithmetic_intensity":
+                _median(slot["arithmetic_intensity"]),
+            "median_flops_ratio": _median(slot["flops_ratio"]),
+            "median_bandwidth_ratio": _median(slot["bandwidth_ratio"]),
+            "knee_intensity": _median(slot["knee"]),
+            "bound": bound,
+        }
+    return out

@@ -14,7 +14,7 @@ import json
 import logging
 import os
 import urllib.parse
-from typing import Optional
+from typing import Any, Optional
 
 from . import store
 
@@ -48,7 +48,8 @@ _MONITOR_JS = """
   var tier = qs.get('tier') || '0';
   var PIN = [
     'monitor.verdict-lag-s', 'wgl.online.verdict-lag-s.p95',
-    'monitor.ops-per-s', 'checkerd.queue-depth',
+    'monitor.ops-per-s', 'monitor.ingest-ops-per-s',
+    'checkerd.queue-depth',
     'monitor.resident-history-bytes', 'monitor.series-disk-bytes',
     'monitor.cost-drift-ratio', 'monitor.epoch-restarts',
     'monitor.discards', 'chip.health'
@@ -208,6 +209,64 @@ def _slo_panel() -> str:
         "<h2>SLOs</h2><table><tr><th>rule</th><th>kind</th>"
         "<th>target</th><th>threshold</th><th>state</th><th>last value"
         "</th></tr>" + trs + "</table>"
+    )
+
+
+def _fmt_rate(v: Any) -> str:
+    """Engineering-notation rate for roofline cells (1.2e9 -> 1.2 G)."""
+    if not isinstance(v, (int, float)):
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                          (1e3, "k")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f} {suffix}"
+    return f"{v:.3f}"
+
+
+def _roofline_panel(summary: Any) -> str:
+    """Per-pass roofline table (telemetry/roofline.summarize shape):
+    achieved FLOP/s and bytes/s vs device peak, arithmetic intensity
+    against the memory/compute knee, and which side each pass lands
+    on.  Shared by /fleet (from checkerd STATS) and /monitor (from the
+    store's profiles.jsonl)."""
+    if not isinstance(summary, dict) or not summary:
+        return ""
+    knee = None
+    trs = ""
+    for name, s in sorted(summary.items()):
+        if not isinstance(s, dict):
+            continue
+        if knee is None and isinstance(s.get("knee_intensity"),
+                                       (int, float)):
+            knee = s["knee_intensity"]
+        ratio = s.get("median_flops_ratio")
+        pct = f"{ratio * 100:.4f}%" if isinstance(
+            ratio, (int, float)) else "-"
+        bound = s.get("bound") or "-"
+        trs += (
+            f"<tr><td>{html.escape(str(name))}</td>"
+            f"<td>{s.get('n')}</td><td>{s.get('with_cost')}</td>"
+            f"<td>{_fmt_rate(s.get('median_flops'))}</td>"
+            f"<td>{_fmt_rate(s.get('median_achieved_flops_per_s'))}</td>"
+            f"<td>{pct}</td>"
+            f"<td>{_fmt_rate(s.get('median_achieved_bytes_per_s'))}</td>"
+            f"<td>{_fmt_rate(s.get('median_arithmetic_intensity'))}</td>"
+            f"<td>{html.escape(str(bound))}</td></tr>"
+        )
+    if not trs:
+        return ""
+    kneenote = (
+        f" · knee at intensity {knee:.2f} FLOP/byte (left of the knee "
+        "is memory-bound, right is compute-bound)"
+        if isinstance(knee, (int, float)) else ""
+    )
+    return (
+        "<h2>roofline (telemetry/roofline.py)</h2>"
+        f"<p>per-pass medians vs device peak{kneenote}</p>"
+        "<table><tr><th>pass</th><th>n</th><th>with cost</th>"
+        "<th>flops</th><th>achieved FLOP/s</th><th>% of peak</th>"
+        "<th>achieved B/s</th><th>intensity</th><th>bound</th></tr>"
+        + trs + "</table>"
     )
 
 
@@ -475,6 +534,7 @@ class Handler(http.server.BaseHTTPRequestHandler):
         self._send(200, _page(
             "checker fleet",
             f"<table>{orows}</table>" + runs_tbl + plan_tbl
+            + _roofline_panel(stats.get("roofline"))
             + _slo_panel() + lint_tbl + hint,
         ))
 
@@ -697,13 +757,17 @@ class Handler(http.server.BaseHTTPRequestHandler):
         /api/series and updated over the SSE stream."""
         root = self._series_root()
         if root is None:
+            # No series yet — the roofline panel still renders off any
+            # profiles.jsonl under the store dir.
             self._send(200, _page(
                 "monitor observatory",
                 "<p>no series store found under "
                 f"<code>{html.escape(self.store_dir)}</code> — start "
                 "one with <code>jepsen monitor --store-dir "
                 f"{html.escape(self.store_dir)}/monitor</code> or point "
-                "this page at a subdir with <code>?dir=name</code></p>",
+                "this page at a subdir with <code>?dir=name</code></p>"
+                + self._monitor_roofline(
+                    os.path.realpath(self.store_dir)),
             ))
             return
         summ_html = ""
@@ -740,9 +804,26 @@ class Handler(http.server.BaseHTTPRequestHandler):
             + "</p><div id='charts'></div>"
             + _MONITOR_JS
             + summ_html
+            + self._monitor_roofline(root)
             + _slo_panel()
         )
         self._send(200, _page("monitor observatory", body))
+
+    def _monitor_roofline(self, root: str) -> str:
+        """Roofline panel for /monitor: summarizes the profiles.jsonl
+        co-located with the series store (the monitored run's profile
+        records), or the store dir's own when the subdir has none."""
+        try:
+            from .telemetry import profile, roofline
+
+            for d in (root, os.path.realpath(self.store_dir)):
+                p = os.path.join(d, profile.PROFILE_FILE)
+                if os.path.isfile(p):
+                    recs = profile.read(p)[-2000:]
+                    return _roofline_panel(roofline.summarize(recs))
+        except Exception:  # noqa: BLE001 — render, don't 500
+            pass
+        return ""
 
     def _telemetry(self, rel: str) -> None:
         """Renders a run's telemetry.json (written by a

@@ -43,6 +43,7 @@ LOG = os.path.join(REPO, "CHIP_LOG.md")
 sys.path.insert(0, REPO)
 
 from bench import probe_chip, reset_chip  # noqa: E402
+from jepsen_tpu.ops import degrade  # noqa: E402
 
 
 def log_line(text: str) -> None:
@@ -155,6 +156,13 @@ def main() -> int:
         result = probe_chip()
         log_line(f"probe={result} ({time.time() - t0:.1f}s)")
         if result == "wedged":
+            # Machine-readable forensics next to the log line: the
+            # structured dossier (env, toolchain versions, lockfile
+            # state, probe timing) the wedged-TPU investigation needs.
+            dossier = degrade.write_chip_dossier(
+                os.path.join(REPO, "chip.json"))
+            if dossier:
+                log_line(f"wedged dossier -> {dossier}")
             # A wedged tunnel used to mean "sleep and hope" — every
             # bench since r03 logged probe=wedged without ever trying
             # the reset rung that landed for exactly this.  Sweep the

@@ -93,6 +93,7 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
     recorded data."""
     shapes: dict[str, dict[str, list[float]]] = {}
     feats_of: dict[str, dict] = {}
+    roofs_of: dict[str, dict[str, list[float]]] = {}
     for rec in records:
         if rec["pass"] not in model.passes:
             continue
@@ -104,6 +105,16 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
         shapes.setdefault(sk, {}).setdefault(
             knob_key(rec), []
         ).append(costmodel.record_cost_s(rec))
+        # Roofline columns per bucket (v2 records; v1 contribute
+        # nothing and the columns render as None).
+        roof = roofs_of.setdefault(sk, {})
+        cost = rec.get("cost") or {}
+        roofline = rec.get("roofline") or {}
+        for col, v in (("flops", cost.get("flops")),
+                       ("bytes_accessed", cost.get("bytes_accessed")),
+                       ("flops_ratio", roofline.get("flops_ratio"))):
+            if isinstance(v, (int, float)):
+                roof.setdefault(col, []).append(float(v))
 
     rows = []
     wins = losses = ties = comparable = 0
@@ -117,9 +128,20 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
         if heur is None:
             continue
         comparable += 1
+        roof = roofs_of.get(sk) or {}
+        med_roof = {col: round(statistics.median(vals), 6)
+                    for col, vals in roof.items() if vals}
+        # The bucket's median cost block feeds prediction identically
+        # for every config (cost describes the shape, not the knobs),
+        # so roofline-aware models rank configs without train/serve
+        # feature skew.
+        cost_feats = {k: med_roof.get(k)
+                      for k in costmodel.COST_KEYS} \
+            if any(k in med_roof for k in costmodel.COST_KEYS) else None
         preds = []
         for k in by_cfg:
-            p = model.predict_s(pass_name, features, json.loads(k))
+            p = model.predict_s(pass_name, features, json.loads(k),
+                                cost_feats)
             preds.append((p if p is not None else float("inf"), k))
         picked = min(preds)[1]
         heur_k = json.dumps(heur, sort_keys=True)
@@ -143,6 +165,9 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
             "heuristic-config": heur,
             "heuristic-median-s": round(heur_s, 6),
             "verdict": verdict,
+            "median-flops": med_roof.get("flops"),
+            "median-bytes-accessed": med_roof.get("bytes_accessed"),
+            "median-flops-ratio": med_roof.get("flops_ratio"),
         })
     return {
         "buckets": len(shapes),

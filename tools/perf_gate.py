@@ -1,0 +1,369 @@
+#!/usr/bin/env python
+"""Perf-regression gate over recorded per-pass profiles.
+
+Compares a candidate run's profiles.jsonl against a committed
+`perf_baseline.json`: records are grouped into shape buckets (pass +
+requested-shape features, the same bucketing as tools/profile_diff.py
+and costmodel_train.py), and each bucket's median cost and median
+roofline flops-ratio are checked against the baseline's.
+
+Machine-speed variance is handled by calibration (on by default): the
+global median candidate/baseline cost ratio across shared buckets
+rescales the baseline first, so a uniformly slower CI runner cancels
+out while a *per-pass* regression — one pass slower than the global
+shift — still trips.  Same-machine comparisons (the CI self-check
+seeds a baseline from the candidate itself) should pass
+`--no-calibrate`.
+
+Noise floors: a bucket only flags when its median exceeds the
+(calibrated) expectation by more than `--noise` relative AND
+`--min-delta-s` absolute, over at least `--min-n` records on both
+sides.  Roofline ratios flag when the candidate achieves less than
+`1 - --roofline-noise` of the baseline's fraction-of-peak.
+
+`--seed` writes the baseline from the candidate stores instead of
+gating.  `--inflate X --inflate-pass a,b` multiplies the named
+passes' candidate costs (and divides their roofline ratios) before
+comparing — the planted-slowdown self-test CI runs.  `--selftest`
+exercises the true-positive and clean-negative paths on synthetic
+stores end-to-end and needs no baseline.
+
+Exit: 0 clean, 1 regression found (or selftest failed), 2 usage/data
+errors.  `--advisory` always exits 0 (the tier-1 advisory step).
+
+Usage:
+  python tools/perf_gate.py STORE.jsonl [...] --baseline perf_baseline.json
+  python tools/perf_gate.py STORE.jsonl --seed --baseline perf_baseline.json
+  python tools/perf_gate.py --selftest
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from jepsen_tpu.plan import costmodel  # noqa: E402
+from jepsen_tpu.telemetry import profile  # noqa: E402
+
+from costmodel_train import shape_key  # noqa: E402
+
+BASELINE_VERSION = 1
+
+#: Calibration shift clamp: a CI runner outside 4x of the baseline
+#: machine is a configuration problem, not a signal to scale away.
+SHIFT_CLAMP = (0.25, 4.0)
+
+
+def bucketize(records: list[dict]) -> dict[str, dict]:
+    """{shape_key: {"pass", "n", "median_cost_s",
+    "median_flops_ratio"}} over normalized records."""
+    costs: dict[str, list[float]] = {}
+    ratios: dict[str, list[float]] = {}
+    passes: dict[str, str] = {}
+    for rec in records:
+        sk = shape_key(rec)
+        passes[sk] = rec["pass"]
+        costs.setdefault(sk, []).append(costmodel.record_cost_s(rec))
+        r = (rec.get("roofline") or {}).get("flops_ratio")
+        if isinstance(r, (int, float)):
+            ratios.setdefault(sk, []).append(float(r))
+    out = {}
+    for sk, vals in costs.items():
+        rv = ratios.get(sk)
+        out[sk] = {
+            "pass": passes[sk],
+            "n": len(vals),
+            "median_cost_s": round(statistics.median(vals), 6),
+            "median_flops_ratio":
+                round(statistics.median(rv), 9) if rv else None,
+        }
+    return out
+
+
+def seed_baseline(records: list[dict], path: str) -> dict:
+    base = {
+        "v": BASELINE_VERSION,
+        "buckets": bucketize(records),
+    }
+    with open(path, "w") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return base
+
+
+def load_baseline(path: str) -> dict:
+    with open(path) as f:
+        base = json.load(f)
+    if not isinstance(base, dict) or base.get("v") != BASELINE_VERSION:
+        raise ValueError(f"{path}: not a v{BASELINE_VERSION} baseline")
+    buckets = base.get("buckets")
+    if not isinstance(buckets, dict):
+        raise ValueError(f"{path}: missing buckets")
+    return base
+
+
+def inflate(buckets: dict[str, dict], factor: float,
+            passes: set[str]) -> dict[str, dict]:
+    """The planted-slowdown transform: multiplies the named passes'
+    costs by `factor` (a slower pass achieves proportionally less of
+    peak, so ratios divide)."""
+    out = {}
+    for sk, b in buckets.items():
+        b = dict(b)
+        if not passes or b.get("pass") in passes:
+            b["median_cost_s"] = round(b["median_cost_s"] * factor, 6)
+            if b.get("median_flops_ratio") is not None:
+                b["median_flops_ratio"] = round(
+                    b["median_flops_ratio"] / factor, 9)
+        out[sk] = b
+    return out
+
+
+def compare(base_buckets: dict[str, dict], cand_buckets: dict[str, dict],
+            *, noise: float, roofline_noise: float, min_delta_s: float,
+            min_n: int, calibrate: bool) -> dict:
+    """{shift, compared, regressions: [...], improvements: [...]}."""
+    shared = [
+        sk for sk in cand_buckets
+        if sk in base_buckets
+        and base_buckets[sk].get("median_cost_s")
+        and cand_buckets[sk]["n"] >= min_n
+        and base_buckets[sk].get("n", 0) >= min_n
+    ]
+    shift = 1.0
+    if calibrate and shared:
+        shift = statistics.median(
+            cand_buckets[sk]["median_cost_s"]
+            / base_buckets[sk]["median_cost_s"]
+            for sk in shared
+        )
+        shift = min(max(shift, SHIFT_CLAMP[0]), SHIFT_CLAMP[1])
+    regressions, improvements = [], []
+    for sk in sorted(shared):
+        base, cand = base_buckets[sk], cand_buckets[sk]
+        expect = base["median_cost_s"] * shift
+        got = cand["median_cost_s"]
+        row = {
+            "pass": cand.get("pass"),
+            "bucket": sk,
+            "expected_s": round(expect, 6),
+            "measured_s": got,
+            "ratio": round(got / expect, 3) if expect else None,
+        }
+        if got > expect * (1 + noise) and got - expect > min_delta_s:
+            row["kind"] = "cost"
+            regressions.append(row)
+            continue
+        br, cr = base.get("median_flops_ratio"), \
+            cand.get("median_flops_ratio")
+        if (isinstance(br, (int, float)) and br > 0
+                and isinstance(cr, (int, float))
+                and cr < br * (1 - roofline_noise)
+                and got - expect > min_delta_s):
+            row["kind"] = "roofline"
+            row["baseline_flops_ratio"] = br
+            row["measured_flops_ratio"] = cr
+            regressions.append(row)
+            continue
+        if expect and got < expect / (1 + noise):
+            row["kind"] = "improvement"
+            improvements.append(row)
+    return {
+        "shift": round(shift, 4),
+        "compared": len(shared),
+        "candidate_buckets": len(cand_buckets),
+        "baseline_buckets": len(base_buckets),
+        "regressions": regressions,
+        "improvements": improvements,
+    }
+
+
+def _synthetic_store(path: str, slow_pass_factor: float = 1.0) -> None:
+    """Writes a deterministic two-pass store for --selftest: 'alpha'
+    records at ~10ms and 'beta' at ~40ms, beta scaled by
+    `slow_pass_factor` (the planted regression)."""
+    import io
+
+    lines = io.StringIO()
+    for i in range(6):
+        jitter = 1.0 + 0.02 * (i % 3)
+        for name, base_s, flops in (("alpha", 0.010, 2e6),
+                                    ("beta", 0.040, 8e6)):
+            s = base_s * jitter
+            if name == "beta":
+                s *= slow_pass_factor
+            lines.write(json.dumps({
+                "v": 2, "pass": name,
+                "features": {"keys": 8, "ops": 4096},
+                "plan": {},
+                "timing": {"execute_s": round(s, 6),
+                           "total_s": round(s * 1.2, 6)},
+                "cost": {"flops": flops, "bytes_accessed": flops / 4,
+                         "transcendentals": None, "device_calls": 1},
+                "roofline": {"flops_ratio":
+                             round(flops / s / 1e11, 9)},
+            }) + "\n")
+    with open(path, "w") as f:
+        f.write(lines.getvalue())
+
+
+def selftest() -> int:
+    """End-to-end gate behavior on synthetic stores: seeding, the
+    clean-negative, the planted 2x true-positive, and calibration
+    cancelling a uniform machine slowdown."""
+    with tempfile.TemporaryDirectory() as d:
+        base_store = os.path.join(d, "base.jsonl")
+        slow_store = os.path.join(d, "slow.jsonl")
+        baseline = os.path.join(d, "baseline.json")
+        _synthetic_store(base_store)
+        _synthetic_store(slow_store, slow_pass_factor=2.0)
+        seed_baseline(profile.read(base_store), baseline)
+        base = load_baseline(baseline)
+        kw = dict(noise=0.35, roofline_noise=0.6, min_delta_s=0.005,
+                  min_n=3)
+        clean = compare(base["buckets"],
+                        bucketize(profile.read(base_store)),
+                        calibrate=False, **kw)
+        if clean["regressions"] or clean["compared"] < 2:
+            print(f"# selftest FAIL: clean run flagged {clean}")
+            return 1
+        planted = compare(base["buckets"],
+                          bucketize(profile.read(slow_store)),
+                          calibrate=False, **kw)
+        hit = [r for r in planted["regressions"] if r["pass"] == "beta"]
+        if not hit or any(r["pass"] == "alpha"
+                          for r in planted["regressions"]):
+            print(f"# selftest FAIL: planted 2x not isolated {planted}")
+            return 1
+        # A uniformly 3x-slower "machine" with the same planted 2x:
+        # calibration must absorb the 3x and still isolate beta.
+        uniform = {
+            sk: {**b,
+                 "median_cost_s": round(b["median_cost_s"] * 3, 6)}
+            for sk, b in bucketize(profile.read(slow_store)).items()
+        }
+        cal = compare(base["buckets"], uniform, calibrate=True, **kw)
+        hit = [r for r in cal["regressions"] if r["pass"] == "beta"]
+        if not hit:
+            print(f"# selftest FAIL: calibrated planted 2x missed {cal}")
+            return 1
+        print("# selftest ok: clean-negative, planted 2x "
+              "true-positive, calibrated true-positive")
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(
+        description="gate per-pass cost/roofline medians against a "
+                    "committed baseline")
+    ap.add_argument("stores", nargs="*",
+                    help="candidate profiles.jsonl paths")
+    ap.add_argument("--baseline", default="perf_baseline.json")
+    ap.add_argument("--seed", action="store_true",
+                    help="write the baseline from the stores and exit")
+    ap.add_argument("--noise", type=float, default=0.35,
+                    help="relative cost noise floor (default 0.35)")
+    ap.add_argument("--roofline-noise", type=float, default=0.6,
+                    help="relative flops-ratio floor (default 0.6)")
+    ap.add_argument("--min-delta-s", type=float, default=0.005,
+                    help="absolute regression floor (default 5ms)")
+    ap.add_argument("--min-n", type=int, default=3,
+                    help="records per bucket per side (default 3)")
+    ap.add_argument("--no-calibrate", action="store_true",
+                    help="skip machine-speed calibration "
+                         "(same-machine baselines)")
+    ap.add_argument("--inflate", type=float, default=None,
+                    help="multiply candidate costs (planted-slowdown "
+                         "self-test)")
+    ap.add_argument("--inflate-pass", default="",
+                    help="comma-separated passes --inflate applies to "
+                         "(default: all)")
+    ap.add_argument("--advisory", action="store_true",
+                    help="report regressions but exit 0")
+    ap.add_argument("--min-compared", type=int, default=0,
+                    help="fail unless at least this many buckets were "
+                         "actually compared (guards against a store "
+                         "too thin for min-n — a gate that compared "
+                         "nothing proved nothing)")
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in TP/TN check on synthetic "
+                         "stores (needs no baseline)")
+    args = ap.parse_args()
+
+    if args.selftest:
+        return selftest()
+    if not args.stores:
+        print("# no candidate stores given", file=sys.stderr)
+        return 2
+
+    records: list[dict] = []
+    for path in args.stores:
+        got = profile.read(path)
+        print(f"# {path}: {len(got)} records")
+        records.extend(got)
+    if not records:
+        print("# no records; nothing to gate", file=sys.stderr)
+        return 2
+
+    if args.seed:
+        base = seed_baseline(records, args.baseline)
+        print(f"# seeded {args.baseline}: "
+              f"{len(base['buckets'])} buckets")
+        return 0
+
+    try:
+        base = load_baseline(args.baseline)
+    except (OSError, ValueError) as e:
+        print(f"# baseline unusable: {e}", file=sys.stderr)
+        return 2
+    cand = bucketize(records)
+    if args.inflate:
+        passes = {p.strip() for p in args.inflate_pass.split(",")
+                  if p.strip()}
+        cand = inflate(cand, args.inflate, passes)
+        print(f"# planted {args.inflate}x slowdown on "
+              f"{sorted(passes) or 'all passes'}")
+    report = compare(
+        base["buckets"], cand,
+        noise=args.noise, roofline_noise=args.roofline_noise,
+        min_delta_s=args.min_delta_s, min_n=args.min_n,
+        calibrate=not args.no_calibrate,
+    )
+    if args.json:
+        json.dump(report, sys.stdout, indent=2)
+        print()
+    else:
+        print(f"# {report['compared']} shared buckets "
+              f"(candidate {report['candidate_buckets']}, baseline "
+              f"{report['baseline_buckets']}), calibration shift "
+              f"{report['shift']}")
+        for r in report["regressions"]:
+            print(f"REGRESSION [{r['kind']}] {r['pass']}: "
+                  f"{r['measured_s'] * 1000:.1f}ms vs expected "
+                  f"{r['expected_s'] * 1000:.1f}ms "
+                  f"(x{r['ratio']})")
+        for r in report["improvements"]:
+            print(f"improved {r['pass']}: "
+                  f"{r['measured_s'] * 1000:.1f}ms vs expected "
+                  f"{r['expected_s'] * 1000:.1f}ms")
+        if not report["regressions"]:
+            print("# clean: no per-pass regression beyond noise floors")
+    if report["compared"] < args.min_compared:
+        print(f"# FAIL: only {report['compared']} buckets compared "
+              f"(--min-compared {args.min_compared}) — store too thin "
+              "to prove anything", file=sys.stderr)
+        return 1 if not args.advisory else 0
+    if report["regressions"] and not args.advisory:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,8 +14,12 @@ knob-varied records tools/costmodel_train.py needs: a model can only
 out-pick the hand heuristics on shapes where the store actually
 recorded more than one knob config.
 
+`--reps N` repeats the checker run N times so every pass bucket holds
+N records — tools/perf_gate.py needs >= its --min-n per side before a
+bucket participates in the comparison at all.
+
 Usage: python tools/profile_seed.py OUT_DIR [keys] [pairs-per-key]
-           [--sweep]
+           [--sweep] [--reps N]
 """
 
 import os
@@ -87,6 +91,11 @@ def sweep_stream_knobs(repeats: int = 3) -> int:
 def main() -> int:
     argv = [a for a in sys.argv[1:] if a != "--sweep"]
     sweep = "--sweep" in sys.argv[1:]
+    reps = 1
+    if "--reps" in argv:
+        i = argv.index("--reps")
+        reps = max(1, int(argv[i + 1]))
+        del argv[i:i + 2]
     out = argv[0] if len(argv) > 0 else "profile-seed"
     keys = int(argv[1]) if len(argv) > 1 else 8
     pairs = int(argv[2]) if len(argv) > 2 else 40
@@ -96,12 +105,14 @@ def main() -> int:
     profile.set_store(out)
     try:
         checker = IndependentChecker(Linearizable(Register()))
-        res = checker.check({"name": "profile-seed"},
-                            seed_history(keys, pairs),
-                            {"history-key": None})
-        if res.get("valid") is not True:
-            print(f"FAIL: seed workload not valid: {res.get('valid')}")
-            return 1
+        for _ in range(reps):
+            res = checker.check({"name": "profile-seed"},
+                                seed_history(keys, pairs),
+                                {"history-key": None})
+            if res.get("valid") is not True:
+                print(f"FAIL: seed workload not valid: "
+                      f"{res.get('valid')}")
+                return 1
         if sweep:
             n_sweep = sweep_stream_knobs()
             print(f"# sweep: {n_sweep} knob-varied stream passes")

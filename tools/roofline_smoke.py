@@ -1,0 +1,196 @@
+#!/usr/bin/env python
+"""CI smoke for the roofline observatory (tier1.yml step).
+
+Runs a settle cohort (IndependentChecker over a multi-key register
+history) with telemetry and a profile store on, then asserts the
+roofline acceptance criteria end-to-end:
+
+  * every device-executed pass in the cohort appended a v2
+    profiles.jsonl record carrying the `cost` and `roofline` blocks —
+    with real numbers where the backend reports cost analysis (CPU
+    does), and never a dropped record;
+  * the settle record accumulated its children's device cost
+    (device_calls > 0 on at least one record with measured flops);
+  * `wgl.roofline.*` gauges render in prometheus_text and scrape over
+    a live HTTP /metrics endpoint (jepsen_tpu.web server);
+  * ingest counters (`ingest.append.ops`) counted the PackedBuilder
+    path when the workload streamed through it.
+
+Exit 0 + "PASS" on success, exit 1 with a reason otherwise.  CPU-only:
+the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import os
+import socket
+import sys
+import tempfile
+import threading
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JEPSEN_TELEMETRY"] = "1"
+
+from jepsen_tpu import telemetry, web  # noqa: E402
+from jepsen_tpu.checker.linearizable import Linearizable  # noqa: E402
+from jepsen_tpu.history.core import History  # noqa: E402
+from jepsen_tpu.history.packed import PackedBuilder  # noqa: E402
+from jepsen_tpu.models.registers import Register  # noqa: E402
+from jepsen_tpu.parallel.independent import (  # noqa: E402
+    KV,
+    IndependentChecker,
+)
+from jepsen_tpu.telemetry import profile, roofline  # noqa: E402
+
+#: Device-executed passes a CPU settle cohort must cover (the elle/scc
+#: screen rides inside these; checker tiers beyond them only run on
+#: degradation).
+REQUIRED_PASSES = ("settle",)
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def fail(msg: str) -> None:
+    print(f"FAIL: {msg}")
+    sys.exit(1)
+
+
+def cohort_history(keys: int = 6, pairs: int = 5,
+                   bad_keys: int = 2) -> History:
+    """`keys` valid write/read register rounds plus `bad_keys` keys
+    whose final read returns a never-written value: the stream witness
+    proves the valid keys, and the invalid ones force the settle
+    cohort (screen -> batched -> CPU settle) — the device passes the
+    smoke asserts on."""
+    ops = []
+
+    def add(k, f, written, returned):
+        i = len(ops)
+        ops.append({"index": i, "type": "invoke", "process": k,
+                    "f": f,
+                    "value": KV(k, None if f == "read" else written),
+                    "time": i})
+        ops.append({"index": i + 1, "type": "ok", "process": k,
+                    "f": f, "value": KV(k, returned), "time": i + 1})
+
+    for k in range(keys):
+        for v in range(pairs):
+            add(k, "write", v, v)
+            add(k, "read", v, v)
+    for k in range(keys, keys + bad_keys):
+        add(k, "write", 1, 1)
+        add(k, "read", None, 9)
+    return History(ops)
+
+
+def main() -> None:
+    store = tempfile.mkdtemp(prefix="roofline-smoke-")
+    os.environ["JEPSEN_ROOFLINE_CACHE"] = os.path.join(
+        store, "cpu-peaks.json")
+    telemetry.enable(True)
+    profile.set_store(store)
+
+    # -- the settle cohort --------------------------------------------------
+    checker = IndependentChecker(Linearizable(Register()))
+    result = checker.check({"name": "roofline-smoke"},
+                           cohort_history(), {})
+    if result.get("valid") is not False:
+        fail(f"cohort verdict should be false (planted bad keys): "
+             f"{result.get('valid')}")
+
+    recs = profile.read(os.path.join(store, profile.PROFILE_FILE))
+    if not recs:
+        fail("no profile records written")
+    by_pass = {}
+    for r in recs:
+        by_pass.setdefault(r["pass"], []).append(r)
+    for name in REQUIRED_PASSES:
+        if name not in by_pass:
+            fail(f"pass {name!r} produced no record "
+                 f"(got {sorted(by_pass)})")
+
+    # -- every record carries the v2 blocks (nulls allowed, keys not) -------
+    for r in recs:
+        for block, keys in (("cost", ("flops", "bytes_accessed")),
+                            ("roofline", ("achieved_flops_per_s",
+                                          "flops_ratio", "bound"))):
+            d = r.get(block)
+            if not isinstance(d, dict):
+                fail(f"{r['pass']}: record missing {block} block")
+            for k in keys:
+                if k not in d:
+                    fail(f"{r['pass']}: {block} block missing {k!r}")
+
+    # -- a direct batched device pass (the settle cohort's screen
+    # refutes register invalidity without the device, so drive the
+    # batched BFS kernel explicitly to cover a second device pass) ----------
+    from jepsen_tpu.history.packed import pack_history
+    from jepsen_tpu.ops.wgl_batched import check_wgl_batched
+
+    pm = Register().packed()
+    sub = cohort_history(keys=1, pairs=4, bad_keys=0)
+    packs = [pack_history(sub, pm.encode)] * 2
+    batch = check_wgl_batched(packs, pm, beam=32)
+    if not all(v is True for v in batch.valid):
+        fail(f"batched pass verdicts wrong: {batch.valid}")
+    recs = profile.read(os.path.join(store, profile.PROFILE_FILE))
+    by_pass = {}
+    for r in recs:
+        by_pass.setdefault(r["pass"], []).append(r)
+    if "batched" not in by_pass:
+        fail(f"no batched record (got {sorted(by_pass)})")
+
+    # -- the CPU backend reports cost: require real numbers somewhere -------
+    measured = [r for r in recs
+                if isinstance(r["cost"].get("flops"), (int, float))
+                and r["cost"].get("device_calls", 0) > 0]
+    if not measured:
+        fail("no record measured flops (cost hook never fired)")
+    achieved = [r for r in measured
+                if isinstance(r["roofline"].get("achieved_flops_per_s"),
+                              (int, float))]
+    if not achieved:
+        fail("no record derived achieved_flops_per_s")
+
+    # -- ingest counters count the PackedBuilder path -----------------------
+    b = PackedBuilder(lambda inv, comp: None)
+    for op in cohort_history(keys=2, pairs=3, bad_keys=0):
+        b.append(op)
+    b.finish()
+    if telemetry.counter_value("ingest.append.ops") <= 0:
+        fail("ingest.append.ops never counted")
+
+    # -- gauges render and scrape over live HTTP ----------------------------
+    mpass = measured[0]["pass"]
+    needle = f"jepsen_wgl_roofline_{mpass}_"
+    text = telemetry.prometheus_text()
+    if needle not in text:
+        fail(f"wgl.roofline.{mpass}.* gauges missing from "
+             "prometheus_text")
+    port = free_port()
+    srv = web.make_server(store, port=port)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as resp:
+            scraped = resp.read().decode()
+    finally:
+        srv.shutdown()
+    for want in (needle, "jepsen_ingest_append_ops_total"):
+        if want not in scraped:
+            fail(f"/metrics scrape missing {want}")
+
+    roofs = roofline.summarize(recs)
+    print(f"PASS roofline smoke: {len(recs)} records, passes "
+          f"{sorted(by_pass)}, measured cost on {len(measured)}, "
+          f"settle median flops "
+          f"{roofs.get('settle', {}).get('median_flops')}")
+
+
+if __name__ == "__main__":
+    main()
