@@ -100,7 +100,10 @@ DECLARED_NAMESPACES = {
     "checker": "checker harness (checker/)",
     "checkerd": "checker daemon fleet (checkerd/)",
     "checkerd.queue": "crash-safe queue journal (checkerd/journal.py)",
+    "checkerd.overload": "overload control plane: fair queue, deadline "
+                         "shed, brownout ladder (checkerd/overload.py)",
     "router": "checkerd federation router (checkerd/router.py)",
+    "chaos": "fleet self-chaos harness (nemesis/selfchaos.py)",
     "nemesis": "fault injection + ledger + schedule search (nemesis/)",
     "lifecycle": "core.run phases (core.py)",
     "interpreter": "op interpreter + workers (interpreter.py)",
@@ -120,7 +123,8 @@ DECLARED_NAMESPACES = {
 #: Fleet-scoped modules: counters here survive scoped_reset only when
 #: under a FLEET_COUNTER_PREFIXES prefix.
 _FLEET_PATHS = ("jepsen_tpu/checkerd/", "jepsen_tpu/streaming/")
-_FLEET_FILES = ("jepsen_tpu/nemesis/search.py",)
+_FLEET_FILES = ("jepsen_tpu/nemesis/search.py",
+                "jepsen_tpu/nemesis/selfchaos.py")
 
 _TELEMETRY_INIT = "jepsen_tpu/telemetry/__init__.py"
 _LEDGER = "jepsen_tpu/nemesis/ledger.py"

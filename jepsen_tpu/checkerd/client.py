@@ -27,6 +27,7 @@ from typing import Any, Optional
 
 from .. import telemetry
 from ..checker.core import Checker, check_safe, merge_valid
+from . import overload
 from .protocol import (
     F_CHUNK,
     F_COMMIT,
@@ -35,6 +36,7 @@ from .protocol import (
     F_PENDING,
     F_POLL,
     F_RESULT,
+    F_SHED,
     F_STATS,
     F_STATS_REPLY,
     F_SUBMIT,
@@ -60,11 +62,34 @@ POLL_INTERVAL_S = 0.05
 #: limit bounds the request.
 DEFAULT_DEADLINE_S = 3600.0
 
+#: Ceiling on how long a client sleeps honoring a SHED's RETRY-AFTER
+#: before moving on (next sibling / in-process fallback); a saturated
+#: daemon can ask for patience, not captivity.
+MAX_SHED_WAIT_S = 5.0
+
 
 class RemoteUnavailable(Exception):
     """The daemon can't serve this request: unreachable, refused the
     model, protocol failure, or client-side deadline.  Triggers the
     in-process fallback."""
+
+
+class ShedByServer(RemoteUnavailable):
+    """The admission plane refused the COMMIT with a structured
+    RETRY-AFTER (F_SHED) — an honest overload signal, not a failure.
+    Subclasses RemoteUnavailable so unaware callers still fall back
+    in-process; aware callers honor `retry_after_s` first."""
+
+    def __init__(self, payload: dict):
+        self.shed = overload.OverloadShed.from_payload(payload or {})
+        super().__init__(
+            f"shed by daemon ({self.shed.reason}); retry after "
+            f"{self.shed.retry_after_s:.2f}s"
+        )
+
+    @property
+    def retry_after_s(self) -> float:
+        return self.shed.retry_after_s
 
 
 class CheckerdClient:
@@ -116,6 +141,9 @@ class CheckerdClient:
             raise RemoteUnavailable(
                 f"daemon error: {fr[1].get('error')}"
             )
+        if fr[0] == F_SHED:
+            telemetry.count("checkerd.shed-received")
+            raise ShedByServer(fr[1])
         return fr
 
     # -- API ----------------------------------------------------------------
@@ -130,11 +158,16 @@ class CheckerdClient:
         budget_s: Optional[float] = None,
         time_limit_s: Optional[float] = None,
         trace: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> str:
         """Submits per-key op-dict lists (submit order = reply order);
         returns the poll ticket.  `trace` is the submitting run's
         telemetry.trace_context(); daemon-side spans for this request
-        are stamped with it so they nest under the run's analyze span."""
+        are stamped with it so they nest under the run's analyze span.
+        `deadline_s` is the client's total patience: a daemon that
+        predicts it can't answer in time sheds at COMMIT (ShedByServer)
+        instead of wasting both sides' budget."""
         self._send(F_SUBMIT, {
             "run": run,
             "model": model_spec,
@@ -143,6 +176,8 @@ class CheckerdClient:
             "packed": False,
             "budget-s": budget_s,
             "time-limit-s": time_limit_s,
+            "tenant": tenant,
+            "deadline-s": deadline_s,
             "trace": trace,
         })
         for i, ops in enumerate(subs_ops):
@@ -166,6 +201,8 @@ class CheckerdClient:
         budget_s: Optional[float] = None,
         time_limit_s: Optional[float] = None,
         trace: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ) -> str:
         """Submits already-packed columnar histories (history/packed.py
         PackedOps) as binary frames — the bulk-transport path."""
@@ -179,6 +216,8 @@ class CheckerdClient:
             "packed": True,
             "budget-s": budget_s,
             "time-limit-s": time_limit_s,
+            "tenant": tenant,
+            "deadline-s": deadline_s,
             "trace": trace,
         })
         for i, p in enumerate(packs):
@@ -255,8 +294,12 @@ class RemoteChecker(Checker):
         run_id: Optional[str] = None,
         fallback: bool = True,
         connect_timeout: float = 3.0,
+        tenant: Optional[str] = None,
     ):
         self.base = base
+        #: Admission identity for the daemon's weighted fair queue;
+        #: None lets the daemon fall back to the run name.
+        self.tenant = tenant
         #: Comma-separated addresses are a failover chain: a dead
         #: daemon's ticket is retried against the next sibling (full
         #: re-submission from the client's own copy of the ops) before
@@ -339,7 +382,13 @@ class RemoteChecker(Checker):
         # daemon dying mid-wait surfaces as RemoteUnavailable and the
         # next sibling re-checks the same ops — per-key verdicts are
         # deterministic, so the retried result matches what the dead
-        # daemon would have returned.
+        # daemon would have returned.  Each address sits behind a
+        # process-wide circuit breaker (overload.breaker_for): an
+        # address that keeps failing is skipped for a jittered backoff
+        # window instead of eating a connect timeout per run, and a
+        # half-open probe re-admits it.  An honest SHED is not a
+        # failure — the breaker stays closed, the client sleeps out the
+        # (bounded) RETRY-AFTER once, retries, then moves on.
         last: Optional[RemoteUnavailable] = None
         payload = None
         served_by = self.addr
@@ -350,15 +399,40 @@ class RemoteChecker(Checker):
                     "checkerd %s failed (%s); retrying ticket against "
                     "sibling %s", self.addrs[n - 1], last, addr,
                 )
-            try:
-                payload = self._attempt(
-                    addr, test, keys, subs_ops, spec, lin, independent,
-                    run, budget, deadline,
+            br = overload.breaker_for(addr)
+            if not br.allow():
+                telemetry.count("checkerd.breaker-skip")
+                last = RemoteUnavailable(
+                    f"circuit open for {addr} (recent failures)"
                 )
-                served_by = addr
+                continue
+            for shed_try in (0, 1):
+                try:
+                    payload = self._attempt(
+                        addr, test, keys, subs_ops, spec, lin,
+                        independent, run, budget, deadline,
+                    )
+                    br.record_success()
+                    served_by = addr
+                    break
+                except ShedByServer as e:
+                    # The daemon answered — it's healthy, just full.
+                    br.record_success()
+                    telemetry.count("checkerd.client-shed")
+                    last = e
+                    if shed_try == 0:
+                        wait = min(e.retry_after_s, MAX_SHED_WAIT_S)
+                        log.info(
+                            "checkerd %s shed the request; honoring "
+                            "retry-after %.2fs", addr, wait,
+                        )
+                        time.sleep(wait)
+                except RemoteUnavailable as e:
+                    br.record_failure()
+                    last = e
+                    break
+            if payload is not None:
                 break
-            except RemoteUnavailable as e:
-                last = e
         if payload is None:
             raise last or RemoteUnavailable("no checkerd address")
 
@@ -430,6 +504,11 @@ class RemoteChecker(Checker):
                     time_limit_s=lin.time_limit_s,
                     trace=telemetry.trace_context()
                     if telemetry.enabled() else None,
+                    tenant=self.tenant,
+                    # The client's own wait ceiling rides the SUBMIT so
+                    # the daemon can shed at COMMIT instead of checking
+                    # into a void nobody is still polling.
+                    deadline_s=deadline,
                 )
             return c.wait(ticket, deadline_s=deadline)
 

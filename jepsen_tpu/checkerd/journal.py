@@ -46,6 +46,27 @@ log = logging.getLogger(__name__)
 
 QUEUE_FILE = "checkerd.queue"
 
+#: Fault-injection hook for the self-chaos harness (nemesis/
+#: selfchaos.py): set to "enospc" and every journal append fails like
+#: a full --queue disk.  ``file:PATH`` indirects through a file's
+#: contents (overload._env_indirect) so the harness toggles the fault
+#: in a live child daemon.  Read per append, same pattern as
+#: ops/degrade.maybe_fault, so a daemon under test flips behavior
+#: without restart.  The append path already treats OSError as a
+#: degraded-durability signal (checkerd.queue.append-failed), never a
+#: crash.
+FAULT_ENV = "JEPSEN_QUEUE_FAULT"
+
+
+def _maybe_disk_fault() -> None:
+    import errno
+
+    from .overload import _env_indirect
+
+    if _env_indirect(os.environ.get(FAULT_ENV)) == "enospc":
+        raise OSError(errno.ENOSPC,
+                      f"injected disk-full ({FAULT_ENV}=enospc)")
+
 #: Finished-ticket results are kept across restarts this long (matches
 #: the scheduler's in-memory _RESULT_TTL_S) so late polls after a crash
 #: still see their verdict; older ones fall to compaction.
@@ -60,6 +81,7 @@ class QueueJournal:
         self.keep_results_s = keep_results_s
         self._lock = threading.Lock()
         self._submits: dict[str, dict] = {}
+        self._submit_ts: dict[str, float] = {}
         self._results: dict[str, dict] = {}
         self._result_ts: dict[str, float] = {}
         self._abandoned: set[str] = set()
@@ -117,6 +139,7 @@ class QueueJournal:
             return
         if kind == "submit" and isinstance(payload.get("req"), dict):
             self._submits[ticket] = payload["req"]
+            self._submit_ts[ticket] = float(payload.get("ts") or 0.0)
         elif kind == "result" and isinstance(payload.get("result"), dict):
             self._results[ticket] = payload["result"]
             self._result_ts[ticket] = float(payload.get("ts") or 0.0)
@@ -132,6 +155,7 @@ class QueueJournal:
         dead = 0
         for t in self._abandoned:
             if self._submits.pop(t, None) is not None:
+                self._submit_ts.pop(t, None)
                 dead += 1
         dead += len(self._abandoned)
         self._abandoned.clear()
@@ -142,6 +166,7 @@ class QueueJournal:
             dead += 1
         for t in [t for t in self._results if t in self._submits]:
             del self._submits[t]
+            self._submit_ts.pop(t, None)
             dead += 1
         return dead
 
@@ -153,9 +178,13 @@ class QueueJournal:
             with open(tmp, "wb") as f:
                 f.write(fmt.MAGIC)
                 for t, req in self._submits.items():
+                    # Original submit time, not now(): compaction must
+                    # never grow a record (torn-tail truncation promises
+                    # size monotonically shrinks) and the ts is the
+                    # submission's, not the rewrite's.
                     f.write(fmt.frame(fmt.BLOCK_QUEUE, {
                         "rec": "submit", "ticket": t, "req": req,
-                        "ts": round(time.time(), 3),
+                        "ts": self._submit_ts.get(t, 0.0),
                     }))
                 for t, res in self._results.items():
                     f.write(fmt.frame(fmt.BLOCK_QUEUE, {
@@ -183,6 +212,7 @@ class QueueJournal:
             if self._writer is None:
                 return False
             try:
+                _maybe_disk_fault()
                 self._writer.append(fmt.BLOCK_QUEUE, payload)
                 self._writer.sync()
                 self.appended += 1
@@ -197,11 +227,12 @@ class QueueJournal:
         """Journals one accepted submission.  Must complete before the
         TICKET reply: a ticket the client can poll is a ticket the
         journal can replay."""
+        now = round(time.time(), 3)
         with self._lock:
             self._submits[ticket] = req
+            self._submit_ts[ticket] = now
         return self._append({
-            "rec": "submit", "ticket": ticket, "req": req,
-            "ts": round(time.time(), 3),
+            "rec": "submit", "ticket": ticket, "req": req, "ts": now,
         })
 
     def record_result(self, ticket: str, result: dict) -> bool:
@@ -212,6 +243,7 @@ class QueueJournal:
             self._results[ticket] = result
             self._result_ts[ticket] = now
             self._submits.pop(ticket, None)
+            self._submit_ts.pop(ticket, None)
         return self._append({
             "rec": "result", "ticket": ticket, "result": result, "ts": now,
         })
@@ -219,6 +251,7 @@ class QueueJournal:
     def record_abandon(self, ticket: str) -> bool:
         with self._lock:
             self._submits.pop(ticket, None)
+            self._submit_ts.pop(ticket, None)
         return self._append({
             "rec": "abandon", "ticket": ticket, "ts": round(time.time(), 3),
         })
@@ -277,6 +310,11 @@ def request_to_record(req: Any) -> dict:
         "n-keys": req.n_keys,
         "budget-s": req.budget_s,
         "time-limit-s": req.time_limit_s,
+        "tenant": req.tenant,
+        # The deadline is relative to the ORIGINAL submission; a
+        # replayed request is already admitted, so replay never
+        # re-sheds it — the field rides along for forensics only.
+        "deadline-s": req.deadline_s,
         "trace": req.trace,
         "subs": {
             str(i): h.to_dicts() for i, h in req.subs.items()
@@ -313,6 +351,7 @@ def request_from_record(rec: dict) -> Any:
         subs=subs,
         packs=packs,
         trace=rec.get("trace"),
+        tenant=rec.get("tenant"),
     )
 
 

@@ -14,11 +14,15 @@ A submit conversation, client -> server:
 
     SUBMIT {"run", "model", "algorithm", "n-keys", "packed",
             "budget-s", "time-limit-s",
+            "tenant": name | null, "deadline-s": s | null,
             "trace": {"trace-id", "parent-span"} | null}
     CHUNK  {"key": i, "ops": [op dicts...]}        (repeatable, ops mode)
     PACKED <u32 key-index><packed bytes>           (one per key, packed mode)
     COMMIT {}
                                   <- TICKET {"ticket", "queue-depth"}
+                                   | SHED {"shed": true, "reason",
+                                           "retry-after-s", "tenant",
+                                           "estimate-s"}
     POLL {"ticket"}               <- PENDING {"state", "queue-depth"}
                                    | RESULT {"valid", "key-results",
                                              "checkerd": {...meta}}
@@ -77,6 +81,11 @@ F_ERROR = 26
 #: re-uploading or falling back to a whole-history recheck.
 F_RESUME = 27       # {"session": token}
 F_RESUME_OK = 28    # {"received": {key-index: op-count}, "n-keys": n}
+#: Overload control (checkerd/overload.py): a COMMIT the admission
+#: plane refuses answers with a structured RETRY-AFTER instead of a
+#: TICKET — deadline-aware shedding and weighted admission are honest,
+#: machine-readable refusals, never ERROR-shaped silence.
+F_SHED = 29         # {"shed": true, "reason", "retry-after-s", ...}
 
 #: Frame types whose payload is raw bytes, not JSON.
 BINARY_TYPES = frozenset({F_PACKED})

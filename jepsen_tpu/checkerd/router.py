@@ -22,12 +22,15 @@ router-host:port``).  It adds what a single daemon can't:
   state machine as test nodes (control/health.py): data-path failures
   are passive signals, a stats round-trip is the active probe, and
   quarantined daemons drop out of placement until probes readmit them.
-* **Admission.**  ``--tenant-quota`` bounds each run's in-flight
+* **Admission.**  ``--tenant-quota`` bounds each tenant's in-flight
   tickets and ``--max-inflight`` bounds the fleet total; a submission
-  over either limit gets one deterministic
-  ``checkerd.admission-rejected`` ERROR at SUBMIT time instead of
-  unbounded router memory.  The client surfaces it as an honest
-  unknown (or falls back in-process when allowed).
+  over either limit gets one deterministic SHED frame with a
+  structured RETRY-AFTER at SUBMIT time instead of unbounded router
+  memory.  A daemon's own SHED (deadline-aware load shedding,
+  checkerd/overload.py) is tried against a sibling first and forwarded
+  to the client only when every healthy daemon sheds.  The client
+  honors the retry-after (checkerd/client.py ShedByServer) or falls
+  back in-process when allowed.
 
 The router submits to daemons on short-lived connections and polls on
 fresh ones, so its forwarded SUBMITs carry ``"detached": true`` —
@@ -47,8 +50,8 @@ from typing import Any, Optional
 
 from .. import telemetry
 from ..control.health import monitor_for_targets
-from . import ROUTER_PORT
-from .client import CheckerdClient, RemoteUnavailable, fetch_stats
+from . import ROUTER_PORT, overload
+from .client import CheckerdClient, RemoteUnavailable, ShedByServer, fetch_stats
 from .journal import QueueJournal, frames_from_record, frames_to_record
 from .protocol import (
     F_CHUNK,
@@ -60,6 +63,7 @@ from .protocol import (
     F_RESULT,
     F_RESUME,
     F_RESUME_OK,
+    F_SHED,
     F_STATS,
     F_STATS_REPLY,
     F_SUBMIT,
@@ -107,6 +111,7 @@ class _RSub:
         self.streaming = bool(meta.get("streaming"))
         self.session = meta.get("session") if self.streaming else None
         self.run = str(meta.get("run") or "anonymous")
+        self.tenant = str(meta.get("tenant") or self.run)
         self.spec_key = canonical_spec(meta.get("model") or {})
         self.n_keys = int(meta.get("n-keys") or 0)
         self.counts: dict[int, int] = {}
@@ -133,12 +138,14 @@ class _RSub:
 class _TicketRec:
     """One router ticket: where it lives now and the frames to move it."""
 
-    __slots__ = ("ticket", "run", "spec_key", "frames", "addr",
+    __slots__ = ("ticket", "run", "tenant", "spec_key", "frames", "addr",
                  "daemon_ticket", "result", "done_t", "busy")
 
-    def __init__(self, ticket: str, run: str, spec_key: str, frames: list):
+    def __init__(self, ticket: str, run: str, spec_key: str, frames: list,
+                 tenant: Optional[str] = None):
         self.ticket = ticket
         self.run = run
+        self.tenant = tenant or run
         self.spec_key = spec_key
         self.frames = frames
         self.addr: Optional[str] = None
@@ -183,6 +190,7 @@ class Router:
         self.n_failovers = 0
         self.n_rejected = 0
         self.n_replayed = 0
+        self.shed_by_tenant: dict[str, int] = {}  # guarded-by: self._lock
         self._t0 = time.monotonic()
         self.health = monitor_for_targets(
             self.daemons, self._probe, interval_s=probe_interval_s,
@@ -253,7 +261,9 @@ class Router:
 
     def _replay_to(self, addr: str, frames: list) -> tuple[str, int]:
         """Plays a buffered submission against one daemon; returns its
-        (ticket, queue-depth).  Any failure is RemoteUnavailable."""
+        (ticket, queue-depth).  A daemon SHED surfaces as ShedByServer
+        (raised by CheckerdClient._recv), any other failure as
+        RemoteUnavailable."""
         with CheckerdClient(
             addr, connect_timeout=self.stats_timeout_s,
             io_timeout=self.io_timeout_s,
@@ -267,7 +277,10 @@ class Router:
 
     def _send_to_daemon(self, rec: _TicketRec, exclude: set) -> int:
         """Places and submits `rec`, walking siblings on failure;
-        returns the accepting daemon's queue depth."""
+        returns the accepting daemon's queue depth.  A shedding daemon
+        is healthy-but-full: it is skipped without a health signal, and
+        when EVERY candidate sheds the last ShedByServer propagates so
+        the handler forwards the structured refusal to the client."""
         tried = set(exclude)
         last: Optional[RemoteUnavailable] = None
         while True:
@@ -277,6 +290,13 @@ class Router:
                 raise last or e
             try:
                 daemon_ticket, depth = self._replay_to(addr, rec.frames)
+            except ShedByServer as e:
+                last = e
+                tried.add(addr)
+                telemetry.count("router.daemon-shed")
+                log.info("daemon %s shed ticket %s (%s); trying a "
+                         "sibling", addr, rec.ticket, e)
+                continue
             except RemoteUnavailable as e:
                 last = e
                 tried.add(addr)
@@ -293,8 +313,8 @@ class Router:
 
     # -- admission -----------------------------------------------------------
 
-    def admission_reason(self, run: str) -> Optional[str]:
-        """Why this tenant's submission must be rejected, or None.
+    def admission_reason(self, tenant: str) -> Optional[str]:
+        """Why this tenant's submission must be shed, or None.
         Deterministic: both bounds are router-local counts, no daemon
         round-trip involved."""
         with self._lock:
@@ -306,11 +326,17 @@ class Router:
                         f"({pending}/{self.max_inflight} tickets in flight)")
             if self.tenant_quota is not None:
                 mine = sum(1 for r in self._tickets.values()
-                           if r.result is None and r.run == run)
+                           if r.result is None and r.tenant == tenant)
                 if mine >= self.tenant_quota:
-                    return (f"tenant {run!r} at its --tenant-quota "
+                    return (f"tenant {tenant!r} at its --tenant-quota "
                             f"({mine}/{self.tenant_quota} tickets in flight)")
         return None
+
+    def record_shed(self, tenant: str) -> None:
+        with self._lock:
+            self.n_rejected += 1
+            self.shed_by_tenant[tenant] = \
+                self.shed_by_tenant.get(tenant, 0) + 1
 
     # -- the ticket lifecycle ------------------------------------------------
 
@@ -323,7 +349,8 @@ class Router:
         frames = [(F_SUBMIT, meta)] + rsub.frames[1:]
         frames.append((F_COMMIT, commit_payload))
         ticket = "r" + uuid.uuid4().hex[:11]
-        rec = _TicketRec(ticket, rsub.run, rsub.spec_key, frames)
+        rec = _TicketRec(ticket, rsub.run, rsub.spec_key, frames,
+                         tenant=rsub.tenant)
         self._sweep()
         # Daemon first, then journal, then the TICKET reply: a crash
         # between submit and journal means the client never saw a
@@ -333,6 +360,7 @@ class Router:
         if self.journal is not None:
             self.journal.record_submit(ticket, {
                 "run": rec.run,
+                "tenant": rec.tenant,
                 "spec-key": rec.spec_key,
                 "frames": frames_to_record(frames),
             })
@@ -397,6 +425,17 @@ class Router:
             self.health.signal(dead, "poll-failed")
             log.warning("daemon %s lost ticket %s (%s); failing over",
                         dead, rec.ticket, why)
+        # The client already holds a TICKET for this submission, so the
+        # replay must not be deadline-shed by the sibling — an acked
+        # ticket yields a verdict, full stop.  Strip the deadline from
+        # the replayed SUBMIT (mirrors the scheduler's own journal
+        # replay, which never re-sheds).
+        if rec.frames and rec.frames[0][0] == F_SUBMIT \
+                and isinstance(rec.frames[0][1], dict) \
+                and rec.frames[0][1].get("deadline-s") is not None:
+            meta = dict(rec.frames[0][1])
+            meta.pop("deadline-s", None)
+            rec.frames = [(F_SUBMIT, meta)] + rec.frames[1:]
         try:
             depth = self._send_to_daemon(
                 rec, exclude={dead} if dead is not None else set(),
@@ -450,6 +489,7 @@ class Router:
             rec = _TicketRec(
                 ticket, str(sr.get("run") or "anonymous"),
                 str(sr.get("spec-key") or ""), frames,
+                tenant=str(sr.get("tenant") or "") or None,
             )
             self._tickets[ticket] = rec
             self.n_replayed += 1
@@ -501,6 +541,7 @@ class Router:
                 "results": self.n_results,
                 "failovers": self.n_failovers,
                 "admission-rejected": self.n_rejected,
+                "shed-by-tenant": dict(self.shed_by_tenant),
                 "replayed": self.n_replayed,
                 "affinity": dict(self._affinity),
                 "quota": {"tenant-quota": self.tenant_quota,
@@ -532,21 +573,25 @@ class _RouterHandler(socketserver.StreamRequestHandler):
             try:
                 if ftype == F_SUBMIT:
                     rejecting = False
-                    run = (str(payload.get("run") or "anonymous")
-                           if isinstance(payload, dict) else "anonymous")
-                    reason = router.admission_reason(run)
+                    meta = payload if isinstance(payload, dict) else {}
+                    tenant = str(meta.get("tenant")
+                                 or meta.get("run") or "anonymous")
+                    reason = router.admission_reason(tenant)
                     if reason is not None:
                         rejecting = True
                         rsub = None
-                        with router._lock:
-                            router.n_rejected += 1
+                        router.record_shed(tenant)
                         telemetry.count("router.admission-rejected")
-                        log.warning("admission rejected for %s: %s",
-                                    run, reason)
-                        self._reply(F_ERROR, {
-                            "error": f"checkerd.admission-rejected: "
-                                     f"{reason}",
-                        })
+                        log.warning("admission shed for %s: %s",
+                                    tenant, reason)
+                        # Structured soft refusal, not an ERROR: the
+                        # quota is a congestion signal the client can
+                        # wait out, not a protocol failure.
+                        self._reply(F_SHED, overload.OverloadShed(
+                            reason=f"router admission: {reason}",
+                            retry_after_s=1.0,
+                            tenant=tenant,
+                        ).payload())
                     else:
                         rsub = _RSub(payload)
                         if rsub.session:
@@ -581,9 +626,19 @@ class _RouterHandler(socketserver.StreamRequestHandler):
                         raise ProtocolError("COMMIT before SUBMIT")
                     s, rsub = rsub, None
                     router.unpark(s)
-                    ticket, depth = router.submit(
-                        s, payload if isinstance(payload, dict) else {},
-                    )
+                    try:
+                        ticket, depth = router.submit(
+                            s, payload if isinstance(payload, dict)
+                            else {},
+                        )
+                    except ShedByServer as e:
+                        # Every healthy daemon shed it: forward the
+                        # structured refusal so the client can honor
+                        # the retry-after.
+                        router.record_shed(s.tenant)
+                        telemetry.count("router.shed-forwarded")
+                        self._reply(F_SHED, e.shed.payload())
+                        continue
                     self._reply(F_TICKET, {
                         "ticket": ticket, "queue-depth": depth,
                     })
@@ -660,7 +715,13 @@ class _RouterMetricsHandler(BaseHTTPRequestHandler):
                     "admission-rejected", 0),
                 "router.replayed": st.get("replayed", 0),
             }
-            body = telemetry.prometheus_text(extra_gauges=extra).encode()
+            extra_labeled = {
+                "router.shed": (
+                    "tenant", st.get("shed-by-tenant") or {}, "counter"),
+            }
+            body = telemetry.prometheus_text(
+                extra_gauges=extra, extra_labeled=extra_labeled,
+            ).encode()
         except Exception as e:  # noqa: BLE001 — a scrape must not 500
             body = f"# metrics error: {e!r}\n".encode()
         self.send_response(200)

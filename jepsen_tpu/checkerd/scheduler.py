@@ -32,6 +32,7 @@ from .. import telemetry
 from ..checker.core import merge_valid
 from ..telemetry import flight, profile
 from ..utils import timeout as _timeout
+from . import overload
 
 _BLOWN = object()
 
@@ -39,6 +40,23 @@ _BLOWN = object()
 _RESULT_TTL_S = 600.0
 #: Hard cap on remembered tickets (done ones evict oldest-first).
 _MAX_TICKETS = 4096
+
+
+def _count_ops(subs: dict, packs: dict) -> int:
+    """Total op count of a submission (latency-estimator feature);
+    best-effort — a shape the codecs don't expose counts as zero."""
+    n = 0
+    for h in subs.values():
+        try:
+            n += len(h)
+        except TypeError:
+            pass
+    for p in packs.values():
+        try:
+            n += int(p.n)
+        except (AttributeError, TypeError, ValueError):
+            pass
+    return n
 
 
 class Request:
@@ -57,6 +75,8 @@ class Request:
         subs: Optional[dict[int, Any]] = None,
         packs: Optional[dict[int, Any]] = None,
         trace: Optional[dict] = None,
+        tenant: Optional[str] = None,
+        deadline_s: Optional[float] = None,
     ):
         from .protocol import canonical_spec
 
@@ -68,6 +88,16 @@ class Request:
         self.time_limit_s = time_limit_s
         self.subs = subs or {}
         self.packs = packs or {}
+        #: Fair-queueing identity: explicit SUBMIT "tenant" when given,
+        #: else the run name (matching the router's quota identity).
+        self.tenant = str(tenant or run or "anonymous")
+        #: Client deadline in seconds from submission; the admission
+        #: plane sheds early (overload.py) when the predicted verdict
+        #: latency plus queue wait cannot meet it.  None = never shed.
+        self.deadline_s = (float(deadline_s)
+                           if isinstance(deadline_s, (int, float))
+                           and deadline_s > 0 else None)
+        self.n_ops = _count_ops(self.subs, self.packs)
         #: The submitting run's trace context ({"trace-id",
         #: "parent-span"}) — deliberately NOT part of `compat`: a
         #: cohort merges requests from different traces, and each
@@ -106,6 +136,8 @@ class Scheduler:
         profile_dir: Optional[str] = None,
         plan_cache_dir: Optional[str] = None,
         queue_path: Optional[str] = None,
+        tenant_weights: Optional[dict[str, float]] = None,
+        fair_quantum: float = overload.DEFAULT_QUANTUM,
     ):
         self.batch_window_s = batch_window_s
         self.max_budget_s = max_budget_s
@@ -124,7 +156,16 @@ class Scheduler:
 
             plan_cache.configure(plan_cache_dir)
         self._cond = threading.Condition()
-        self._queue: list[Request] = []
+        #: Deficit-round-robin per-tenant queues (overload.py) — the
+        #: FIFO list's replacement.  Guarded by self._cond like it was.
+        self._fq = overload.FairQueue(
+            quantum=fair_quantum, weights=tenant_weights,
+        )
+        #: Verdict-latency estimator feeding deadline-aware shedding.
+        self.estimator = overload.LatencyEstimator()
+        #: Per-tenant service record (queue-wait p95, served/shed).
+        self.tenant_stats = overload.TenantStats()
+        self.n_shed = 0
         self._tickets: dict[str, Request] = {}
         #: canonical model spec -> live Model instance.  THE warm path:
         #: one instance per spec for the daemon's lifetime means one
@@ -197,7 +238,7 @@ class Scheduler:
             req.state = "queued"
             with self._cond:
                 self._tickets[ticket] = req
-                self._queue.append(req)
+                self._fq.push(req)
                 self.n_requests += 1
                 self.n_keys_total += req.n_keys
                 self._run_entry_locked(req.run)["submitted"] += 1
@@ -211,15 +252,20 @@ class Scheduler:
     # -- admission ----------------------------------------------------------
 
     def submit(self, req: Request, *, owner_conn: Optional[int] = None) -> str:
+        """Admits one request, or raises overload.OverloadShed — BEFORE
+        any ticket is minted or journaled, so a shed is never an acked
+        submission (the no-silent-loss invariant is trivial for sheds:
+        there is nothing to lose)."""
         now = time.monotonic()
         with self._cond:
+            self._maybe_shed_locked(req)
             req.ticket = uuid.uuid4().hex[:12]
             req.submitted_t = now
             req.state = "queued"
             req.owner_conn = owner_conn
             self._sweep_locked(now)
             self._tickets[req.ticket] = req
-            self._queue.append(req)
+            self._fq.push(req)
             self.n_requests += 1
             self.n_keys_total += req.n_keys
             r = self._run_entry_locked(req.run)
@@ -237,6 +283,33 @@ class Scheduler:
             telemetry.count("checkerd.requests")
             telemetry.count("checkerd.keys", req.n_keys)
         return req.ticket
+
+    def _maybe_shed_locked(self, req: Request) -> None:
+        """Deadline-aware admission (overload.py): raises OverloadShed
+        when the predicted verdict latency plus the current queue wait
+        cannot meet the request's client deadline.  Requests without a
+        deadline are never shed — they queue like they always did."""
+        if req.deadline_s is None:
+            return
+        queued_keys = sum(r.n_keys for r in self._fq.requests())
+        wait_s = self.estimator.queue_wait_s(queued_keys)
+        check_s = self.estimator.predict_s(req.n_keys, req.n_ops)
+        estimate = (wait_s + check_s) * overload.brownout().shed_factor()
+        if estimate <= req.deadline_s:
+            return
+        self.n_shed += 1
+        self.tenant_stats.record_shed(req.tenant)
+        telemetry.count("checkerd.overload.shed")
+        telemetry.count("checkerd.overload.shed-deadline")
+        raise overload.OverloadShed(
+            f"predicted verdict latency {estimate:.2f}s exceeds the "
+            f"{req.deadline_s:.2f}s client deadline "
+            f"(queue wait ~{wait_s:.2f}s over {queued_keys} keys)",
+            retry_after_s=max(0.5, wait_s),
+            tenant=req.tenant,
+            estimate_s=estimate,
+            deadline_s=req.deadline_s,
+        )
 
     def poll(self, ticket: str, conn_id: Optional[int] = None) -> dict:
         """A POLL reply payload: PENDING-shaped while queued/running,
@@ -256,7 +329,7 @@ class Scheduler:
             return {
                 "_pending": True,
                 "state": req.state,
-                "queue-depth": len(self._queue),
+                "queue-depth": len(self._fq),
             }
 
     def abandon(self, ticket: str, conn_id: Optional[int] = None) -> bool:
@@ -296,7 +369,7 @@ class Scheduler:
 
     def queue_depth(self) -> int:
         with self._cond:
-            return len(self._queue)
+            return len(self._fq)
 
     def stop(self) -> None:
         with self._cond:
@@ -340,7 +413,7 @@ class Scheduler:
             uptime = max(now - self._t0, 1e-9)
             queued: dict[str, int] = {}
             running: dict[str, int] = {}
-            for r in self._queue:
+            for r in self._fq.requests():
                 queued[r.run] = queued.get(r.run, 0) + 1
             for r in self._tickets.values():
                 if r.state == "running":
@@ -352,9 +425,20 @@ class Scheduler:
                     "queued": queued.get(run, 0),
                     "running": running.get(run, 0),
                 }
+            fair = self._fq.snapshot()
+            tenants = self.tenant_stats.snapshot()
+            for t, fq in fair.items():
+                tenants.setdefault(t, {}).update(fq)
             out = {
                 "uptime-s": round(uptime, 3),
-                "queue-depth": len(self._queue),
+                "queue-depth": len(self._fq),
+                "overload": {
+                    "brownout-level": overload.brownout().level,
+                    "shed": self.n_shed,
+                    "quantum": self._fq.quantum,
+                    "weights": dict(self._fq.weights),
+                    "tenants": tenants,
+                },
                 "requests": self.n_requests,
                 "keys": self.n_keys_total,
                 "cohorts": self.n_cohorts,
@@ -422,8 +506,13 @@ class Scheduler:
     def _loop(self) -> None:
         while True:
             with self._cond:
-                while not self._queue and not self._stop:
+                while not len(self._fq) and not self._stop:
                     self._cond.wait(0.5)
+                    # Idle samples let the brownout ladder de-escalate
+                    # (sample() takes no scheduler lock; _cond is ours).
+                    overload.brownout().sample(
+                        len(self._fq), overload.process_rss_mb(),
+                    )
                 if self._stop:
                     return
             if self.batch_window_s > 0:
@@ -435,10 +524,8 @@ class Scheduler:
                 # The cohort boundary is where abandoned tickets leave:
                 # their keys never join the merged subs map, so a dead
                 # client can't burn cohort budget.
-                condemned = [r for r in self._queue if r.abandoned]
+                condemned = self._fq.drop_abandoned()
                 if condemned:
-                    self._queue = [r for r in self._queue
-                                   if not r.abandoned]
                     now = time.monotonic()
                     for r in condemned:
                         r.state = "done"
@@ -455,16 +542,26 @@ class Scheduler:
                             "checkerd": {"ticket": r.ticket,
                                          "abandoned": True},
                         }
-                if not self._queue:
+                if not len(self._fq):
                     continue
-                head = self._queue[0]
-                group = [r for r in self._queue if r.compat == head.compat]
-                taken = set(id(r) for r in group)
-                self._queue = [r for r in self._queue if id(r) not in taken]
+                # Deficit round-robin picks the head (the fairness
+                # decision); compatible requests from EVERY tenant then
+                # ride the cohort — the merge amortizes device work, so
+                # joining costs the fleet nothing, and take_compat
+                # charges each tenant's deficit for its own keys.
+                head = self._fq.next_head()
+                if head is None:
+                    continue
+                group = [head] + self._fq.take_compat(head.compat)
                 now = time.monotonic()
                 for r in group:
                     r.state = "running"
                     r.started_t = now
+            # One pressure sample per cohort: queue depth + RSS drive
+            # the brownout ladder the plan compiler consults.
+            overload.brownout().sample(
+                self.queue_depth(), overload.process_rss_mb(),
+            )
             t_run = time.monotonic()
             try:
                 self._check_group(group)
@@ -482,6 +579,10 @@ class Scheduler:
                             "checkerd": {"error": err["error"]},
                         }
             dt = time.monotonic() - t_run
+            # Feed the deadline-shed estimator with what this cohort
+            # actually cost per key (observed fallback when the plan
+            # cost model is untrained).
+            self.estimator.observe(sum(r.n_keys for r in group), dt)
             if self.journal is not None:
                 # The replay-idempotence rule: a verdict is durable
                 # BEFORE any poll can observe state "done", so a crash
@@ -510,6 +611,9 @@ class Scheduler:
                     e["last-latency-s"] = round(lat, 4)
                     if len(group) > 1:
                         e["merged"] += 1
+                    self.tenant_stats.observe_wait(
+                        r.tenant, r.started_t - r.submitted_t,
+                    )
                 self._cond.notify_all()
             if telemetry.enabled():
                 telemetry.count("checkerd.cohorts")
@@ -720,12 +824,19 @@ def _settle_packs(
             live.append(k)
     if not live:
         return out
-    try:
-        stream_v = check_wgl_witness_stream(
-            [packs[k] for k in live], pm, time_limit_s=left(),
-        )
-    except Exception:  # noqa: BLE001 — sound fallback below
+    if "stream" in overload.dropped_passes():
+        # Brownout level 1+: the witness beam is the first optional
+        # tier to go — it only ever proves keys early, so skipping it
+        # routes work to the sound exact tiers below.
+        telemetry.count("checkerd.overload.brownout-skip-stream")
         stream_v = [None] * len(live)
+    else:
+        try:
+            stream_v = check_wgl_witness_stream(
+                [packs[k] for k in live], pm, time_limit_s=left(),
+            )
+        except Exception:  # noqa: BLE001 — sound fallback below
+            stream_v = [None] * len(live)
     rest = []
     for k, v in zip(live, stream_v):
         if v is True:

@@ -27,6 +27,7 @@ from .protocol import (
     F_RESULT,
     F_RESUME,
     F_RESUME_OK,
+    F_SHED,
     F_STATS,
     F_STATS_REPLY,
     F_SUBMIT,
@@ -36,15 +37,22 @@ from .protocol import (
     unpack_key_frame,
     write_frame,
 )
+from . import overload
 from .scheduler import Request, Scheduler
 from .. import telemetry
 
 log = logging.getLogger(__name__)
 
 #: Parked streaming sessions the daemon keeps for reconnecting
-#: clients; oldest-first eviction past this (a leaked session must not
-#: pin its half-uploaded history forever).
+#: clients; LRU eviction past this (a leaked session must not pin its
+#: half-uploaded history forever, and a session that just resumed must
+#: not be the one evicted).
 MAX_PARKED_SESSIONS = 64
+
+#: Evicted session tokens remembered so a late RESUME gets an honest
+#: "evicted" refusal (the client falls back to post-hoc) instead of an
+#: indistinguishable "unknown session".
+MAX_EVICTED_REMEMBERED = 256
 
 
 class _Submission:
@@ -145,6 +153,8 @@ class _Submission:
             subs=subs,
             packs=self.packs,
             trace=meta.get("trace"),
+            tenant=meta.get("tenant"),
+            deadline_s=meta.get("deadline-s"),
         )
 
 
@@ -198,10 +208,23 @@ class _Handler(socketserver.StreamRequestHandler):
                              if isinstance(payload, dict) else None)
                     parked = self._parked(token)
                     if parked is None:
-                        self._reply(F_ERROR, {
-                            "error": f"unknown session {token!r} "
-                            "(daemon restarted or session evicted)",
-                        })
+                        # Honest RESUME refusal: an evicted session is
+                        # named as such so the client knows its stream
+                        # is unrecoverable and falls back to post-hoc
+                        # (never wedges waiting for a bound that will
+                        # not come).
+                        if self._was_evicted(token):
+                            telemetry.count("checkerd.resume-refused")
+                            self._reply(F_ERROR, {
+                                "error": f"session {token!r} evicted "
+                                "(parked-session LRU bound; resume "
+                                "refused — submit post-hoc)",
+                            })
+                        else:
+                            self._reply(F_ERROR, {
+                                "error": f"unknown session {token!r} "
+                                "(daemon restarted or session evicted)",
+                            })
                     else:
                         sub = parked
                         self._reply(F_RESUME_OK, {
@@ -220,10 +243,16 @@ class _Handler(socketserver.StreamRequestHandler):
                     # fresh ones) opt out of abandon-on-disconnect, as
                     # do streamed ones (their poller arrives later).
                     detached = s.streaming or bool(s.meta.get("detached"))
-                    ticket = sched.submit(
-                        req,
-                        owner_conn=None if detached else conn_id,
-                    )
+                    try:
+                        ticket = sched.submit(
+                            req,
+                            owner_conn=None if detached else conn_id,
+                        )
+                    except overload.OverloadShed as shed:
+                        # Structured refusal: no ticket was minted or
+                        # journaled, so nothing can be silently lost.
+                        self._reply(F_SHED, shed.payload())
+                        continue
                     if not detached:
                         owned.append(ticket)
                     self._reply(F_TICKET, {
@@ -260,17 +289,35 @@ class _Handler(socketserver.StreamRequestHandler):
         return sub
 
     def _park(self, sub: _Submission) -> None:
+        """Parks (or LRU-touches) a streamed submission.  Eviction is
+        least-recently-used — dict insertion order, refreshed on every
+        park and resume — bounded by MAX_PARKED_SESSIONS; each victim
+        is counted (checkerd.parked-evicted) and remembered so its
+        RESUME gets an honest refusal."""
         srv = self.server
         with srv.sessions_lock:  # type: ignore[attr-defined]
+            srv.sessions.pop(sub.session, None)  # type: ignore[attr-defined]
             srv.sessions[sub.session] = sub  # type: ignore[attr-defined]
             while len(srv.sessions) > MAX_PARKED_SESSIONS:  # type: ignore[attr-defined]
                 victim = next(iter(srv.sessions))  # type: ignore[attr-defined]
                 del srv.sessions[victim]  # type: ignore[attr-defined]
+                srv.evicted_sessions.append(victim)  # type: ignore[attr-defined]
+                telemetry.count("checkerd.parked-evicted")
 
     def _parked(self, token: Any) -> Optional[_Submission]:
         srv = self.server
         with srv.sessions_lock:  # type: ignore[attr-defined]
-            return srv.sessions.get(token)  # type: ignore[attr-defined]
+            sub = srv.sessions.get(token)  # type: ignore[attr-defined]
+            if sub is not None:
+                # LRU touch: a resuming session moves to the young end.
+                srv.sessions.pop(token, None)  # type: ignore[attr-defined]
+                srv.sessions[token] = sub  # type: ignore[attr-defined]
+            return sub
+
+    def _was_evicted(self, token: Any) -> bool:
+        srv = self.server
+        with srv.sessions_lock:  # type: ignore[attr-defined]
+            return token in srv.evicted_sessions  # type: ignore[attr-defined]
 
     def _unpark(self, sub: _Submission) -> None:
         srv = self.server
@@ -289,9 +336,12 @@ class CheckerdServer(socketserver.ThreadingTCPServer):
     allow_reuse_address = True
     daemon_threads = True
     scheduler: Scheduler
-    #: Parked streaming submissions by resume token (F_RESUME).
+    #: Parked streaming submissions by resume token (F_RESUME),
+    #: LRU-ordered: oldest-touched first.
     sessions: dict
     sessions_lock: threading.Lock
+    #: Recently LRU-evicted session tokens (honest RESUME refusals).
+    evicted_sessions: Any
 
 
 def make_server(
@@ -304,10 +354,14 @@ def make_server(
     profile_dir: Optional[str] = None,
     plan_cache_dir: Optional[str] = None,
     queue_path: Optional[str] = None,
+    tenant_weights: Optional[dict] = None,
 ) -> CheckerdServer:
+    from collections import deque
+
     srv = CheckerdServer((host, port), _Handler)
     srv.sessions = {}
     srv.sessions_lock = threading.Lock()
+    srv.evicted_sessions = deque(maxlen=MAX_EVICTED_REMEMBERED)
     srv.scheduler = Scheduler(
         batch_window_s=batch_window_s,
         max_budget_s=max_budget_s,
@@ -315,6 +369,7 @@ def make_server(
         profile_dir=profile_dir,
         plan_cache_dir=plan_cache_dir,
         queue_path=queue_path,
+        tenant_weights=tenant_weights,
     )
     return srv
 
@@ -335,6 +390,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
 
         try:
             st = self.scheduler.stats()
+            ov = st.get("overload") or {}
             extra = {
                 "checkerd.queue-depth": st.get("queue-depth", 0),
                 "checkerd.utilization": st.get("utilization", 0.0),
@@ -343,6 +399,26 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                 "checkerd.cohorts": st.get("cohorts", 0),
                 "checkerd.merge-ratio": st.get("merge-ratio", 0.0),
                 "checkerd.profile-records": st.get("profile-records", 0),
+                "checkerd.overload.brownout-level":
+                    ov.get("brownout-level", 0),
+                "checkerd.overload.shed-total": ov.get("shed", 0),
+            }
+            # Per-tenant admission/fairness families (satellite 3):
+            # jepsen_checkerd_shed_total{tenant=...} and the queue-wait
+            # p95 gauge per tenant.
+            tenants = ov.get("tenants") or {}
+            shed_by_tenant = {
+                t: d.get("shed", 0) for t, d in tenants.items()
+                if d.get("shed")
+            }
+            wait_p95 = {
+                t: d["queue-wait-p95-s"] for t, d in tenants.items()
+                if d.get("queue-wait-p95-s") is not None
+            }
+            extra_labeled = {
+                "checkerd.shed": ("tenant", shed_by_tenant, "counter"),
+                "checkerd.queue-wait-p95-seconds":
+                    ("tenant", wait_p95, "gauge"),
             }
             # SLO sweep on every scrape: the daemon-surface gauges
             # (queue depth, merge ratio) only exist here, so this is
@@ -353,6 +429,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             body = telemetry.prometheus_text(
                 extra_gauges=extra, chip_state=degrade.chip_state(),
                 slo_firing=slo.firing_gauges(),
+                extra_labeled=extra_labeled,
             ).encode()
         except Exception as e:  # noqa: BLE001 — a scrape must not 500
             # the daemon into a restart loop; answer degraded instead.
@@ -388,6 +465,7 @@ def serve(
     profile_dir: Optional[str] = None,
     plan_cache_dir: Optional[str] = None,
     queue_path: Optional[str] = None,
+    tenant_weights: Optional[dict] = None,
 ) -> None:
     """Blocking entrypoint for `jepsen checkerd`."""
     srv = make_server(
@@ -396,6 +474,7 @@ def serve(
         profile_dir=profile_dir,
         plan_cache_dir=plan_cache_dir,
         queue_path=queue_path,
+        tenant_weights=tenant_weights,
     )
     bound_port = srv.server_address[1]
     msrv = None
@@ -470,7 +549,21 @@ def main(argv: Optional[list[str]] = None) -> int:
         "a restarted daemon replays unfinished tickets under their "
         "original ids — zero in-flight verdicts lost",
     )
+    p.add_argument(
+        "--tenant-weight", action="append", default=[],
+        metavar="NAME=W",
+        help="fair-queue weight for a tenant (repeatable; default 1.0 "
+        "each): service share under saturation is weight-proportional, "
+        "never a hard cliff",
+    )
     opts = p.parse_args(argv)
+    weights: dict[str, float] = {}
+    for spec in opts.tenant_weight:
+        name, _, w = spec.partition("=")
+        try:
+            weights[name] = float(w)
+        except ValueError:
+            p.error(f"--tenant-weight {spec!r}: expected NAME=FLOAT")
     logging.basicConfig(
         level=logging.INFO,
         format="%(asctime)s %(levelname)s [%(threadName)s] "
@@ -487,5 +580,10 @@ def main(argv: Optional[list[str]] = None) -> int:
         profile_dir=opts.profile_dir,
         plan_cache_dir=opts.plan_cache,
         queue_path=opts.queue,
+        tenant_weights=weights or None,
     )
     return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

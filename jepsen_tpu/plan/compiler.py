@@ -56,6 +56,23 @@ def _knob_counter(*sources: str) -> None:
     )
 
 
+def _brownout_drops() -> tuple:
+    """Optional pass ids the checkerd brownout ladder is currently
+    dropping (checkerd/overload.py).  Empty outside a daemon or at
+    level 0; the dropped tiers only ever prove keys early, so plans
+    built without them stay sound — work routes to the exact tiers."""
+    try:
+        from ..checkerd import overload
+
+        dropped = overload.dropped_passes()
+    except Exception:  # noqa: BLE001 — compilation must never fail on
+        # an advisory signal
+        return ()
+    if dropped:
+        telemetry.count("wgl.plan.brownout-compile")
+    return dropped
+
+
 # ---------------------------------------------------------------------------
 # Cohort plans (IndependentChecker)
 # ---------------------------------------------------------------------------
@@ -95,16 +112,19 @@ def compile_cohort_plan(
                       knobs={"threshold": 2000})
     chain.append(router)
     longdev = PassNode("longdev", "single-device", features=feats)
+    dropped = _brownout_drops()
     stream = None
-    if order != "skip-stream":
+    if order != "skip-stream" and "stream" not in dropped:
         stream = PassNode("stream", "stream-witness",
                           knobs=dict(stream_knobs), features=feats)
     screen = PassNode("screen", "refute-screen",
                       knobs={"mode": "classify"}, features=feats,
                       group=True)
-    batched = PassNode("batched", "batched-bfs",
-                       knobs=dict(batched_knobs), features=feats,
-                       group=True)
+    batched = None
+    if "batched" not in dropped:
+        batched = PassNode("batched", "batched-bfs",
+                           knobs=dict(batched_knobs), features=feats,
+                           group=True)
     detail = PassNode("detail", "settle-exact", features=feats,
                       group=True)
 
@@ -116,17 +136,23 @@ def compile_cohort_plan(
     if stream is not None:
         stream.edges["unknown"] = screen.id
     screen.edges["refuted"] = detail.id
-    screen.edges["unknown"] = batched.id
-    batched.edges["refuted"] = detail.id
-    batched.edges["unknown"] = detail.id
+    if batched is not None:
+        screen.edges["unknown"] = batched.id
+        batched.edges["refuted"] = detail.id
+        batched.edges["unknown"] = detail.id
+    else:
+        screen.edges["unknown"] = detail.id
 
     nodes.extend(chain)
     nodes.append(longdev)
     if stream is not None:
         nodes.append(stream)
-    nodes.extend([screen, batched, detail])
+    nodes.append(screen)
+    if batched is not None:
+        nodes.append(batched)
+    nodes.append(detail)
 
-    plan = Plan(nodes, meta={
+    meta = {
         "kind": "cohort",
         "model": pm.name,
         "algorithm": lin.algorithm,
@@ -134,7 +160,10 @@ def compile_cohort_plan(
         "keys": n_keys,
         "knobs": "model" if "model" in (s_src, b_src) else "heuristic",
         "order": order,
-    })
+    }
+    if dropped:
+        meta["brownout-dropped"] = list(dropped)
+    plan = Plan(nodes, meta=meta)
     return plan, chain[0].id
 
 
@@ -184,26 +213,31 @@ def compile_packs_plan(lin: Any, pm: Any, n_keys: int,
     if cache_on:
         chain.append(PassNode("pmemo", "persistent-memo",
                               features=feats))
-    stream = PassNode("stream", "stream-witness",
-                      knobs=dict(stream_knobs), features=feats)
+    dropped = _brownout_drops()
+    if "stream" not in dropped:
+        chain.append(PassNode("stream", "stream-witness",
+                              knobs=dict(stream_knobs), features=feats))
     screen = PassNode("screen", "refute-screen",
                       knobs={"mode": "decide"}, features=feats,
                       group=True)
     exact = PassNode("exact", "packs-exact", features=feats,
                      group=True)
-    chain.append(stream)
     for a, b in zip(chain, chain[1:]):
         a.edges["unknown"] = b.id
-    stream.edges["unknown"] = screen.id
+    if chain:
+        chain[-1].edges["unknown"] = screen.id
     screen.edges["unknown"] = exact.id
-    plan = Plan(chain + [screen, exact], meta={
+    meta = {
         "kind": "packs",
         "model": pm.name,
         "algorithm": lin.algorithm,
         "budget-s": lin.time_limit_s,
         "keys": n_keys,
-    })
-    return plan, chain[0].id
+    }
+    if dropped:
+        meta["brownout-dropped"] = list(dropped)
+    plan = Plan(chain + [screen, exact], meta=meta)
+    return plan, chain[0].id if chain else screen.id
 
 
 def run_packs(packs: dict, model: Any, lin: Any,

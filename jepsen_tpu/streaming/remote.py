@@ -226,10 +226,26 @@ class RemoteFeed:
         with self._lock:
             if self._client is not None:
                 return
+        from ..checkerd import overload
         from ..checkerd.client import CheckerdClient
         from ..checkerd.protocol import F_SUBMIT
 
-        c = CheckerdClient(self.addr)
+        # Same process-wide breaker RemoteChecker consults: a daemon
+        # that keeps refusing connections shouldn't cost this feed a
+        # connect timeout per flush interval — abandon the stream (the
+        # post-hoc submit covers it) until the breaker half-opens.
+        br = overload.breaker_for(self.addr)
+        if not br.allow():
+            telemetry.count("checkerd.breaker-skip")
+            raise RuntimeError(
+                f"circuit open for {self.addr} (recent failures)"
+            )
+        try:
+            c = CheckerdClient(self.addr)
+        except Exception:
+            br.record_failure()
+            raise
+        br.record_success()
         c._send(F_SUBMIT, {
             "run": self.run,
             "model": self.model_spec,
@@ -290,6 +306,7 @@ class RemoteFeed:
         via the session token, and re-sends each key's tail past the
         daemon's stable bound.  False means the fallback path (post-hoc
         submit) takes over."""
+        from ..checkerd import overload
         from ..checkerd.client import CHUNK_OPS, CheckerdClient
         from ..checkerd.protocol import F_CHUNK, F_RESUME, F_RESUME_OK
 
@@ -300,6 +317,12 @@ class RemoteFeed:
             c_old, self._client = self._client, None
         if c_old is not None:
             c_old.close()
+        br = overload.breaker_for(self.addr)
+        if not br.allow():
+            telemetry.count("checkerd.breaker-skip")
+            log.info("resume of session %s skipped: circuit open for "
+                     "%s", self.session[:8], self.addr)
+            return False
         c = None
         try:
             c = CheckerdClient(self.addr)
@@ -318,11 +341,13 @@ class RemoteFeed:
                     resent += len(ops[lo:lo + CHUNK_OPS])
             c.wf.flush()
         except Exception as e:  # noqa: BLE001
+            br.record_failure()
             if c is not None:
                 c.close()
             log.info("resume of session %s failed (%s); abandoning the "
                      "stream", self.session[:8], e)
             return False
+        br.record_success()
         with self._lock:
             self._client = c
             self.resumes += 1

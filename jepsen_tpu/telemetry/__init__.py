@@ -126,6 +126,7 @@ FLEET_COUNTER_PREFIXES = (
     "checkerd.",
     "router.",
     "ingest.",
+    "chaos.",
 )
 
 
@@ -643,6 +644,7 @@ def prometheus_text(
     chip_state: Optional[str] = None,
     lint_findings: Optional[dict] = None,
     slo_firing: Optional[dict] = None,
+    extra_labeled: Optional[dict] = None,
 ) -> str:
     """The registry rendered in Prometheus text exposition format:
     counters as `counter`, gauge last-values and span totals/counts as
@@ -656,7 +658,11 @@ def prometheus_text(
     `slo_firing` ({rule: 0|1}) renders the
     `jepsen_slo_firing{rule=...}` family — when omitted, the default
     SLO engine's current state (telemetry/slo.py) is exported, so every
-    scrape surface alerts for free."""
+    scrape surface alerts for free;
+    `extra_labeled` ({family: (label_name, {label_value: number},
+    "counter"|"gauge")}) renders single-label families like
+    `jepsen_checkerd_shed_total{tenant=...}` — counters get the
+    `_total` suffix appended here, so pass the bare family name."""
     with _lock:
         counters = dict(_counters)
         gauges = {k: g[0] for k, g in _gauges.items()}
@@ -756,4 +762,19 @@ def prometheus_text(
                 continue
             lines.append(
                 f'jepsen_slo_firing{{rule="{rule}"}} {int(bool(v))}')
+    for family in sorted(extra_labeled or {}):
+        try:
+            label, values, ptype = (extra_labeled or {})[family]
+        except (TypeError, ValueError):
+            continue
+        if ptype not in ("counter", "gauge") or not isinstance(
+                values, dict):
+            continue
+        pn = _prom_name(family) + ("_total" if ptype == "counter" else "")
+        lines.append(f"# TYPE {pn} {ptype}")
+        for lv in sorted(values, key=str):
+            v = values[lv]
+            if not isinstance(v, (int, float)):
+                continue
+            lines.append(f'{pn}{{{label}="{lv}"}} {v}')
     return "\n".join(lines) + "\n"

@@ -120,6 +120,13 @@ MONITOR_RULES: tuple[Rule, ...] = (
          "wgl.online.verdict-lag-s.p95", 30.0),
     Rule("cost-drift", "gauge-above", "monitor.cost-drift-ratio",
          3.0, for_count=3),
+    # Overload control plane: a sustained shed rate means the fleet is
+    # saturated past the point graceful degradation can absorb —
+    # capacity or weights need attention, not just patience (the
+    # brownout ladder and deadline shedding are already doing their
+    # jobs when this fires).
+    Rule("checkerd-shed-rate", "counter-rate-above",
+         "checkerd.overload.shed", 1.0, for_count=3),
 )
 
 

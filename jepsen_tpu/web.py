@@ -531,9 +531,41 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 "<h2>plan cache (plan/cache.py)</h2>"
                 f"<table>{prows}</table>"
             )
+        ov_tbl = ""
+        ov = stats.get("overload") or {}
+        if ov:
+            weights = ov.get("weights") or {}
+            head = "".join(
+                f"<tr><td>{html.escape(str(k))}</td>"
+                f"<td>{html.escape(str(v))}</td></tr>"
+                for k, v in [
+                    ("brownout level", ov.get("brownout-level")),
+                    ("sheds total", ov.get("shed")),
+                    ("fair-queue quantum (key-credits)",
+                     ov.get("quantum")),
+                ]
+            )
+            trows = "".join(
+                f"<tr><td>{html.escape(str(t))}</td>"
+                f"<td>{weights.get(t, 1.0)}</td>"
+                f"<td>{d.get('served')}</td><td>{d.get('shed')}</td>"
+                f"<td>{d.get('queue-wait-p95-s')}</td></tr>"
+                for t, d in sorted((ov.get("tenants") or {}).items())
+                if isinstance(d, dict)
+            )
+            tenants_tbl = (
+                "<h3>tenants (deficit round-robin)</h3><table>"
+                "<tr><th>tenant</th><th>weight</th><th>served</th>"
+                "<th>shed</th><th>queue-wait p95 s</th></tr>"
+                + trows + "</table>"
+            ) if trows else ""
+            ov_tbl = (
+                "<h2>overload control (checkerd/overload.py)</h2>"
+                f"<table>{head}</table>" + tenants_tbl
+            )
         self._send(200, _page(
             "checker fleet",
-            f"<table>{orows}</table>" + runs_tbl + plan_tbl
+            f"<table>{orows}</table>" + runs_tbl + ov_tbl + plan_tbl
             + _roofline_panel(stats.get("roofline"))
             + _slo_panel() + lint_tbl + hint,
         ))
@@ -593,7 +625,20 @@ class Handler(http.server.BaseHTTPRequestHandler):
             "<th>queue depth</th><th>requests</th><th>models cached</th>"
             "<th>affinity specs</th></tr>" + drows + "</table>"
         )
-        return f"<table>{orows}</table>" + daemons_tbl
+        shed_tbl = ""
+        sheds = stats.get("shed-by-tenant") or {}
+        if sheds:
+            srows = "".join(
+                f"<tr><td>{html.escape(str(t))}</td>"
+                f"<td>{html.escape(str(n))}</td></tr>"
+                for t, n in sorted(sheds.items())
+            )
+            shed_tbl = (
+                "<h2>admission sheds by tenant</h2><table>"
+                "<tr><th>tenant</th><th>sheds</th></tr>"
+                + srows + "</table>"
+            )
+        return f"<table>{orows}</table>" + daemons_tbl + shed_tbl
 
     def _metrics(self) -> None:
         """Prometheus text scrape surface: this process's telemetry

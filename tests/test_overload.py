@@ -1,0 +1,404 @@
+"""Overload control plane: graceful degradation under saturation.
+
+The checkerd/overload.py contracts, each tested in isolation with
+injected clocks/RNGs, plus the two end-to-end shapes that define the
+plane's honesty:
+
+  * deficit round-robin bounds starvation — a whale tenant cannot push
+    a light tenant's service arbitrarily far out, and weights scale
+    service share instead of cliffing it;
+  * deadline shedding happens BEFORE the ticket is minted, with a
+    structured RETRY-AFTER, and the same submission without a deadline
+    is served to a normal verdict (shed vs served parity);
+  * the brownout ladder escalates and de-escalates in order, dropping
+    optional plan passes only;
+  * circuit breakers walk closed -> open -> half-open -> closed with
+    exactly one probe per half-open window.
+"""
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+import pytest
+
+from jepsen_tpu.checkerd import overload
+from jepsen_tpu.checkerd.overload import (
+    BrownoutController,
+    CircuitBreaker,
+    FairQueue,
+    LatencyEstimator,
+    OverloadShed,
+    TenantStats,
+)
+
+
+@dataclass
+class _Req:
+    tenant: str
+    n_keys: int = 1
+    compat: str = "c"
+    abandoned: bool = False
+    name: str = field(default="")
+
+
+# ---------------------------------------------------------------------
+# FairQueue: deficit round-robin
+# ---------------------------------------------------------------------
+
+
+def _drain(fq):
+    out = []
+    while True:
+        r = fq.next_head()
+        if r is None:
+            return out
+        out.append(r)
+
+
+def test_fair_queue_starvation_bound():
+    """A light tenant arriving behind a deep whale backlog is served
+    within a couple of pops, not after the whale drains."""
+    fq = FairQueue(quantum=8.0)
+    for i in range(50):
+        fq.push(_Req("whale", n_keys=8, name=f"w{i}"))
+    fq.push(_Req("light", n_keys=1, name="l0"))
+    order = _drain(fq)
+    pos = next(i for i, r in enumerate(order) if r.tenant == "light")
+    assert pos <= 2, f"light tenant served at position {pos}"
+    assert len(order) == 51
+
+
+def test_fair_queue_interleaves_equal_weights():
+    fq = FairQueue(quantum=8.0)
+    for i in range(10):
+        fq.push(_Req("a", n_keys=8, name=f"a{i}"))
+        fq.push(_Req("b", n_keys=8, name=f"b{i}"))
+    order = [r.tenant for r in _drain(fq)]
+    # Equal weights, equal costs: no tenant is ever served twice in a
+    # row while the other still has queued work.
+    for i in range(1, 19):
+        assert order[i] != order[i - 1], f"double-serve at {i}: {order}"
+
+
+def test_fair_queue_weight_scales_share():
+    """Weight 3 gets ~3x the service of weight 1 over any window —
+    a quota is a share, not a cliff."""
+    fq = FairQueue(quantum=8.0, weights={"heavy": 3.0})
+    for i in range(30):
+        fq.push(_Req("heavy", n_keys=8, name=f"h{i}"))
+        fq.push(_Req("lite", n_keys=8, name=f"l{i}"))
+    first = [r.tenant for r in _drain(fq)][:12]
+    heavy = first.count("heavy")
+    assert 8 <= heavy <= 10, f"heavy got {heavy}/12: {first}"
+    assert first.count("lite") >= 2  # never starved outright
+
+
+def test_fair_queue_take_compat_charges_each_tenant():
+    fq = FairQueue(quantum=8.0)
+    fq.push(_Req("a", n_keys=4, compat="x", name="a0"))
+    fq.push(_Req("a", n_keys=4, compat="y", name="a1"))
+    fq.push(_Req("b", n_keys=2, compat="x", name="b0"))
+    taken = fq.take_compat("x")
+    assert sorted(r.name for r in taken) == ["a0", "b0"]
+    # `a` still has queued work, so its merge ride shows as debt; `b`
+    # drained and retired (deficit resets — standard DRR, no banking).
+    assert fq.snapshot()["a"]["deficit"] == -4.0
+    assert "b" not in fq.snapshot()
+    assert len(fq) == 1
+
+
+def test_fair_queue_drop_abandoned_and_empty():
+    fq = FairQueue()
+    assert fq.next_head() is None
+    fq.push(_Req("a", abandoned=True, name="dead"))
+    fq.push(_Req("a", name="live"))
+    gone = fq.drop_abandoned()
+    assert [r.name for r in gone] == ["dead"]
+    assert [r.name for r in _drain(fq)] == ["live"]
+
+
+# ---------------------------------------------------------------------
+# TenantStats + LatencyEstimator
+# ---------------------------------------------------------------------
+
+
+def test_tenant_stats_p95_and_sheds():
+    ts = TenantStats()
+    for i in range(100):
+        ts.observe_wait("t", i / 100.0)
+    ts.record_shed("t")
+    ts.record_shed("u")
+    p95 = ts.wait_p95("t")
+    assert 0.9 <= p95 <= 0.99
+    snap = ts.snapshot()
+    assert snap["t"]["served"] == 100
+    assert snap["t"]["shed"] == 1
+    assert snap["u"]["shed"] == 1
+    assert ts.wait_p95("nobody") is None
+
+
+def test_latency_estimator_learns_observed_rate():
+    est = LatencyEstimator()
+    default = est.predict_s(10)
+    for _ in range(8):
+        est.observe(10, 5.0)  # 0.5 s/key — 10x the default rate
+    assert est.predict_s(10) > default
+    assert est.queue_wait_s(20) > 0
+
+
+# ---------------------------------------------------------------------
+# OverloadShed payload: the structured RETRY-AFTER contract
+# ---------------------------------------------------------------------
+
+
+def test_overload_shed_payload_roundtrip():
+    e = OverloadShed("queue too deep", retry_after_s=2.5,
+                     tenant="alpha", estimate_s=9.0, deadline_s=3.0)
+    p = e.payload()
+    assert p["shed"] is True
+    assert p["retry-after-s"] == 2.5
+    assert p["tenant"] == "alpha"
+    back = OverloadShed.from_payload(p)
+    assert back.retry_after_s == 2.5
+    assert back.tenant == "alpha"
+    assert "queue too deep" in back.reason
+
+
+def test_overload_shed_retry_after_floor():
+    """A shed can never tell the client to retry immediately: garbage
+    or zero retry-after clamps to a positive floor."""
+    for bad in ({}, {"retry-after-s": 0}, {"retry-after-s": -4},
+                {"retry-after-s": "soon"}):
+        assert OverloadShed.from_payload(bad).retry_after_s >= 0.1
+
+
+def test_client_shed_exception_carries_retry_after():
+    from jepsen_tpu.checkerd.client import ShedByServer
+
+    e = ShedByServer({"shed": True, "reason": "saturated",
+                      "retry-after-s": 1.5, "tenant": "t"})
+    assert e.retry_after_s == 1.5
+    assert "saturated" in str(e)
+    # It subclasses RemoteUnavailable, so shed-unaware callers take
+    # the in-process fallback path instead of crashing.
+    from jepsen_tpu.checkerd.client import RemoteUnavailable
+
+    assert isinstance(e, RemoteUnavailable)
+
+
+# ---------------------------------------------------------------------
+# Brownout ladder
+# ---------------------------------------------------------------------
+
+
+def _ladder():
+    return BrownoutController(queue_high=10.0, rss_high_mb=None,
+                              up_count=2, down_count=3)
+
+
+def test_brownout_escalates_in_order():
+    b = _ladder()
+    assert b.level == 0 and b.dropped_passes() == ()
+    # Tier-1 pressure: 2 consecutive samples escalate one level only.
+    b.sample(15)
+    assert b.level == 0
+    b.sample(15)
+    assert b.level == 1
+    assert b.dropped_passes() == ("stream",)
+    # Tier-2 pressure escalates to 2 — stream first, then batched.
+    b.sample(25)
+    b.sample(25)
+    assert b.level == 2
+    assert b.dropped_passes() == ("stream", "batched")
+    assert b.shed_factor() == 2.0
+
+
+def test_brownout_deescalates_with_hysteresis():
+    b = _ladder()
+    for _ in range(4):
+        b.sample(25)
+    assert b.level == 2
+    # Recovery takes down_count consecutive calm samples per level.
+    for _ in range(2):
+        b.sample(0)
+    assert b.level == 2
+    b.sample(0)
+    assert b.level == 1
+    assert b.dropped_passes() == ("stream",)
+    for _ in range(3):
+        b.sample(0)
+    assert b.level == 0
+    assert b.shed_factor() == 1.0
+
+
+def test_brownout_force_env(monkeypatch, tmp_path):
+    b = _ladder()
+    monkeypatch.setenv(overload.FORCE_ENV, "2")
+    assert b.level == 2
+    # file: indirection — the self-chaos harness's live-daemon toggle.
+    p = tmp_path / "force"
+    p.write_text("1")
+    monkeypatch.setenv(overload.FORCE_ENV, f"file:{p}")
+    assert b.level == 1
+    p.unlink()
+    monkeypatch.delenv(overload.FORCE_ENV)
+    assert b.level == 0
+
+
+# ---------------------------------------------------------------------
+# Circuit breaker
+# ---------------------------------------------------------------------
+
+
+def test_breaker_open_halfopen_close():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=3, base_backoff_s=1.0,
+                        jitter=0.0, clock=lambda: now[0],
+                        rng=lambda: 0.5)
+    assert br.state == "closed" and br.allow()
+    br.record_failure()
+    br.record_failure()
+    assert br.allow()  # under threshold: still closed
+    br.record_failure()
+    assert br.state == "open"
+    assert not br.allow()
+    # Backoff expires -> half-open, exactly ONE probe allowed.
+    now[0] = 1.1
+    assert br.state == "half-open"
+    assert br.allow()
+    assert not br.allow(), "second caller raced the half-open probe"
+    br.record_success()
+    assert br.state == "closed"
+    assert br.allow()
+
+
+def test_breaker_halfopen_failure_doubles_backoff():
+    now = [0.0]
+    br = CircuitBreaker(failure_threshold=1, base_backoff_s=1.0,
+                        jitter=0.0, clock=lambda: now[0],
+                        rng=lambda: 0.5)
+    br.record_failure()          # open #1: backoff 1.0
+    now[0] = 1.1
+    assert br.allow()            # the probe
+    br.record_failure()          # open #2: backoff 2.0
+    now[0] = 2.1                 # 1.0 past re-open: still open
+    assert not br.allow()
+    now[0] = 3.2
+    assert br.allow()
+
+
+def test_breaker_registry_per_address():
+    overload.reset_breakers()
+    try:
+        a = overload.breaker_for("h:1")
+        assert overload.breaker_for("h:1") is a
+        assert overload.breaker_for("h:2") is not a
+    finally:
+        overload.reset_breakers()
+
+
+# ---------------------------------------------------------------------
+# End to end: deadline shed vs served parity through a real daemon
+# ---------------------------------------------------------------------
+
+
+def _ops(key, pairs):
+    ops = []
+    for v in range(pairs):
+        for f, typ, val in (("write", "invoke", v), ("write", "ok", v),
+                            ("read", "invoke", None), ("read", "ok", v)):
+            ops.append({"index": len(ops), "time": len(ops),
+                        "type": typ, "process": 0, "f": f, "value": val})
+    return ops
+
+
+def test_deadline_shed_vs_served_parity():
+    """An impossible deadline sheds BEFORE any ticket is minted (no ack
+    -> nothing to lose), with a structured retry-after; the identical
+    submission without a deadline is served to a valid verdict."""
+    from jepsen_tpu.checkerd.client import CheckerdClient, ShedByServer
+    from jepsen_tpu.checkerd.protocol import F_RESULT
+    from jepsen_tpu.checkerd.server import make_server
+
+    srv = make_server("127.0.0.1", 0, batch_window_s=0.01)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+    spec = {"type": "register", "value": None}
+    subs = [_ops(k, 3) for k in range(2)]
+    try:
+        with CheckerdClient(addr) as c:
+            with pytest.raises(ShedByServer) as ei:
+                c.submit_ops("shed-run", spec, subs, tenant="alpha",
+                             deadline_s=1e-6)
+            assert ei.value.retry_after_s > 0
+        with CheckerdClient(addr) as c:
+            ticket = c.submit_ops("served-run", spec, subs,
+                                  tenant="alpha", deadline_s=120.0)
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                ftype, payload = c.poll(ticket)
+                if ftype == F_RESULT:
+                    break
+                time.sleep(0.05)
+            assert ftype == F_RESULT
+            assert payload["valid"] is True
+            st = c.stats()
+        ov = st["overload"]
+        assert ov["shed"] >= 1
+        assert ov["tenants"]["alpha"]["shed"] >= 1
+        assert ov["tenants"]["alpha"]["served"] >= 1
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.scheduler.stop()
+        t.join(timeout=10)
+
+
+def test_parked_sessions_lru_evicted_with_honest_refusal(monkeypatch):
+    """Parked streaming sessions are bounded: pushing past the cap
+    LRU-evicts the oldest, and a RESUME for the victim is refused by
+    NAME (evicted), not mistaken for an unknown session."""
+    from jepsen_tpu.checkerd import server as server_mod
+    from jepsen_tpu.checkerd.client import CheckerdClient, RemoteUnavailable
+    from jepsen_tpu.checkerd.protocol import F_RESUME, F_RESUME_OK, F_SUBMIT
+    from jepsen_tpu.checkerd.server import make_server
+
+    monkeypatch.setattr(server_mod, "MAX_PARKED_SESSIONS", 3)
+    srv = make_server("127.0.0.1", 0, batch_window_s=0.01)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    addr = f"127.0.0.1:{srv.server_address[1]}"
+
+    def park(c, token):
+        c._send(F_SUBMIT, {
+            "run": f"r-{token}", "model": {"type": "register",
+                                           "value": None},
+            "algorithm": "wgl-tpu", "n-keys": 1, "packed": False,
+            "streaming": True, "session": token,
+        })
+        c.wf.flush()
+
+    try:
+        with CheckerdClient(addr) as c:
+            for i in range(5):
+                park(c, f"s{i}")
+            # The two oldest fell off the LRU; their RESUME is an
+            # honest by-name refusal...
+            with pytest.raises(RemoteUnavailable) as ei:
+                c._send(F_RESUME, {"session": "s0"})
+                c._recv()
+            assert "evicted" in str(ei.value)
+        # ...while a surviving session still resumes.
+        with CheckerdClient(addr) as c:
+            c._send(F_RESUME, {"session": "s4"})
+            ftype, payload = c._recv()
+            assert ftype == F_RESUME_OK
+        assert len(srv.sessions) <= 3
+        assert "s0" in srv.evicted_sessions
+    finally:
+        srv.shutdown()
+        srv.server_close()
+        srv.scheduler.stop()
+        t.join(timeout=10)

@@ -1,0 +1,297 @@
+"""Self-chaos harness units: schedules, invariants, fault injectors.
+
+The full fleet-under-fire run lives in tools/chaos_smoke.py (tier-1);
+these are the per-fault-family unit contracts that make that smoke
+debuggable when it fails:
+
+  * schedule compilation is deterministic and every fault heals inside
+    the run window;
+  * the invariant checker flags exactly the violation classes the
+    design names (lost verdict, replay divergence, dishonest shed,
+    fairness breach) and stays quiet on clean histories;
+  * the FlakyProxy forwards / partitions / slows on command;
+  * the file-indirected fault toggles (disk-full, brownout) write the
+    bytes the live daemons' env hooks read;
+  * verdict digests ignore replay-variant metadata and bind to the
+    observable verdict.
+"""
+
+import os
+import socket
+import threading
+
+import pytest
+
+from jepsen_tpu.nemesis import selfchaos as sc
+
+
+# ---------------------------------------------------------------------
+# Schedules
+# ---------------------------------------------------------------------
+
+
+def test_compile_schedule_deterministic():
+    a = sc.compile_schedule(42, n_daemons=3, duration_s=30, n_faults=8)
+    b = sc.compile_schedule(42, n_daemons=3, duration_s=30, n_faults=8)
+    assert a == b
+    assert a != sc.compile_schedule(43, n_daemons=3, duration_s=30,
+                                    n_faults=8)
+
+
+def test_compile_schedule_bounds():
+    s = sc.compile_schedule(7, n_daemons=2, duration_s=20, n_faults=12)
+    assert len(s.faults) == 12
+    for f in s.faults:
+        assert f.family in sc.FAMILIES
+        assert 0 < f.t < s.duration_s
+        # Every fault heals before the run window closes, so the
+        # post-run chase always sees a fully healed fleet.
+        assert f.t + f.duration_s < s.duration_s
+        if f.family == "router-kill":
+            assert f.target == -1
+        else:
+            assert f.target in (0, 1)
+    assert [f.t for f in s.faults] == sorted(f.t for f in s.faults)
+
+
+def test_schedule_roundtrips_to_dict():
+    s = sc.compile_schedule(3, n_daemons=1, duration_s=10, n_faults=2)
+    d = s.to_dict()
+    assert d["seed"] == 3
+    assert len(d["faults"]) == 2
+    assert all("family" in f and "t" in f for f in d["faults"])
+
+
+def test_inject_rejects_unknown_family(tmp_path):
+    fleet = sc.ChaosFleet(1, str(tmp_path))
+    try:
+        with pytest.raises(ValueError):
+            fleet.inject(sc.ChaosFault("meteor-strike", 1.0, 1.0, 0, 0))
+    finally:
+        fleet.stop()
+
+
+# ---------------------------------------------------------------------
+# Invariant checker: one test per violation class
+# ---------------------------------------------------------------------
+
+
+def _clean_history():
+    h = sc.ChaosHistory()
+    h.record("ack", tenant="a", ticket="t1")
+    h.record("verdict", tenant="a", ticket="t1", digest="d1", wait_s=0.2)
+    h.record("shed", tenant="b", retry_after_s=1.5, reason="saturated")
+    return h
+
+
+def test_invariants_clean_history_passes():
+    assert sc.check_invariants(_clean_history()) == []
+
+
+def test_invariant_lost_verdict():
+    h = _clean_history()
+    h.record("ack", tenant="a", ticket="t-lost")
+    v = sc.check_invariants(h)
+    assert len(v) == 1 and "lost-verdict" in v[0] and "t-lost" in v[0]
+
+
+def test_invariant_replay_divergence():
+    h = _clean_history()
+    h.record("verdict", tenant="a", ticket="t1", digest="DIFFERENT",
+             wait_s=None)
+    v = sc.check_invariants(h)
+    assert len(v) == 1 and "replay-divergence" in v[0]
+
+
+def test_invariant_dishonest_shed():
+    h = _clean_history()
+    h.record("shed", tenant="b", retry_after_s=0)
+    h.record("shed", tenant="b", retry_after_s=None)
+    v = sc.check_invariants(h)
+    assert len(v) == 2 and all("dishonest-shed" in x for x in v)
+
+
+def test_invariant_fairness_bound():
+    h = sc.ChaosHistory()
+    for i in range(40):
+        h.record("ack", tenant="lite", ticket=f"t{i}")
+        h.record("verdict", tenant="lite", ticket=f"t{i}",
+                 digest="d", wait_s=0.1 if i < 38 else 9.0)
+    # p95 over 40 waits: the two 9.0s land past the p95 cut -> clean.
+    assert sc.check_invariants(h, fairness_bound_s=1.0,
+                               light_tenant="lite") == []
+    # Shift the distribution and the bound fires.
+    for i in range(40, 80):
+        h.record("ack", tenant="lite", ticket=f"t{i}")
+        h.record("verdict", tenant="lite", ticket=f"t{i}",
+                 digest="d", wait_s=5.0)
+    v = sc.check_invariants(h, fairness_bound_s=1.0,
+                            light_tenant="lite")
+    assert len(v) == 1 and "unfair" in v[0]
+
+
+def test_verdict_digest_ignores_meta():
+    a = {"valid": True, "key-results": [{"valid": True}],
+         "meta": {"daemon": "127.0.0.1:1"}, "latency-s": 0.5}
+    b = {"valid": True, "key-results": [{"valid": True}],
+         "meta": {"daemon": "127.0.0.1:2"}, "latency-s": 9.9}
+    assert sc.verdict_digest(a) == sc.verdict_digest(b)
+    c = {"valid": False, "key-results": [{"valid": False}]}
+    assert sc.verdict_digest(a) != sc.verdict_digest(c)
+
+
+# ---------------------------------------------------------------------
+# FlakyProxy: partition and slow-peer without netns privileges
+# ---------------------------------------------------------------------
+
+
+def _echo_server():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def accept_loop():
+        while True:
+            try:
+                conn, _ = srv.accept()
+            except OSError:
+                return
+            def pump(c):
+                try:
+                    while True:
+                        data = c.recv(4096)
+                        if not data:
+                            return
+                        c.sendall(data)
+                except OSError:
+                    pass
+                finally:
+                    c.close()
+            threading.Thread(target=pump, args=(conn,),
+                             daemon=True).start()
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    return srv, port
+
+
+def test_proxy_forwards_then_partitions_then_heals():
+    srv, port = _echo_server()
+    px = sc.FlakyProxy(f"127.0.0.1:{port}")
+    try:
+        host, pport = px.addr.split(":")
+        with socket.create_connection((host, int(pport)),
+                                      timeout=5) as s:
+            s.sendall(b"ping")
+            assert s.recv(4) == b"ping"
+        px.set_mode("drop")
+        with socket.create_connection((host, int(pport)),
+                                      timeout=5) as s:
+            s.settimeout(5)
+            # The proxy either refuses outright (reset) or reads EOF.
+            try:
+                s.sendall(b"x")
+                assert s.recv(4) == b""
+            except OSError:
+                pass
+        px.set_mode("ok")
+        with socket.create_connection((host, int(pport)),
+                                      timeout=5) as s:
+            s.sendall(b"back")
+            assert s.recv(4) == b"back"
+    finally:
+        px.close()
+        srv.close()
+
+
+def test_proxy_slow_mode_delays():
+    import time
+
+    srv, port = _echo_server()
+    px = sc.FlakyProxy(f"127.0.0.1:{port}")
+    try:
+        host, pport = px.addr.split(":")
+        px.set_mode("slow", delay_s=0.2)
+        with socket.create_connection((host, int(pport)),
+                                      timeout=5) as s:
+            t0 = time.monotonic()
+            s.sendall(b"slow")
+            assert s.recv(4) == b"slow"
+            assert time.monotonic() - t0 >= 0.2
+    finally:
+        px.close()
+        srv.close()
+
+
+# ---------------------------------------------------------------------
+# File-indirected fault toggles (the live-daemon injection channel)
+# ---------------------------------------------------------------------
+
+
+def test_disk_full_toggle_matches_env_hook(tmp_path, monkeypatch):
+    from jepsen_tpu.checkerd import journal
+
+    fleet = sc.ChaosFleet(1, str(tmp_path))
+    try:
+        fleet.set_disk_full(0, True)
+        path = fleet._diskfull_path(0)
+        assert os.path.isfile(path)
+        # The journal's env hook resolves the same file: a live child
+        # daemon sees the fault with no env churn.
+        monkeypatch.setenv(journal.FAULT_ENV, f"file:{path}")
+        with pytest.raises(OSError):
+            journal._maybe_disk_fault()
+        fleet.set_disk_full(0, False)
+        assert not os.path.exists(path)
+        journal._maybe_disk_fault()  # healed: no raise
+    finally:
+        fleet.stop()
+
+
+def test_brownout_toggle_matches_env_hook(tmp_path, monkeypatch):
+    from jepsen_tpu.checkerd import overload
+
+    fleet = sc.ChaosFleet(1, str(tmp_path))
+    try:
+        fleet.set_brownout(0, 2)
+        path = fleet._brownout_path(0)
+        monkeypatch.setenv(overload.FORCE_ENV, f"file:{path}")
+        assert overload.BrownoutController().level == 2
+        fleet.set_brownout(0, 0)
+        assert overload.BrownoutController().level == 0
+    finally:
+        fleet.stop()
+
+
+def test_journal_tear_appends_garbage(tmp_path):
+    fleet = sc.ChaosFleet(1, str(tmp_path))
+    try:
+        qp = fleet._queue_path(0)
+        with open(qp, "wb") as f:
+            f.write(b"existing-bytes")
+        before = os.path.getsize(qp)
+        fleet.tear_journal(0)
+        assert os.path.getsize(qp) > before
+        with open(qp, "rb") as f:
+            assert f.read().startswith(b"existing-bytes")
+    finally:
+        fleet.stop()
+
+
+def test_fleet_injectors_are_noops_when_target_down(tmp_path):
+    """Kill/pause/heal against an already-dead target must not raise —
+    schedules overlap faults freely."""
+    fleet = sc.ChaosFleet(2, str(tmp_path))
+    try:
+        fleet.kill_daemon(0)
+        fleet.pause_daemon(0)
+        fleet.resume_daemon(0)
+        fleet.kill_router()
+        for f in sc.compile_schedule(1, n_daemons=2,
+                                     duration_s=10, n_faults=6,
+                                     families=("disk-full",
+                                               "brownout")).faults:
+            fleet.inject(f)
+            fleet.heal(f)
+    finally:
+        fleet.stop()

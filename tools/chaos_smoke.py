@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""Self-chaos smoke: Jepsen turned on its own checker fleet.
+
+The overload + self-chaos acceptance gate (tier-1): a real router +
+2-daemon fleet under 3-tenant load (one whale saturating its queue)
+takes a scripted fault sequence —
+
+  * SIGKILL the placed daemon mid-flight, tear its queue journal while
+    it is down, restart it on the torn journal;
+  * a saturation shed: a submission with an impossible deadline must be
+    refused BEFORE a ticket is minted, as a structured F_SHED with a
+    positive retry-after (never an error, never a hang);
+
+— and the run passes only if the fleet's own Jepsen history holds:
+
+  * zero lost verdicts: every acked ticket polls to a verdict;
+  * >= 1 honest shed recorded with a structured retry-after;
+  * replayed verdicts are byte-identical (digest match on re-poll);
+  * the whale cannot push the light tenant's queue-wait p95 over the
+    fairness bound;
+  * the daemon /metrics scrape exposes the checkerd.overload.* gauges
+    and the per-tenant shed/queue-wait families.
+
+Usage: python tools/chaos_smoke.py [--duration S] [--bound S]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.nemesis import selfchaos as sc  # noqa: E402
+
+WHALE, ALPHA, BETA = "whale", "alpha", "beta"
+
+
+def fail(msg: str) -> int:
+    print(f"FAIL: {msg}")
+    return 1
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--duration", type=float, default=16.0,
+                    help="load window seconds (default 16)")
+    ap.add_argument("--bound", type=float, default=30.0,
+                    help="light-tenant queue-wait p95 fairness bound "
+                         "seconds (default 30; CI CPUs are slow)")
+    args = ap.parse_args()
+
+    tmp = tempfile.mkdtemp(prefix="chaos-smoke-")
+    fleet = sc.ChaosFleet(2, tmp, metrics=True)
+    history = sc.ChaosHistory()
+    stop = threading.Event()
+    print(f"# fleet: router :{fleet.router_port}, daemons "
+          f"{fleet.daemon_ports}, workdir {tmp}")
+    try:
+        fleet.start()
+        loads = [
+            # The whale: big histories, no think time — the saturation
+            # source the fairness invariant measures against.
+            sc.TenantLoad(WHALE, fleet.router_addr, history, stop,
+                          seed=101, n_keys=6, pairs_per_key=24,
+                          think_s=0.0),
+            sc.TenantLoad(ALPHA, fleet.router_addr, history, stop,
+                          seed=102, n_keys=2, pairs_per_key=4,
+                          think_s=0.05),
+            sc.TenantLoad(BETA, fleet.router_addr, history, stop,
+                          seed=103, n_keys=2, pairs_per_key=4,
+                          think_s=0.05),
+        ]
+        for ld in loads:
+            ld.start()
+
+        # Let the fleet place work, then kill a placed daemon, tear its
+        # journal while it is down, and restart it on the torn tail.
+        time.sleep(args.duration * 0.3)
+        victim = 0
+        print(f"# chaos: SIGKILL daemon {victim} + journal tear")
+        history.record("inject", family="daemon-kill", target=victim)
+        fleet.kill_daemon(victim)
+        time.sleep(0.5)
+        history.record("inject", family="journal-tear", target=victim)
+        fleet.tear_journal(victim)
+        time.sleep(args.duration * 0.1)
+        history.record("heal", family="daemon-kill", target=victim)
+        fleet.restart_daemon(victim)
+
+        # Saturation shed: an impossible deadline must come back as a
+        # structured SHED before any ticket exists.
+        from jepsen_tpu.checkerd.client import (
+            CheckerdClient,
+            RemoteUnavailable,
+            ShedByServer,
+        )
+
+        ops = [[{"index": i, "time": i, "type": t, "process": 0,
+                 "f": f, "value": v}
+                for i, (t, f, v) in enumerate(
+                    [("invoke", "write", 1), ("ok", "write", 1)] * 40)]
+               for _ in range(4)]
+        shed_seen = False
+        spec = {"type": "register", "value": None}
+        for attempt in range(10):
+            try:
+                with CheckerdClient(fleet.router_addr,
+                                    io_timeout=30.0) as c:
+                    c.submit_ops(f"impossible-{attempt}", spec, ops,
+                                 tenant=ALPHA, deadline_s=1e-6)
+            except ShedByServer as e:
+                history.record("shed", tenant=ALPHA,
+                               retry_after_s=e.retry_after_s,
+                               reason=e.shed.reason)
+                print(f"# shed observed: {e.shed.reason!r} "
+                      f"retry-after {e.retry_after_s:.2f}s")
+                shed_seen = True
+                break
+            except RemoteUnavailable:
+                time.sleep(0.5)
+        if not shed_seen:
+            return fail("no structured shed for an impossible deadline")
+
+        time.sleep(args.duration * 0.6)
+        stop.set()
+        for ld in loads:
+            ld.join(timeout=60)
+        stop.clear()
+
+        print(f"# load done: {sum(ld.submitted for ld in loads)} "
+              f"submissions, chasing outstanding tickets")
+        sc.chase_outstanding(history, fleet.router_addr, timeout_s=60)
+        divergent = sc.replay_check(history, fleet.router_addr, n=5)
+        if divergent:
+            return fail(f"replay digests diverged: {divergent}")
+
+        # The daemon /metrics surface: overload gauges + per-tenant
+        # families must scrape from the restarted daemon.
+        url = f"http://127.0.0.1:{fleet.metrics_ports[0]}/metrics"
+        body = urllib.request.urlopen(url, timeout=10).read().decode()
+        for family in ("jepsen_checkerd_overload_brownout_level",
+                       "jepsen_checkerd_overload_shed_total",
+                       "jepsen_checkerd_queue_depth"):
+            if family not in body:
+                return fail(f"{family} missing from {url}")
+        print("# /metrics: checkerd.overload.* gauges present")
+    finally:
+        stop.set()
+        fleet.stop()
+
+    violations = sc.check_invariants(
+        history, fairness_bound_s=args.bound, light_tenant=ALPHA,
+    )
+    if violations:
+        for v in violations:
+            print(f"  violation: {v}")
+        return fail(f"{len(violations)} fleet invariant violation(s)")
+
+    st = history.stats()
+    acked = st["kinds"].get("ack", 0)
+    verdicts = st["kinds"].get("verdict", 0)
+    sheds = st["kinds"].get("shed", 0)
+    if not acked:
+        return fail("no tickets were ever acked — load never ran")
+    waits = [op["wait_s"] for op in history.ops("verdict")
+             if op.get("tenant") == ALPHA
+             and isinstance(op.get("wait_s"), (int, float))]
+    p95 = sorted(waits)[max(0, int(len(waits) * 0.95) - 1)] \
+        if waits else None
+    print(f"PASS: {acked} acked -> {verdicts} verdicts (0 lost), "
+          f"{sheds} honest shed(s), replays byte-identical, "
+          f"light-tenant p95 {p95}s <= {args.bound}s")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -43,6 +43,18 @@ MEASURED_FEATURES = frozenset((
     "proven", "settled", "merged", "passes", "restarts",
 ))
 
+#: A bucket is noise-dominated when the model-vs-heuristic median gap
+#: is within this many within-config MADs — the measured "win" or
+#: "loss" is then a timing coin-flip, not a knob effect, and the
+#: --require-win gate downgrades to advisory for it.
+NOISE_FACTOR = 2.0
+
+
+def _mad_s(samples: list[float]) -> float:
+    """Median absolute deviation — the within-config timing spread."""
+    m = statistics.median(samples)
+    return statistics.median(abs(x - m) for x in samples)
+
 
 def shape_key(rec: dict) -> str:
     feats = {
@@ -156,6 +168,11 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
         else:
             verdict = "loss"
             losses += 1
+        # Noise dominance: the verdict only means something when the
+        # median gap clears the within-config timing spread.
+        noise_s = max(_mad_s(by_cfg[picked]), _mad_s(by_cfg[heur_k]))
+        noisy = (len(by_cfg[picked]) < 2 or len(by_cfg[heur_k]) < 2
+                 or abs(picked_s - heur_s) <= NOISE_FACTOR * noise_s)
         rows.append({
             "pass": pass_name,
             "features": features,
@@ -165,6 +182,8 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
             "heuristic-config": heur,
             "heuristic-median-s": round(heur_s, 6),
             "verdict": verdict,
+            "noise-s": round(noise_s, 6),
+            "noise-dominated": noisy,
             "median-flops": med_roof.get("flops"),
             "median-bytes-accessed": med_roof.get("bytes_accessed"),
             "median-flops-ratio": med_roof.get("flops_ratio"),
@@ -175,6 +194,11 @@ def evaluate(model: costmodel.CostModel, records: list[dict]) -> dict:
         "wins": wins,
         "losses": losses,
         "ties": ties,
+        "clean-wins": sum(1 for r in rows
+                          if r["verdict"] == "win"
+                          and not r["noise-dominated"]),
+        "noise-dominated": sum(1 for r in rows
+                               if r["noise-dominated"]),
         "rows": rows,
     }
 
@@ -238,9 +262,28 @@ def main() -> int:
                   f"{r['heuristic-median-s'] * 1000:.1f}ms")
     print(f"# {report['comparable']} comparable buckets: "
           f"{report['wins']} win(s), {report['ties']} tie(s), "
-          f"{report['losses']} loss(es)")
+          f"{report['losses']} loss(es); "
+          f"{report['noise-dominated']} noise-dominated")
     if args.require_win and report["wins"] < 1:
-        print("# FAIL: model beats the heuristics on no recorded bucket")
+        # When every comparable bucket's verdict is inside the timing
+        # noise floor, a zero-win run is a coin-flip, not a regression
+        # (PR-16 known flake): downgrade to advisory with an
+        # annotation instead of failing the gate.  A zero-win run with
+        # at least one CLEAN (signal-dominated) bucket still fails —
+        # there the model genuinely lost.
+        clean = [r for r in report["rows"] if not r["noise-dominated"]]
+        if report["comparable"] and not clean:
+            msg = (f"costmodel --require-win: 0 wins, but all "
+                   f"{report['comparable']} comparable bucket(s) are "
+                   f"noise-dominated (median gap within "
+                   f"{NOISE_FACTOR}x the within-config MAD); "
+                   f"win-requirement downgraded to advisory")
+            print(f"# ADVISORY: {msg}")
+            # GitHub Actions annotation; inert noise elsewhere.
+            print(f"::warning title=costmodel advisory::{msg}")
+            return 0
+        print("# FAIL: model beats the heuristics on no recorded "
+              "bucket and at least one bucket is signal-dominated")
         return 1
     return 0
 

@@ -23,6 +23,7 @@ Usage: python tools/profile_seed.py OUT_DIR [keys] [pairs-per-key]
 """
 
 import os
+import random
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -79,8 +80,16 @@ def sweep_stream_knobs(repeats: int = 3) -> int:
         packs.append(pack_history(h, pm.encode))
     n = 0
     restarts = max(8, len(packs) // 2)  # the heuristic cap: only the
-    for _ in range(repeats):            # segment knob varies
-        for seg in (2, 3, 4, 6, 8, 16):
+    # Seeded shuffle per repeat: a monotone machine drift (thermal,
+    # page-cache warm-up) hits every knob config equally in expectation
+    # instead of systematically inflating whichever knob always ran
+    # first — the costmodel_train --require-win gate compares measured
+    # medians across these configs, so ordering bias reads as signal.
+    rng = random.Random(0x5EED)         # segment knob varies
+    order = [2, 3, 4, 6, 8, 16]
+    for _ in range(repeats):
+        rng.shuffle(order)
+        for seg in order:
             check_wgl_witness_stream(
                 packs, pm, segment_keys=seg, max_restarts=restarts,
             )
