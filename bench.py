@@ -395,27 +395,52 @@ def _roofline_probe(pm) -> "Optional[dict]":
             os.path.dirname(prev_store) if prev_store else None)
 
 
-def _measure_ingest(pm) -> "Optional[int]":
+def _measure_ingest(pm) -> "Optional[dict]":
     """Measured ingest throughput: ops/s through the PackedBuilder
     append -> snapshot -> finish path (the streaming checker's ingest
     primitive), over a pre-built op list so op generation stays out of
-    the measurement."""
+    the measurement.  Measures both the scalar per-op path and the
+    columnar append_many fast path (the batch size matches the remote
+    feed's FLUSH_OPS frame) and reports the gain."""
     from jepsen_tpu.history.packed import PackedBuilder
+    from jepsen_tpu.streaming.remote import FLUSH_OPS
     from jepsen_tpu.utils.histgen import random_register_history
 
     ops = list(random_register_history(
         200_000, procs=int(knob("JEPSEN_BENCH_PROCS")),
         info_rate=float(knob("JEPSEN_BENCH_INFO")), seed=13,
     ))
-    b = PackedBuilder(pm.encode)
-    t0 = time.monotonic()
-    for i, o in enumerate(ops):
-        b.append(o)
-        if (i + 1) % 50_000 == 0:
-            b.snapshot()
-    b.finish()
-    dt = time.monotonic() - t0
-    return round(len(ops) / dt) if dt > 0 else None
+
+    def scalar() -> float:
+        b = PackedBuilder(pm.encode)
+        t0 = time.monotonic()
+        for i, o in enumerate(ops):
+            b.append(o)
+            if (i + 1) % 50_000 == 0:
+                b.snapshot()
+        b.finish()
+        return time.monotonic() - t0
+
+    def batched() -> float:
+        b = PackedBuilder(pm.encode)
+        t0 = time.monotonic()
+        for lo in range(0, len(ops), FLUSH_OPS):
+            b.append_many(ops[lo:lo + FLUSH_OPS])
+            if (lo // FLUSH_OPS) % (50_000 // FLUSH_OPS) == \
+                    (50_000 // FLUSH_OPS) - 1:
+                b.snapshot()
+        b.finish()
+        return time.monotonic() - t0
+
+    t_scalar = min(scalar(), scalar())
+    t_batch = min(batched(), batched())
+    if t_scalar <= 0 or t_batch <= 0:
+        return None
+    return {
+        "ops_per_s": round(len(ops) / t_batch),
+        "scalar_ops_per_s": round(len(ops) / t_scalar),
+        "batch_gain": round(t_scalar / t_batch, 3),
+    }
 
 
 def run_scale() -> int:
@@ -543,8 +568,12 @@ def run_scale() -> int:
         except Exception:  # noqa: BLE001
             rec["roofline"] = None
         try:
-            ing = _measure_ingest(pm)
+            ing_rec = _measure_ingest(pm)
+            ing = ing_rec["ops_per_s"] if ing_rec else None
             rec["ingest_ops_per_s"] = ing
+            if ing_rec:
+                rec["ingest_scalar_ops_per_s"] = ing_rec["scalar_ops_per_s"]
+                rec["ingest_batch_gain"] = ing_rec["batch_gain"]
             if res.valid is True and ing:
                 # The share of end-to-end verdict lag the ingest path
                 # would claim at this point's scale (ROADMAP item 5's
@@ -1101,6 +1130,22 @@ def _with_mixed_point(out: str, env: dict, t_start: float,
     return "\n".join(lines) + "\n"
 
 
+def _cpu_dispatch_flags(env2: dict, main_rec: dict) -> None:
+    """CPU scale children run XLA's legacy (non-thunk) CPU runtime:
+    the witness engine's chain rounds are ~100 small ops each, and on
+    a 1-core host the thunk runtime's per-op dispatch roughly doubles
+    end-to-end time (measured 154k -> 291k ops/s on the 4M-op scale
+    shape).  TPU children never see the flag, and an ambient
+    xla_cpu_use_thunk_runtime setting wins over this default."""
+    if main_rec.get("platform") == "tpu":
+        return
+    flags = env2.get("XLA_FLAGS", "")
+    if "xla_cpu_use_thunk_runtime" not in flags:
+        env2["XLA_FLAGS"] = (
+            flags + " --xla_cpu_use_thunk_runtime=false"
+        ).strip()
+
+
 def _with_scale_point(out: str, env: dict, t_start: float,
                       wall_cap: float) -> str:
     """Runs the scale-point child inside what's left of the wall cap
@@ -1128,6 +1173,7 @@ def _with_scale_point(out: str, env: dict, t_start: float,
                 min(300.0, max(60.0, wall_left - 60.0))
             ),
         )
+        _cpu_dispatch_flags(env2, main_rec)
         # A chip that failed the pre-flight probe gets one more
         # recovery rung before the scale point: the primary metric just
         # spent minutes on CPU — plenty of settle time for a transient
@@ -1206,6 +1252,7 @@ def _with_scale_online_point(out: str, env: dict, t_start: float,
                 min(180.0, max(40.0, wall_left - 50.0))
             ),
         )
+        _cpu_dispatch_flags(env2, main_rec)
         try:
             proc = subprocess.run(
                 [sys.executable, os.path.abspath(__file__)],

@@ -91,6 +91,9 @@ RULES = {
 #: generated from it plus the live scan.
 DECLARED_NAMESPACES = {
     "wgl": "device checker passes (ops/, streaming/, parallel/)",
+    "wgl.packed": "bit-packed uint32-lane kernel variants: block "
+                  "counts, lane-word gauges, shed-packing fallbacks "
+                  "(ops/packing.py, ops/wgl*.py)",
     "wgl.plan": "checking-plan compiler/executor/cache (plan/)",
     "wgl.roofline": "achieved-vs-peak roofline gauges "
                     "(telemetry/roofline.py)",

@@ -98,6 +98,62 @@ def _register_packed(model: Register, allow_cas: bool) -> PackedModel:
             )
         raise ValueError(f"register model can't encode op f {f!r}")
 
+    def encode_many(items):
+        # Columnar-ingest hook (PackedBuilder.append_many): encode() over
+        # a [(inv, comp)] batch with the interner inlined — one loop,
+        # no per-op intern_value/intern call frames.  MUST stay
+        # semantically in lockstep with encode(): same interner dicts,
+        # same drops, same codes, so the packed bytes are identical.
+        ids = interner._ids
+        vals = interner.values
+        out = []
+        add = out.append
+        for inv, comp in items:
+            f = inv.f
+            if f == "read":
+                if comp is None or comp.type != OK:
+                    add(None)
+                    continue
+                v = comp.value
+                if v is None:
+                    add(None)
+                    continue
+            elif f == "write":
+                v = inv.value
+            elif f == "cas" and allow_cas:
+                old, new = inv.value
+                if isinstance(old, list):
+                    old = tuple(old)
+                if isinstance(new, list):
+                    new = tuple(new)
+                i0 = ids.get(old)
+                if i0 is None:
+                    i0 = len(vals)
+                    ids[old] = i0
+                    vals.append(old)
+                i1 = ids.get(new)
+                if i1 is None:
+                    i1 = len(vals)
+                    ids[new] = i1
+                    vals.append(new)
+                add((F_CAS, i0, i1))
+                continue
+            else:
+                raise ValueError(
+                    f"register model can't encode op f {f!r}"
+                )
+            if isinstance(v, list):
+                v = tuple(v)
+            i = ids.get(v)
+            if i is None:
+                i = len(vals)
+                ids[v] = i
+                vals.append(v)
+            add((F_READ if f == "read" else F_WRITE, i, NIL))
+        return out
+
+    encode.many = encode_many
+
     def py_step(state, f, a0, a1):
         s = state[0]
         if f == F_READ:

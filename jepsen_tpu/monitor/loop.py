@@ -394,14 +394,20 @@ def run_monitor(cfg: MonitorConfig,
                 break
             if cfg.max_ops is not None and completed >= cfg.max_ops:
                 break
+            # Drain the whole burst per key through the columnar ingest
+            # (PackedBuilder.append_many) instead of per-op feeds.
+            by_key: dict = {}
             for _ in range(burst):
                 key, op = source.next_event()
-                checker.feed(key, op, time.monotonic())
+                by_key.setdefault(key, []).append(op)
                 if tee is not None:
                     tee.feed(key, op)
                 events += 1
                 if op.type != "invoke":
                     completed += 1
+            t_feed = time.monotonic()
+            for key, kops in by_key.items():
+                checker.feed_many(key, kops, t_feed)
             # Pace: one completed op ~= two events.
             target = t0 + events / (2.0 * cfg.rate)
             now = time.monotonic()

@@ -183,6 +183,17 @@ class RollingChecker:
         if ks.builder.n_rows - ks.rows_at_advance >= self.advance_rows:
             self._advance(key, ks, now)
 
+    def feed_many(self, key: Hashable, ops: list,
+                  now: Optional[float] = None) -> None:
+        """feed() for a per-key burst: one columnar append, then at
+        most one advance (advance resets the cadence watermark, so a
+        burst crossing the threshold multiple times still advances
+        once — same as the last scalar feed of the burst would)."""
+        ks = self._state(key)
+        ks.builder.append_many(ops)
+        if ks.builder.n_rows - ks.rows_at_advance >= self.advance_rows:
+            self._advance(key, ks, now)
+
     def pump(self, now: Optional[float] = None) -> None:
         """Advances every key regardless of cadence (idle-stream
         flush)."""

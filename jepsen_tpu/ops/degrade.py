@@ -200,10 +200,11 @@ def probe_chip(timeout_s: float = 90.0) -> str:
     are cleanly separable.
 
     Every probe leaves a structured trace in `_last_probe` (timing,
-    returncode, trimmed output); a "wedged" result additionally writes
-    the forensics dossier (`write_chip_dossier`) when
-    JEPSEN_CHIP_DOSSIER_DIR points somewhere — machine-readable
-    evidence for the still-open wedged-TPU investigation."""
+    returncode, trimmed output); a "wedged" or "absent" result
+    additionally writes the forensics dossier (`write_chip_dossier`)
+    when JEPSEN_CHIP_DOSSIER_DIR points somewhere — machine-readable
+    evidence for the still-open wedged-TPU investigation, and for the
+    terminal plugin-gone state that succeeded it."""
     import subprocess
     import sys
 
@@ -235,11 +236,14 @@ def probe_chip(timeout_s: float = 90.0) -> str:
     if proc.returncode != 0:
         _note_probe("absent", trace)
         _set_chip_state("absent")
+        _maybe_write_dossier()
         return "absent"
     platform = proc.stdout.decode(errors="replace").strip()
     state = "ok" if platform == "tpu" else "absent"
     _note_probe(state, trace)
     _set_chip_state(state)
+    if state == "absent":
+        _maybe_write_dossier()
     return state
 
 

@@ -1,0 +1,142 @@
+"""uint32 lane packing for WGL member/child bitsets.
+
+A member set over a window of ``W`` slots is carried as
+``ceil(W / 32)`` uint32 words instead of ``W`` bools.  Bit ``w`` of a
+set lives at word ``w // 32``, lane ``w % 32`` (LSB-first).  All step
+semantics the engines need reduce to popcount/AND/OR/shift on the
+words; padding lanes (``w >= W``) are always zero so full-coverage
+tests can OR them away with the complement of the packed ok-mask.
+
+Hash accumulation over packed words is done with wrapping uint32
+multiply-adds against fixed odd constants — deterministic across
+devices, and exact dedup still compares the words themselves, so the
+hashes only have to order duplicates next to each other.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+LANES = 32
+FULL_WORD = np.uint32(0xFFFFFFFF)
+
+# Fixed odd multipliers (splitmix-style) for word/state hash lanes.
+# Independent hash streams; dedup correctness never depends on them
+# (exact word compare backs the hash), only sort clustering does.
+_HASH_SEEDS = (
+    np.uint32(0x9E3779B1), np.uint32(0x85EBCA77),
+    np.uint32(0xC2B2AE3D), np.uint32(0x27D4EB2F),
+)
+
+
+def n_words(W: int) -> int:
+    """Words needed for a W-slot window."""
+    return max(1, -(-int(W) // LANES))
+
+
+def word_lane_tables(W: int) -> tuple[np.ndarray, np.ndarray]:
+    """(word_idx[W] int32, lane_bit[W] uint32) lookup tables."""
+    idx = np.arange(W, dtype=np.int32)
+    lane = np.arange(W, dtype=np.uint32) % np.uint32(LANES)
+    return idx // LANES, np.uint32(1) << lane
+
+
+def hash_consts(Wp: int, stream: int = 0) -> np.ndarray:
+    """Per-word odd uint32 multipliers for hash stream 0 or 1."""
+    seed = _HASH_SEEDS[stream % len(_HASH_SEEDS)]
+    k = np.arange(1, Wp + 1, dtype=np.uint32)
+    # All-uint32 arithmetic: wraps in-type, no narrowing cast needed.
+    return k * seed * np.uint32(2) + np.uint32(1)
+
+
+def as_u32(x: jnp.ndarray) -> jnp.ndarray:
+    """Relabels 32-bit integer lanes as uint32 for wrapping hash
+    arithmetic.  Same-width reinterpretation only — the trace-time
+    assert keeps a 64-bit value from ever narrowing here."""
+    assert x.dtype in (jnp.int32, jnp.uint32), (
+        f"as_u32: expected an int32/uint32 lane dtype, got {x.dtype}"
+    )
+    return x.astype(jnp.uint32)
+
+
+def pack_bits(x: jnp.ndarray, Wp: int | None = None) -> jnp.ndarray:
+    """bool (..., W) -> uint32 (..., ceil(W/32)), LSB-first lanes."""
+    W = x.shape[-1]
+    wp = Wp if Wp is not None else n_words(W)
+    pad = wp * LANES - W
+    if pad:
+        x = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xr = x.reshape(x.shape[:-1] + (wp, LANES))
+    lanebits = jnp.uint32(1) << jnp.arange(LANES, dtype=jnp.uint32)
+    return jnp.where(xr, lanebits, jnp.uint32(0)).sum(
+        axis=-1, dtype=jnp.uint32)
+
+
+def unpack_bits(words: jnp.ndarray, W: int) -> jnp.ndarray:
+    """uint32 (..., Wp) -> bool (..., W)."""
+    lanes = jnp.arange(LANES, dtype=jnp.uint32)
+    bits = (words[..., :, None] >> lanes) & jnp.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (words.shape[-1] * LANES,))
+    return flat[..., :W].astype(bool)
+
+
+def popcount(words: jnp.ndarray) -> jnp.ndarray:
+    """Per-element popcount summed over the last (word) axis -> int32."""
+    return jax.lax.population_count(words).sum(axis=-1, dtype=jnp.int32)
+
+
+def set_bit(words: jnp.ndarray, slot: jnp.ndarray) -> jnp.ndarray:
+    """OR bit ``slot`` into each row of uint32 (..., Wp) words.
+
+    ``slot`` broadcasts against the leading axes of ``words``.
+    """
+    wp = words.shape[-1]
+    slot = jnp.asarray(slot)
+    # Same-width relabels below (slot is already a 32-bit window index
+    # by contract): assert at trace time so no int32 narrowing can
+    # slip in through a 64-bit slot.
+    assert slot.dtype in (jnp.int32, jnp.uint32), (
+        f"set_bit: slot must be an int32/uint32 index, got {slot.dtype}"
+    )
+    widx = (slot // LANES).astype(jnp.int32)
+    bit = jnp.uint32(1) << (slot % LANES).astype(jnp.uint32)
+    cols = jnp.arange(wp, dtype=jnp.int32)
+    hot = jnp.where(cols == widx[..., None], bit[..., None], jnp.uint32(0))
+    return words | hot
+
+
+def covers(child_words: jnp.ndarray, ok_words: jnp.ndarray) -> jnp.ndarray:
+    """True where a packed child set covers every ok bit.
+
+    Padding lanes of ``ok_words`` are zero, so their complement is all
+    ones and they never block coverage.
+    """
+    return ((child_words | ~ok_words) == FULL_WORD).all(axis=-1)
+
+
+def hash_words(words: jnp.ndarray, consts: jnp.ndarray) -> jnp.ndarray:
+    """Wrapping uint32 multiply-add over the last axis."""
+    return (words * consts).sum(axis=-1, dtype=jnp.uint32)
+
+
+# -- host-side (numpy) mirrors, for re-gather / snapshots -------------------
+
+def np_pack_bits(x: np.ndarray, Wp: int | None = None) -> np.ndarray:
+    W = x.shape[-1]
+    wp = Wp if Wp is not None else n_words(W)
+    pad = wp * LANES - W
+    if pad:
+        x = np.pad(x, [(0, 0)] * (x.ndim - 1) + [(0, pad)])
+    xr = x.reshape(x.shape[:-1] + (wp, LANES))
+    lanebits = np.uint32(1) << np.arange(LANES, dtype=np.uint32)
+    return np.where(xr, lanebits, np.uint32(0)).sum(
+        axis=-1, dtype=np.uint32)
+
+
+def np_unpack_bits(words: np.ndarray, W: int) -> np.ndarray:
+    lanes = np.arange(LANES, dtype=np.uint32)
+    bits = (words[..., :, None] >> lanes) & np.uint32(1)
+    flat = bits.reshape(words.shape[:-1] + (words.shape[-1] * LANES,))
+    return flat[..., :W].astype(bool)

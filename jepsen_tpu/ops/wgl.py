@@ -44,11 +44,25 @@ from ..telemetry import profile, roofline
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
-from . import degrade
+from . import degrade, packing
 
 INF = np.int32(2**31 - 1)
 
+#: JEPSEN_WGL_PACKED=0 disables the uint32 bit-packed member lanes and
+#: falls back to the wide bool (B, W) tensors everywhere.
+PACKED_ENV = "JEPSEN_WGL_PACKED"
+
 _block_fn_cache: dict[tuple, Any] = {}
+
+
+def packed_enabled(packed_lanes: Optional[bool] = None) -> bool:
+    """Resolve the packed-lane switch: explicit arg wins, then the
+    JEPSEN_WGL_PACKED env (default on)."""
+    import os
+
+    if packed_lanes is not None:
+        return bool(packed_lanes)
+    return os.environ.get(PACKED_ENV, "1") not in ("0", "false", "off")
 
 
 def _hash_vectors(w: int, sw: int, seed: int = 0x5EED) -> tuple[np.ndarray, ...]:
@@ -128,6 +142,74 @@ def _expand_level(member, states, alive, tables, n_rows, n_slots,
     return child, new_states, live_c, h1, h2, accepted_any, overflow
 
 
+def _expand_level_packed(member_w, states, alive, tables, n_rows,
+                         n_slots, jax_step):
+    """Bit-packed twin of _expand_level: the frontier member sets ride
+    as uint32 lanes (W bools -> ceil(W/32) words), children are built
+    with word-OR + one hot bit, acceptance is a packed cover test, and
+    the dedup hashes are wrapping uint32 multiply-adds over the words.
+    The candidate rule still needs per-slot ints, so the member bits
+    are unpacked once per level — everything carried between levels
+    (and gathered over ICI in the sharded path) stays packed."""
+    import jax
+    import jax.numpy as jnp
+
+    (ret_w, inv_w, f_w, a0_w, a1_w, ok_words, fmin1, f_has_ok,
+     hw1, hw2, shw1, shw2) = tables
+    W = ret_w.shape[0]
+    member = packing.unpack_bits(member_w, W)
+
+    # --- candidate rule (identical to the wide engine) ---------------
+    nm_ret = jnp.where(member | ~alive[:, None], INF, ret_w[None, :])
+    m1w = nm_ret.min(axis=1)
+    am1 = jnp.argmin(nm_ret, axis=1)
+    nm_ret2 = nm_ret.at[jnp.arange(n_rows), am1].set(INF)
+    m2w = nm_ret2.min(axis=1)
+    is_w_min = m1w <= fmin1
+    total_m1 = jnp.minimum(m1w, fmin1)
+    second_for_argmin = jnp.minimum(m2w, fmin1)
+    bound = jnp.where(
+        (jnp.arange(W)[None, :] == am1[:, None]) & is_w_min[:, None],
+        second_for_argmin[:, None],
+        total_m1[:, None],
+    )
+    order_ok = (~member) & alive[:, None] & (inv_w[None, :] < bound)
+
+    flat = order_ok.reshape(-1)
+    count = flat.sum()
+    cand_idx = jnp.nonzero(flat, size=n_slots, fill_value=0)[0]
+    valid_c = jnp.arange(n_slots) < count
+    overflow = count > n_slots
+    parent = cand_idx // W
+    a = cand_idx % W
+
+    new_states, legal = jax.vmap(jax_step)(
+        states[parent], f_w[a], a0_w[a], a1_w[a]
+    )
+    live_c = valid_c & legal
+
+    child_w = packing.set_bit(member_w[parent], a)
+
+    # --- acceptance: packed cover over the ok-mask words -------------
+    cover = packing.covers(child_w, ok_words)
+    accepted_any = jnp.any(live_c & cover & ~f_has_ok)
+
+    # --- dedup hashes: uint32 wrap-sum over words + states -----------
+    su = packing.as_u32(new_states)
+    dead = jnp.uint32(0xFFFFFFFF)
+    h1 = jnp.where(
+        live_c,
+        packing.hash_words(child_w, hw1) + packing.hash_words(su, shw1),
+        dead,
+    )
+    h2 = jnp.where(
+        live_c,
+        packing.hash_words(child_w, hw2) + packing.hash_words(su, shw2),
+        dead,
+    )
+    return child_w, new_states, live_c, h1, h2, accepted_any, overflow
+
+
 def _dedup_sort(child, new_states, live_c, h1, h2, n_slots):
     """Hash-sort + exact adjacent compare over candidates: equal
     configs always hash equal, so dedup is exact; collisions only cost
@@ -152,18 +234,22 @@ def _dedup_sort(child, new_states, live_c, h1, h2, n_slots):
     return child_s, states_s, uniq, uniq.sum()
 
 
-def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step):
+def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step,
+                   packed: bool = False):
     """Builds the jitted block runner for static shapes (B, W, SW, Cmax).
 
-    Carry: member (B, W) bool, states (B, SW) i32, alive (B,) bool,
-    accepted, incomplete (bool), explored (i32), it (i32).
+    Carry: member (B, W) bool — or (B, ceil(W/32)) uint32 when
+    `packed` — states (B, SW) i32, alive (B,) bool, accepted,
+    incomplete (bool), explored (i32), it (i32).
     """
     import jax
     import jax.numpy as jnp
 
+    expand = _expand_level_packed if packed else _expand_level
+
     def level_step(carry, tables):
         member, states, alive, accepted, incomplete, explored, it = carry
-        child, new_states, live_c, h1, h2, acc, overflow = _expand_level(
+        child, new_states, live_c, h1, h2, acc, overflow = expand(
             member, states, alive, tables, B, Cmax, jax_step
         )
         accepted = accepted | acc
@@ -212,7 +298,7 @@ def _make_block_fn(B: int, W: int, SW: int, Cmax: int, jax_step):
 
 
 def _make_block_fn_sharded(B: int, W: int, SW: int, Cmax: int, jax_step,
-                           mesh):
+                           mesh, packed: bool = False):
     """Frontier-sharded variant of _make_block_fn: ONE search's beam
     splits across the mesh (the within-search axis SURVEY.md §5 frames
     as the ring-attention analog — parallelism over the configuration
@@ -244,14 +330,17 @@ def _make_block_fn_sharded(B: int, W: int, SW: int, Cmax: int, jax_step,
     assert B % n == 0 and Cmax % n == 0, (B, Cmax, n)
     B_l = B // n
     C_l = Cmax // n
+    expand = _expand_level_packed if packed else _expand_level
 
     def level_step(carry, tables):
         (member, states, alive, accepted, incomplete, explored, it,
          n_alive) = carry
 
         # --- expansion on the LOCAL frontier rows -----------------------
+        # With packed lanes the all_gather below moves uint32 words —
+        # 8x fewer ICI bytes per candidate bitset than the bool rows.
         child, new_states, live_c, h1, h2, acc_local, local_overflow = (
-            _expand_level(
+            expand(
                 member, states, alive, tables, B_l, C_l, jax_step
             )
         )
@@ -390,6 +479,7 @@ def check_wgl_device(
     width_hint: int = 0,
     mesh: Any = None,
     checkpoint_dir: Optional[str] = None,
+    packed_lanes: Optional[bool] = None,
 ) -> WGLResult:
     """Decides linearizability of one packed history on the default JAX
     device.
@@ -494,8 +584,9 @@ def check_wgl_device(
         SW = pm.state_width
         n0 = 0
         B = _bucket(beam, lo=256)
+        packed_on = packed_enabled(packed_lanes)
         prev_active: Optional[np.ndarray] = None
-        member = None  # device (B, W) bool
+        member = None  # device (B, W) bool, or (B, ceil(W/32)) u32 packed
         states = None  # device (B, SW) i32
         alive = None   # device (B,) bool
         explored_total = 0
@@ -513,10 +604,14 @@ def check_wgl_device(
                 )
             active, W, tables = win
             h1v, h2v, sh1v, sh2v = _hash_vectors(W, SW)
+            Wp = packing.n_words(W)
 
             # Re-gather frontier bits from the previous window layout.
             if prev_active is None:
-                base_member = np.zeros((B, W), dtype=bool)
+                if packed_on:
+                    base_member = np.zeros((B, Wp), dtype=np.uint32)
+                else:
+                    base_member = np.zeros((B, W), dtype=bool)
                 base_states = np.tile(
                     np.asarray(pm.init_state, dtype=np.int32), (B, 1)
                 )
@@ -530,11 +625,17 @@ def check_wgl_device(
                 # distinct (old, new) window shape pair and dominate runtime.
                 perm, present = window_regather(prev_active, active)
                 member_np = np.asarray(member)
+                if packed_on:
+                    member_np = packing.np_unpack_bits(
+                        member_np, member_np.shape[1] * packing.LANES
+                    )
                 Bcur = member_np.shape[0]
                 new_member = np.zeros((Bcur, W), dtype=bool)
                 new_member[:, : len(active)] = np.where(
                     present[None, :], member_np[:, perm], False
                 )
+                if packed_on:
+                    new_member = packing.np_pack_bits(new_member, Wp)
                 member = jnp.asarray(new_member)
 
             iters = min(block, N - n0)
@@ -546,31 +647,49 @@ def check_wgl_device(
                 # The step fn itself keys the cache (strong ref): an
                 # id() key can collide after GC address reuse and serve
                 # the wrong model's transition kernel.
-                key = (B, W, SW, Cmax, pm.jax_step, mesh)
+                key = (B, W, SW, Cmax, pm.jax_step, mesh, packed_on)
                 fn = _block_fn_cache.get(key)
                 fresh_fn = fn is None
                 if fn is None:
                     if mesh is not None:
                         fn = _make_block_fn_sharded(
-                            B, W, SW, Cmax, pm.jax_step, mesh
+                            B, W, SW, Cmax, pm.jax_step, mesh,
+                            packed=packed_on,
                         )
                     else:
-                        fn = _make_block_fn(B, W, SW, Cmax, pm.jax_step)
+                        fn = _make_block_fn(
+                            B, W, SW, Cmax, pm.jax_step, packed=packed_on
+                        )
                     _block_fn_cache[key] = fn
+                if packed_on:
+                    # Packed table slots: ok-mask as uint32 words, hash
+                    # vectors as odd uint32 multipliers.
+                    htabs = [
+                        jnp.asarray(packing.np_pack_bits(tables["ok_w"], Wp)),
+                        jnp.asarray(tables["fmin1"]),
+                        jnp.asarray(tables["f_has_ok"]),
+                        jnp.asarray(packing.hash_consts(Wp, 0)),
+                        jnp.asarray(packing.hash_consts(Wp, 1)),
+                        jnp.asarray(packing.hash_consts(SW, 2)),
+                        jnp.asarray(packing.hash_consts(SW, 3)),
+                    ]
+                else:
+                    htabs = [
+                        jnp.asarray(tables["ok_w"]),
+                        jnp.asarray(tables["fmin1"]),
+                        jnp.asarray(tables["f_has_ok"]),
+                        jnp.asarray(h1v),
+                        jnp.asarray(h2v),
+                        jnp.asarray(sh1v),
+                        jnp.asarray(sh2v),
+                    ]
                 targs = [
                     jnp.asarray(tables["ret_w"]),
                     jnp.asarray(tables["inv_w"]),
                     jnp.asarray(tables["f_w"]),
                     jnp.asarray(tables["a0_w"]),
                     jnp.asarray(tables["a1_w"]),
-                    jnp.asarray(tables["ok_w"]),
-                    jnp.asarray(tables["fmin1"]),
-                    jnp.asarray(tables["f_has_ok"]),
-                    jnp.asarray(h1v),
-                    jnp.asarray(h2v),
-                    jnp.asarray(sh1v),
-                    jnp.asarray(sh2v),
-                ]
+                ] + htabs
                 if telemetry.enabled():
                     # Fresh cache entries pay jit trace+compile inside the
                     # first call — "wgl.bfs.compile" vs "wgl.bfs.block" is
@@ -582,6 +701,9 @@ def check_wgl_device(
                     )
                     telemetry.gauge("wgl.bfs.beam", B)
                     telemetry.gauge("wgl.bfs.window", W)
+                    if packed_on:
+                        telemetry.count("wgl.packed.blocks")
+                        telemetry.gauge("wgl.packed.words", Wp)
                     sp = telemetry.span(
                         "wgl.bfs.compile" if fresh_fn else "wgl.bfs.block"
                     )
@@ -604,6 +726,22 @@ def check_wgl_device(
                     # halved beam from the block snapshot, then settle for
                     # "unknown" — the dispatcher's CPU settle takes over.
                     _block_fn_cache.pop(key, None)
+                    if packed_on:
+                        # First rung: shed the packed lanes and retry the
+                        # block wide at the SAME beam — packing is an
+                        # optimisation, not a budget, so it goes before
+                        # any beam width is surrendered.
+                        packed_on = False
+                        degrade.record("device", "packed-fallback", e)
+                        telemetry.count("wgl.packed.fallbacks")
+                        m0, s0, a0_ = snap
+                        m0np = np.asarray(m0)
+                        member = jnp.asarray(packing.np_unpack_bits(
+                            m0np, m0np.shape[1] * packing.LANES
+                        )[:, :W])
+                        states, alive = s0, a0_
+                        snap = (member, states, alive)
+                        continue
                     if device_retried or B <= 64:
                         degrade.record("device", "fall-through", e)
                         return WGLResult(
@@ -705,6 +843,7 @@ def check_wgl_device(
             beam=int(_bucket(beam, lo=256)), block=int(block),
             max_beam=int(max_beam), max_window=int(max_window),
             mesh=mesh is not None,
+            packed=packed_enabled(packed_lanes),
         )
         res = _bfs()
         _pb.outcome = (f"unknown:{res.reason}"

@@ -35,7 +35,8 @@ from .. import telemetry
 from ..telemetry import profile, roofline
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
-from . import degrade
+from . import degrade, packing
+from .wgl import packed_enabled
 
 INF = np.int32(2**31 - 1)
 
@@ -110,17 +111,38 @@ def pack_batch(packs: list[PackedOps], pad_keys_to: Optional[int] = None) -> Bat
     return BatchedPack(ret=ret, inv=inv, f=f, a0=a0, a1=a1, okv=okv, n_ops=n_ops)
 
 
-def _make_key_fn(B: int, N: int, SW: int, Cmax: int, jax_step):
+def _make_key_fn(B: int, N: int, SW: int, Cmax: int, jax_step,
+                 packed: bool = False):
     """One key's full frontier search: (tables…) -> (accepted, alive_end,
-    incomplete, explored).  vmap'd over the key axis by the caller."""
+    incomplete, explored).  vmap'd over the key axis by the caller.
+
+    With `packed`, the member/child bitsets ride as ceil(N/32) uint32
+    lanes between levels (ops/packing.py): word-OR children, packed
+    cover test, wrapping-uint32 dedup hashes.  Under the caller's vmap
+    the level advances every key's frontier in one dispatch, so the
+    unpack + candidate rule is one (K*B, N) operand and the dedup hash
+    one (K*Cmax, Np) integer contraction — the batched, matmul-shaped
+    step the wide engine only approximates with bool tensors."""
     import jax
     import jax.numpy as jnp
 
-    h1v, h2v, sh1v, sh2v = (jnp.asarray(v) for v in _hash_vectors(N, SW))
+    if packed:
+        Np = packing.n_words(N)
+        hw1 = jnp.asarray(packing.hash_consts(Np, 0))
+        hw2 = jnp.asarray(packing.hash_consts(Np, 1))
+        shw1 = jnp.asarray(packing.hash_consts(SW, 2))
+        shw2 = jnp.asarray(packing.hash_consts(SW, 3))
+    else:
+        h1v, h2v, sh1v, sh2v = (
+            jnp.asarray(v) for v in _hash_vectors(N, SW)
+        )
 
     def level_step(carry, tables):
         member, states, alive, accepted, incomplete, explored, it = carry
         ret, inv, f, a0, a1, okv, init_state, n_ops = tables
+        member_w = member
+        if packed:
+            member = packing.unpack_bits(member_w, N)
 
         # Candidate rule: a non-member a may be linearized next iff
         # inv(a) < min ret over the *other* non-members — two masked
@@ -147,18 +169,40 @@ def _make_key_fn(B: int, N: int, SW: int, Cmax: int, jax_step):
         # Model transition over survivors.
         new_states, legal = jax.vmap(jax_step)(states[parent], f[a], a0[a], a1[a])
         live_c = valid_c & legal
-        child = member[parent].at[jnp.arange(Cmax), a].set(True)
+        if packed:
+            # Packed child: word-OR the parent lanes + one hot bit;
+            # cover test and dedup hashes run on the uint32 words
+            # (okv arrives pre-packed from key_fn).
+            child = packing.set_bit(member_w[parent], a)
+            cover = packing.covers(child, okv)
+            accepted = accepted | jnp.any(live_c & cover)
+            su = packing.as_u32(new_states)
+            dead = jnp.uint32(0xFFFFFFFF)
+            h1 = jnp.where(
+                live_c,
+                packing.hash_words(child, hw1)
+                + packing.hash_words(su, shw1),
+                dead,
+            )
+            h2 = jnp.where(
+                live_c,
+                packing.hash_words(child, hw2)
+                + packing.hash_words(su, shw2),
+                dead,
+            )
+        else:
+            child = member[parent].at[jnp.arange(Cmax), a].set(True)
 
-        # Accept when some live child covers every :ok op.
-        cover = (child | ~okv[None, :]).all(axis=1)
-        accepted = accepted | jnp.any(live_c & cover)
+            # Accept when some live child covers every :ok op.
+            cover = (child | ~okv[None, :]).all(axis=1)
+            accepted = accepted | jnp.any(live_c & cover)
 
-        # Dedup via float-hash sort + exact adjacent compare.
-        cf = child.astype(jnp.float32)
-        sf = new_states.astype(jnp.float32)
-        big = jnp.float32(3.0e38)
-        h1 = jnp.where(live_c, cf @ h1v + sf @ sh1v, big)
-        h2 = jnp.where(live_c, cf @ h2v + sf @ sh2v, big)
+            # Dedup via float-hash sort + exact adjacent compare.
+            cf = child.astype(jnp.float32)
+            sf = new_states.astype(jnp.float32)
+            big = jnp.float32(3.0e38)
+            h1 = jnp.where(live_c, cf @ h1v + sf @ sh1v, big)
+            h2 = jnp.where(live_c, cf @ h2v + sf @ sh2v, big)
         h1s, h2s, perm = jax.lax.sort((h1, h2, jnp.arange(Cmax)), num_keys=2)
         child_s = child[perm]
         states_s = new_states[perm]
@@ -187,11 +231,15 @@ def _make_key_fn(B: int, N: int, SW: int, Cmax: int, jax_step):
         )
 
     def key_fn(ret, inv, f, a0, a1, okv, init_state, n_ops):
-        member0 = jnp.zeros((B, N), dtype=bool)
+        if packed:
+            member0 = jnp.zeros((B, Np), dtype=jnp.uint32)
+        else:
+            member0 = jnp.zeros((B, N), dtype=bool)
         states0 = jnp.tile(init_state[None, :], (B, 1))
         alive0 = jnp.arange(B) < 1
         accepted0 = ~okv.any()
-        tables = (ret, inv, f, a0, a1, okv, init_state, n_ops)
+        ok_t = packing.pack_bits(okv, Np) if packed else okv
+        tables = (ret, inv, f, a0, a1, ok_t, init_state, n_ops)
 
         def cond(carry):
             _, _, alive, accepted, _, _, it = carry
@@ -217,19 +265,20 @@ def _make_key_fn(B: int, N: int, SW: int, Cmax: int, jax_step):
     return key_fn
 
 
-def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None):
+def _get_kernel(B: int, N: int, SW: int, Cmax: int, jax_step, mesh=None,
+                packed: bool = False):
     """The jitted batched kernel: vmap over keys, shard_map over the mesh
     'keys' axis when a mesh is given (each device runs its slice of keys
     independently — no collectives in the hot loop)."""
     import jax
 
     # Strong-reference keys: id() collides after GC address reuse.
-    key = (B, N, SW, Cmax, jax_step, mesh)
+    key = (B, N, SW, Cmax, jax_step, mesh, packed)
     fn = _kernel_cache.get(key)
     if fn is not None:
         return fn
 
-    key_fn = _make_key_fn(B, N, SW, Cmax, jax_step)
+    key_fn = _make_key_fn(B, N, SW, Cmax, jax_step, packed=packed)
     batched = jax.vmap(key_fn, in_axes=(0, 0, 0, 0, 0, 0, None, 0))
     if mesh is not None:
         from jax.sharding import PartitionSpec as P
@@ -268,6 +317,7 @@ def check_wgl_batched(
     cand_factor: int = 4,
     mesh=None,
     time_limit_s: Optional[float] = None,
+    packed_lanes: Optional[bool] = None,
 ) -> BatchedWGLResult:
     """Runs the WGL search for every key at once on device.  Keys whose
     search overflowed the beam are retried together with a doubled beam;
@@ -289,6 +339,7 @@ def check_wgl_batched(
     explored = np.zeros(K, dtype=np.int64)
     todo = list(range(K))
     B = _bucket(beam, lo=32)
+    packed_on = packed_enabled(packed_lanes)
     batch_retried = False  # one halved-beam retry on resource errors
 
     # One cost record per batched pass: shape features, the beam plan,
@@ -297,7 +348,8 @@ def check_wgl_batched(
         "batched", keys=K, ops=int(sum(p.n for p in packs)),
     ) as _pb:
         _pb.knob(beam=B, max_beam=int(max_beam),
-                 cand_factor=int(cand_factor), mesh=mesh is not None)
+                 cand_factor=int(cand_factor), mesh=mesh is not None,
+                 packed=packed_on)
         while todo:
             if mesh is not None:
                 pad_t = n_dev * math.ceil(len(todo) / n_dev)
@@ -309,8 +361,11 @@ def check_wgl_batched(
             # exactly like the witness/BFS tiers (the phase profile and the
             # per-pass cost record both read this convention).
             fresh_fn = (B, bp.N, SW, cand_factor * B, pm.jax_step,
-                        mesh) not in _kernel_cache
-            fn = _get_kernel(B, bp.N, SW, cand_factor * B, pm.jax_step, mesh)
+                        mesh, packed_on) not in _kernel_cache
+            fn = _get_kernel(B, bp.N, SW, cand_factor * B, pm.jax_step,
+                             mesh, packed=packed_on)
+            if packed_on and telemetry.enabled():
+                telemetry.count("wgl.packed.batched-rounds")
             sp = telemetry.span(
                 "wgl.batched.compile" if fresh_fn else "wgl.batched.block",
                 keys=len(todo), beam=B,
@@ -343,8 +398,16 @@ def check_wgl_batched(
                 # it can't climb back into the OOM region); a second
                 # failure hands every unsettled key to the CPU settle.
                 _kernel_cache.pop(
-                    (B, bp.N, SW, cand_factor * B, pm.jax_step, mesh), None
+                    (B, bp.N, SW, cand_factor * B, pm.jax_step, mesh,
+                     packed_on), None
                 )
+                if packed_on:
+                    # First rung: shed the packed lanes at the SAME beam
+                    # before surrendering any width (see ops/wgl.py).
+                    packed_on = False
+                    degrade.record("batched", "packed-fallback", e)
+                    telemetry.count("wgl.packed.fallbacks")
+                    continue
                 if batch_retried or B <= 32:
                     degrade.record("batched", "fall-through", e)
                     for k in todo:

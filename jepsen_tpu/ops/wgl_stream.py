@@ -59,6 +59,7 @@ from ..telemetry import profile
 from ..history.packed import NO_RET, ST_OK, PackedOps
 from ..models.base import PackedModel
 from . import degrade
+from .wgl import packed_enabled
 from .wgl_witness import INF, check_wgl_witness
 
 #: Synthetic f-code for the inter-key reset barrier.  Far above any
@@ -298,7 +299,14 @@ def check_wgl_witness_stream(
     with profile.capture(
         "stream", keys=K, ops=int(stream_timeline_len(packs)),
     ) as _pp, telemetry.span("wgl.stream", keys=K):
-        _pp.knob(segment=seg, max_restarts=max_restarts)
+        # packed_lanes flows through **witness_kw to the witness
+        # engine; the knob is recorded here so stream pass records
+        # distinguish packed from wide runs in profiles.jsonl.
+        stream_packed = packed_enabled(witness_kw.get("packed_lanes"))
+        _pp.knob(segment=seg, max_restarts=max_restarts,
+                 packed=stream_packed)
+        if stream_packed and telemetry.enabled():
+            telemetry.count("wgl.packed.stream-passes")
         while start < K:
             remaining = None
             if time_limit_s is not None:

@@ -66,12 +66,12 @@ from typing import Any, Optional
 import numpy as np
 
 from .. import telemetry
-from ..telemetry import roofline
+from ..telemetry import profile, roofline
 from ..checker.wgl_cpu import WGLResult
 from ..history.packed import ST_OK, PackedOps
 from ..models.base import PackedModel
-from . import degrade
-from .wgl import _bucket, window_regather
+from . import degrade, packing
+from .wgl import _bucket, packed_enabled, window_regather
 
 INF = np.int32(2**31 - 1)
 NO_BAR = np.iinfo(np.int32).max
@@ -290,12 +290,17 @@ def _plan_blocks(packed: PackedOps, bars_per_block: int,
     return bars, bar_rank, inv32, ret32, blocks, any_dropped
 
 
-def plan_width(packed: PackedOps, bars_per_block: int = 1024,
+def plan_width(packed: PackedOps, bars_per_block: Optional[int] = None,
                info_window: Optional[int] = NARROW_INFO_WINDOW) -> int:
     """The window width a witness run over `packed` will use — lets a
     warm-up run pre-compile the same kernel via `width_hint`."""
     if packed.n == 0 or packed.n_ok == 0:
         return 0
+    if bars_per_block is None:
+        from ..plan.costmodel import choose_witness_block_knobs
+
+        bars_per_block = choose_witness_block_knobs(
+            packed.n, int(packed.n_ok))[0]["bars_per_block"]
     try:
         _, _, _, _, blocks, _ = _plan_blocks(packed, bars_per_block,
                                              info_window)
@@ -304,7 +309,7 @@ def plan_width(packed: PackedOps, bars_per_block: int = 1024,
     return _bucket(max(max(len(a) for _, _, a in blocks), 1))
 
 
-def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
+def plan_drops(packed: PackedOps, bars_per_block: Optional[int] = None,
                info_window: Optional[int] = NARROW_INFO_WINDOW) -> bool:
     """Whether a witness plan at this info_window would drop any info
     columns — when False, a wider window plans identically and an
@@ -313,6 +318,11 @@ def plan_drops(packed: PackedOps, bars_per_block: int = 1024,
         return False
     if packed.n - packed.n_ok <= info_window:
         return False  # cheap bound: fewer info ops than the window
+    if bars_per_block is None:
+        from ..plan.costmodel import choose_witness_block_knobs
+
+        bars_per_block = choose_witness_block_knobs(
+            packed.n, int(packed.n_ok))[0]["bars_per_block"]
     try:
         return _plan_blocks(packed, bars_per_block, info_window)[5]
     except OverflowError:
@@ -450,7 +460,8 @@ def _make_pallas_sweep(B: int, W: int, SW: int, K: int, jax_step_rows,
 
 def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
                    jax_step, pallas_mode: str = "off",
-                   jax_step_rows=None, compact: int = 0):
+                   jax_step_rows=None, compact: int = 0,
+                   packed: bool = False):
     """One call runs NB blocks of up to K barriers each.
 
     Args: member (W, B) bool — window-major so the per-barrier
@@ -494,6 +505,21 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
     BIG = jnp.float32(3.0e38)
     M = B * W
     WC = compact if 0 < compact < W else 0
+
+    # `packed`: the (W, B) member window rides the inter-block scan
+    # carry — and the per-block re-gather, the engine's hottest
+    # relayout — as ceil(B/32) uint32 beam lanes (ops/packing.py).
+    # run_block itself still sees the bool window (unpack on entry,
+    # pack on exit), so block semantics are bit-identical; only the
+    # carried/gathered bytes shrink.
+    Bp = packing.n_words(B)
+    zero_m = jnp.uint32(0) if packed else False
+
+    def _pack_m(m):
+        return packing.pack_bits(m, Bp) if packed else m
+
+    def _unpack_m(mw):
+        return packing.unpack_bits(mw, B) if packed else mw
 
     pallas_sweep = (
         _make_pallas_sweep(
@@ -737,10 +763,13 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             member, states, alive, failed, died = carry
             bars_b, tab_b, perm_b, present_b, k0 = xs
             member = jnp.where(present_b[:, None], member[perm_b],
-                               False)
+                               zero_m)
 
             def run(_):
-                return run_block(member, states, alive, bars_b, tab_b, k0)
+                m, s, al, f2, d2 = run_block(
+                    _unpack_m(member), states, alive, bars_b, tab_b, k0
+                )
+                return _pack_m(m), s, al, f2, d2
 
             def skip(_):
                 return (member, states, alive, jnp.bool_(False),
@@ -751,10 +780,11 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             return (m, s, al, failed | f2, died), None
 
         (member, states, alive, failed, died), _ = jax.lax.scan(
-            body, (member, states, alive, failed, jnp.int32(NO_BAR)),
+            body,
+            (_pack_m(member), states, alive, failed, jnp.int32(NO_BAR)),
             (bars, tab, perm, present, k0s),
         )
-        return member, states, alive, failed, died
+        return _unpack_m(member), states, alive, failed, died
 
     jcol = jnp.arange(K, dtype=jnp.int32)
     wcol = jnp.arange(W, dtype=jnp.int32)
@@ -762,11 +792,12 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
     def idx_block_step(member, states, alive, failed, died,
                        bar_b, act_b, nb, nw, perm_b, present_b,
                        k0, fA, a0A, a1A, retA, invA, rankA):
-        """One block: regather member, build bar/tab tables on
-        device from row indices, run.  Shared by the "indices"
-        and "device" transfer modes."""
+        """One block: regather member (packed lanes when enabled),
+        build bar/tab tables on device from row indices, run.  Shared
+        by the "indices" and "device" transfer modes; member arrives
+        and leaves in carry form (_pack_m)."""
         member = jnp.where(present_b[:, None], member[perm_b],
-                           False)
+                           zero_m)
         real = (jcol < nb).astype(jnp.int32)
         bars_b = jnp.stack([
             jnp.searchsorted(act_b, bar_b).astype(jnp.int32),
@@ -786,8 +817,10 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
         ])
 
         def run(_):
-            return run_block(member, states, alive, bars_b, tab_b,
-                             k0)
+            m, s, al, f2, d2 = run_block(
+                _unpack_m(member), states, alive, bars_b, tab_b, k0
+            )
+            return _pack_m(m), s, al, f2, d2
 
         def skip(_):
             return (member, states, alive, jnp.bool_(False),
@@ -823,10 +856,11 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
             return out, None
 
         (member, states, alive, failed, died), _ = jax.lax.scan(
-            body, (member, states, alive, failed, jnp.int32(NO_BAR)),
+            body,
+            (_pack_m(member), states, alive, failed, jnp.int32(NO_BAR)),
             (bar_idx, act_idx, nbars, nws, perm, present, k0s),
         )
-        return member, states, alive, failed, died
+        return _unpack_m(member), states, alive, failed, died
 
     def make_chunk_dev(S: int):
         """Builds the transfer="device" entry for span-slice width S.
@@ -904,11 +938,11 @@ def _make_chunk_fn(B: int, W: int, SW: int, K: int, D: int, NB: int,
 
         carry, _ = jax.lax.scan(
             body,
-            (member, states, alive, failed, jnp.int32(NO_BAR),
+            (_pack_m(member), states, alive, failed, jnp.int32(NO_BAR),
              prev_act),
             (k0s, end_rets, los, nbars, cuts),
         )
-        return carry
+        return (_unpack_m(carry[0]),) + tuple(carry[1:])
 
     return (roofline.instrument(jax.jit(chunk)),
             roofline.instrument(jax.jit(chunk_idx)), make_chunk_dev)
@@ -922,8 +956,8 @@ def check_wgl_witness(
     # chain diversity above 8 lanes almost never decides a register-
     # class history, and a died witness still escalates to the exact
     # tiers.
-    bars_per_block: int = 1024,
-    blocks_per_call: int = 32,
+    bars_per_block: Optional[int] = None,  # None -> profile-chosen
+    blocks_per_call: Optional[int] = None,  # bucket (plan/costmodel)
     depth: int = 5,
     info_window: Optional[int] = NARROW_INFO_WINDOW,
     max_window: int = 32768,
@@ -935,6 +969,7 @@ def check_wgl_witness(
     transfer: str = "auto",
     rank_override: Optional[np.ndarray] = None,
     out_info: Optional[dict] = None,
+    packed_lanes: Optional[bool] = None,
     _degraded: bool = False,
 ) -> Optional[WGLResult]:
     """Runs the witness search on the default JAX device.
@@ -1006,6 +1041,24 @@ def check_wgl_witness(
         return WGLResult(valid=True, configs_explored=1,
                          elapsed_s=time.monotonic() - t0)
 
+    if bars_per_block is None or blocks_per_call is None:
+        # Chunk-shape buckets are profile-chosen (ROADMAP item 1 (c)):
+        # the trained cost model ranks the bucket grid when its witness
+        # predictor covers the candidates, else the measured heuristic
+        # default.  Explicit caller values always win.
+        from ..plan.costmodel import choose_witness_block_knobs
+
+        knobs, source = choose_witness_block_knobs(n, int(packed.n_ok))
+        if bars_per_block is None:
+            bars_per_block = knobs["bars_per_block"]
+        if blocks_per_call is None:
+            blocks_per_call = knobs["blocks_per_call"]
+        telemetry.count(f"wgl.plan.witness-block-{source}")
+    # Record the resolved shape on the enclosing pass capture so the
+    # cost model can train on what actually ran.
+    profile.annotate(bars_per_block=int(bars_per_block),
+                     blocks_per_call=int(blocks_per_call))
+
     if rank_override is not None:
         checkpoint_dir = None  # ckpt key does not cover the override
     try:
@@ -1024,6 +1077,7 @@ def check_wgl_witness(
     SW = pm.state_width
     B = _bucket(beam, lo=8)
     K = bars_per_block
+    packed_on = packed_enabled(packed_lanes)
     if len(blocks) < blocks_per_call:
         # Short histories (one chunk): trim the call width to a
         # bucket of the real block count — padding blocks are no-ops
@@ -1038,6 +1092,8 @@ def check_wgl_witness(
         telemetry.gauge("wgl.witness.window", W)
         telemetry.gauge("wgl.witness.beam", B)
         telemetry.gauge("wgl.witness.blocks", len(blocks))
+        if packed_on:
+            telemetry.count("wgl.packed.witness-runs")
 
     if pallas not in ("auto", "on", "off", "interpret"):
         raise ValueError(f"unknown pallas mode {pallas!r}")
@@ -1127,19 +1183,39 @@ def check_wgl_witness(
             pallas="off", compact=compact,
             checkpoint_dir=checkpoint_dir, transfer=transfer,
             rank_override=rank_override, out_info=out_info,
-            _degraded=_degraded,
+            packed_lanes=packed_on, _degraded=_degraded,
         )
 
     def _retry_smaller(e: BaseException):
         """Degradation-ladder fallback for device resource exhaustion
         (XLA RESOURCE_EXHAUSTED / compile failure / injected fault):
-        retry ONCE with a halved block plan — the chunk call's working
-        set scales with bars_per_block × blocks_per_call — then
-        escalate (return None) so the caller falls through to the next
-        tier.  Mirrors _retry_on_scan's budget deduction; keep every
-        caller-visible kwarg reproduced here too."""
+        first shed the packed lanes (an optimisation, not a budget),
+        then retry ONCE with a halved block plan — the chunk call's
+        working set scales with bars_per_block × blocks_per_call —
+        then escalate (return None) so the caller falls through to the
+        next tier.  Mirrors _retry_on_scan's budget deduction; keep
+        every caller-visible kwarg reproduced here too."""
         import logging
 
+        if packed_on:
+            degrade.record("witness", "packed-fallback", e)
+            telemetry.count("wgl.packed.fallbacks")
+            if time_limit_s is not None:
+                rem = time_limit_s - (time.monotonic() - t0)
+                if rem <= 0:
+                    return None
+            else:
+                rem = None
+            return check_wgl_witness(
+                packed, pm, beam=beam, bars_per_block=bars_per_block,
+                blocks_per_call=blocks_per_call, depth=depth,
+                info_window=info_window, max_window=max_window,
+                width_hint=width_hint, time_limit_s=rem,
+                pallas=pallas, compact=compact,
+                checkpoint_dir=checkpoint_dir, transfer=transfer,
+                rank_override=rank_override, out_info=out_info,
+                packed_lanes=False, _degraded=_degraded,
+            )
         if _degraded or bars_per_block <= 64:
             degrade.record("witness", "fall-through", e)
             logging.getLogger(__name__).warning(
@@ -1167,13 +1243,13 @@ def check_wgl_witness(
             pallas=pallas, compact=compact,
             checkpoint_dir=checkpoint_dir, transfer=transfer,
             rank_override=rank_override, out_info=out_info,
-            _degraded=True,
+            packed_lanes=packed_on, _degraded=True,
         )
 
     # The step fn itself keys the cache (strong ref): an id() key
     # can collide after GC address reuse and serve the wrong
     # model's transition kernel.
-    key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
+    key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact, packed_on)
     # jax.jit is lazy: a freshly built chunk fn actually compiles on
     # its FIRST call — the trace labels that call "compile".
     fresh_fn = False
@@ -1186,7 +1262,8 @@ def check_wgl_witness(
         # pool) and leak it to the tuple unpack below.  "off" keys
         # never hold the sentinel, so this fetch can't see it.
         pallas = "off"
-        key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact)
+        key = (B, W, SW, K, D, NB, pm.jax_step, pallas, compact,
+               packed_on)
         fns = _chunk_fn_cache.get(key)
     if fns is None:
         fresh_fn = True
@@ -1194,7 +1271,7 @@ def check_wgl_witness(
             fns = _make_chunk_fn(B, W, SW, K, D, NB, pm.jax_step,
                                  pallas_mode=pallas,
                                  jax_step_rows=pm.jax_step_rows,
-                                 compact=compact)
+                                 compact=compact, packed=packed_on)
         except Exception:
             # Kernel BUILD failures (pallas_call construction, Mosaic
             # lowering probes) need the same safety net as execution

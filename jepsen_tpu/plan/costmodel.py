@@ -55,6 +55,61 @@ def heuristic_batched_knobs(beam: int) -> dict:
     return {"beam": min(beam, 32)}
 
 
+def heuristic_witness_block_knobs() -> dict:
+    """The witness chunk shape when no trained model covers the pass:
+    2048 bars/block x 32 blocks/call.  Re-measured with the packed
+    lanes on the scale workload (4M ops, procs=16, info 5%):
+    2048x32 runs 1.28x the old 1024x32 default (169.6k vs 132.9k
+    ops/s) and still wins at 200k ops; 4096 regresses (working set
+    falls out of cache) — see doc/design.md "Bit-packed kernels"."""
+    return {"bars_per_block": 2048, "blocks_per_call": 32}
+
+
+def _candidate_witness_blocks() -> list:
+    """Witness block-shape grid: bars/block x blocks/call buckets the
+    scan kernel compiles cleanly at; the chooser ranks only those the
+    trained witness predictor has support for."""
+    return [(512, 32), (1024, 32), (1024, 64),
+            (2048, 16), (2048, 32), (4096, 16)]
+
+
+def choose_witness_block_knobs(n_ops: int, n_ok: int,
+                               model: "Optional[CostModel]" = None
+                               ) -> tuple:
+    """(knobs, source) for the witness chunk shape
+    ({bars_per_block, blocks_per_call}): model-argmin over the bucket
+    grid when a trained witness predictor covers the candidates, else
+    the measured heuristic default."""
+    heur = heuristic_witness_block_knobs()
+    if model is None:
+        model = active_model()
+    if model is None or not model.has("witness"):
+        return heur, "heuristic"
+    feats = {"ops": n_ops, "ok": n_ok}
+    best, best_cost = None, None
+    for bars, nb in _candidate_witness_blocks():
+        knobs = {"bars_per_block": bars, "blocks_per_call": nb}
+        if not _in_support(model, "witness", knobs, heur):
+            continue
+        cost = model.predict_s("witness", feats, knobs)
+        if cost is None:
+            return heur, "heuristic"
+        if best_cost is None or cost < best_cost:
+            best, best_cost = knobs, cost
+    return (best, "model") if best else (heur, "heuristic")
+
+
+#: Floor for streaming-finalize chunk rows (PR 7's constant).
+FINALIZE_MIN_ROWS = 192
+
+
+def heuristic_finalize_rows(hwm: int) -> int:
+    """PR 7's HWM-halving formula, verbatim from the streaming
+    pipeline's finalize: chunk caps at half the high-water mark the
+    steady-state stream batches reached, floored at 192 rows."""
+    return max(FINALIZE_MIN_ROWS, int(hwm) // 2)
+
+
 # ---------------------------------------------------------------------------
 # Featurization
 # ---------------------------------------------------------------------------
@@ -195,14 +250,16 @@ def fit(records: Iterable[dict], *,
 
     by_pass: dict[str, list[tuple[dict[str, float], float]]] = {}
     support: dict[str, dict[str, list[float]]] = {}
+    shape_support: dict[str, dict[str, list[float]]] = {}
     for rec in records:
         name = rec.get("pass") or "unknown"
         cost = record_cost_s(rec)
         if cost < 0:
             continue
         plan = rec.get("plan") or {}
+        feats = rec.get("features") or {}
         xla_cost = rec.get("cost")
-        x = featurize(rec.get("features") or {}, plan,
+        x = featurize(feats, plan,
                       xla_cost if isinstance(xla_cost, dict) else None)
         by_pass.setdefault(name, []).append((x, cost))
         sup = support.setdefault(name, {})
@@ -211,6 +268,12 @@ def fit(records: Iterable[dict], *,
             if isinstance(v, (int, float)) and v >= 0:
                 lo, hi = sup.get(k, (v, v))
                 sup[k] = [min(lo, float(v)), max(hi, float(v))]
+        ssup = shape_support.setdefault(name, {})
+        for k in SHAPE_KEYS:
+            v = feats.get(k)
+            if isinstance(v, (int, float)) and v >= 0:
+                lo, hi = ssup.get(k, (v, v))
+                ssup[k] = [min(lo, float(v)), max(hi, float(v))]
 
     passes: dict[str, dict] = {}
     for name, rows in by_pass.items():
@@ -243,6 +306,12 @@ def fit(records: Iterable[dict], *,
             # value the training data has no support for — a linear
             # fit extrapolates confidently and wrongly.
             "support": support.get(name, {}),
+            # Observed SHAPE buckets (keys/ops/ok ranges): the
+            # finalize-chunk chooser only ranks chunk sizes whose
+            # per-chunk shape the store has actually recorded.
+            # Additive field — models without it simply keep every
+            # shape-gated chooser on the legacy formulas.
+            "shape_support": shape_support.get(name, {}),
         }
     return CostModel(passes)
 
@@ -372,6 +441,74 @@ def choose_batched_knobs(n_keys: int, n_ops: int, beam: int,
             return heur, "heuristic"
         if best_cost is None or cost < best_cost:
             best, best_cost = {"beam": b}, cost
+    return (best, "model") if best else (heur, "heuristic")
+
+
+def _shape_in_support(model: CostModel, pass_name: str,
+                      feats: dict) -> bool:
+    """True iff every shape feature sits inside the pass's recorded
+    shape bucket range.  Models fitted before shape_support existed
+    have no ranges -> nothing is rankable -> legacy formulas hold."""
+    sup = model.passes.get(pass_name, {}).get("shape_support") or {}
+    if not sup:
+        return False
+    for k, v in feats.items():
+        rng = sup.get(k)
+        if rng is None:
+            return False
+        try:
+            lo, hi = float(rng[0]), float(rng[1])
+        except (TypeError, ValueError, IndexError):
+            return False
+        if not lo <= float(v) <= hi:
+            return False
+    return True
+
+
+def _candidate_chunk_rows(hwm: int, total_rows: int) -> list[int]:
+    """Finalize-chunk candidates: the two legacy formulas plus
+    power-of-two buckets up to the backlog (capped — a cap beyond the
+    backlog is equivalent to one chunk)."""
+    cands = {heuristic_finalize_rows(hwm),
+             max(FINALIZE_MIN_ROWS, int(hwm) // 4)}
+    b = 256
+    while b <= max(total_rows, FINALIZE_MIN_ROWS) and b <= (1 << 16):
+        cands.add(b)
+        b *= 2
+    return sorted(c for c in cands if c >= FINALIZE_MIN_ROWS)
+
+
+def choose_finalize_chunk_rows(n_keys: int, total_rows: int, hwm: int,
+                               model: Optional[CostModel] = None
+                               ) -> tuple[int, str]:
+    """(chunk_rows, source) for the streaming pipeline's finalize
+    backlog: the generalization of PR 7's HWM-halving.  When the
+    trained stream predictor has roofline-annotated records whose
+    shape buckets cover a candidate chunk size, the model ranks the
+    candidates by predicted total finalize cost (per-chunk pass cost x
+    number of chunks); out of support — or with no model at all — the
+    legacy `max(192, hwm // 2)` formula holds verbatim."""
+    heur = heuristic_finalize_rows(hwm)
+    if total_rows <= 0:
+        return heur, "heuristic"
+    if model is None:
+        model = active_model()
+    if model is None or not model.has("stream"):
+        return heur, "heuristic"
+    sknobs = heuristic_stream_knobs(n_keys)
+    best, best_cost = None, None
+    for cap in _candidate_chunk_rows(hwm, total_rows):
+        n_chunks = max(1, -(-total_rows // cap))
+        keys_per_chunk = max(1, -(-n_keys // n_chunks))
+        feats = {"keys": keys_per_chunk, "ops": min(cap, total_rows)}
+        if not _shape_in_support(model, "stream", feats):
+            continue
+        per = model.predict_s("stream", feats, sknobs)
+        if per is None:
+            return heur, "heuristic"
+        cost = per * n_chunks
+        if best_cost is None or cost < best_cost:
+            best, best_cost = cap, cost
     return (best, "model") if best else (heur, "heuristic")
 
 

@@ -258,18 +258,26 @@ class StreamingSession:
         return None, None
 
     def _ingest_keyed(self, batch: list) -> None:
-        touched = {}
+        # Route scalar (the pending map is inherently sequential), then
+        # ingest columnar: one append_many per touched key.
+        routed_by_key: dict = {}
         for op in batch:
             k, routed = self._route(op)
             if routed is None:
                 continue
+            lst = routed_by_key.get(k)
+            if lst is None:
+                lst = routed_by_key[k] = []
+            lst.append(routed)
+        touched = {}
+        for k, rops in routed_by_key.items():
             b = self._builders.get(k)
             if b is None:
                 b = self._builders[k] = PackedBuilder(self.pm.encode)
-            b.append(routed)
+            b.append_many(rops)
             touched[k] = True
             if self._remote is not None:
-                self._remote.put(k, routed)
+                self._remote.put_many(k, rops)
         for k in touched:
             self._changed[k] = True
             v = self._verdicts.pop(k, None)
@@ -367,7 +375,7 @@ class StreamingSession:
             if isinstance(op.value, KV):
                 self._break("KV op in single-stream mode")
                 return
-            b.append(op)
+        b.append_many(batch)
         fr = self._frontier
         if fr is not None and not fr.dead and \
                 b.n_rows - self._adv_rows >= self.advance_rows:
@@ -488,14 +496,31 @@ class StreamingSession:
                 continue
             rest.append(k)
             packs.append(finals[k])
-        # Chunk to HALF the mid-run high-water mark: every mid-run
-        # batch already compiled its shape buckets, and the window the
-        # witness buckets by scales with rows for concatenated
-        # independent keys — a chunk at exactly the high-water mark
-        # sits on the bucket edge, where one extra indeterminate row
-        # tips into the next power of two and pays a fresh XLA compile
-        # seconds before the verdict.  Half stays safely inside.
-        cap = max(192, self._stream_rows_hwm // 2)
+        # Chunk sizing: by default HALF the mid-run high-water mark —
+        # every mid-run batch already compiled its shape buckets, and
+        # the window the witness buckets by scales with rows for
+        # concatenated independent keys; a chunk at exactly the
+        # high-water mark sits on the bucket edge, where one extra
+        # indeterminate row tips into the next power of two and pays a
+        # fresh XLA compile seconds before the verdict.  With planning
+        # on and a trained cost model whose roofline-annotated stream
+        # records cover the candidate shape buckets, the model picks
+        # the chunk rows instead (plan/costmodel.py
+        # choose_finalize_chunk_rows); out of support it falls back to
+        # the same halving formula.
+        total_rows = sum(p.n for p in packs)
+        from ..plan import enabled as _plan_enabled
+        if _plan_enabled():
+            from ..plan import costmodel
+            cap, cap_src = costmodel.choose_finalize_chunk_rows(
+                len(rest), total_rows, self._stream_rows_hwm
+            )
+            if cap_src == "model":
+                telemetry.count("wgl.plan.finalize-chunk-model")
+            else:
+                telemetry.count("wgl.plan.finalize-chunk-heuristic")
+        else:
+            cap = max(192, self._stream_rows_hwm // 2)
         i = 0
         while i < len(rest):
             j, rows = i, 0

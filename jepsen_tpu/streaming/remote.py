@@ -136,6 +136,21 @@ class RemoteFeed:
             if len(self._queue) >= FLUSH_OPS:
                 self._wake.set()
 
+    def put_many(self, key: Any, ops: list) -> None:
+        """put() for a per-key batch: one key lookup, the dict
+        conversion outside the lock, one lock acquisition."""
+        i = self._index.get(key)
+        if i is None:
+            i = self._index[key] = len(self._keys)
+            self._keys.append(key)
+        ods = [(i, op.to_dict()) for op in ops]
+        with self._lock:
+            if self.dead:
+                return
+            self._queue.extend(ods)
+            if len(self._queue) >= FLUSH_OPS:
+                self._wake.set()
+
     def commit(self, keys: list) -> None:
         """Drains the queue, finalizes the key count, collects the
         ticket.  `keys` is the session's first-seen key order — it must

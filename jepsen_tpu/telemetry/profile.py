@@ -381,6 +381,20 @@ class Capture:
         }
 
 
+#: Innermost-first stack of live captures — lets a callee that
+#: RESOLVES a knob (e.g. the witness block chooser) record the chosen
+#: value on the pass record its caller opened, so the cost model
+#: trains on what actually ran.
+_active: list[Capture] = []
+
+
+def annotate(**knobs: Any) -> None:
+    """Merges `knobs` into the innermost active capture's plan block;
+    silent no-op outside any capture (plain engine calls)."""
+    if _active:
+        _active[-1].knob(**knobs)
+
+
 @contextlib.contextmanager
 def capture(pass_name: str, **features: Any) -> Iterator[Capture]:
     """Profiles one checking pass: installs the span-exit and cost
@@ -416,6 +430,7 @@ def capture(pass_name: str, **features: Any) -> Iterator[Capture]:
     set_pass_hook(hook)
     set_cost_hook(cost_cb)
     _cost_hook.pending = pending_cb
+    _active.append(cap)
     try:
         yield cap
     except Exception as e:
@@ -423,6 +438,7 @@ def capture(pass_name: str, **features: Any) -> Iterator[Capture]:
             cap.outcome = f"error:{type(e).__name__}"
         raise
     finally:
+        _active.pop()
         set_pass_hook(prev)
         set_cost_hook(prev_cost)
         _cost_hook.pending = prev_pending

@@ -369,7 +369,11 @@ def test_witness_fault_retries_then_falls_through(monkeypatch, telem):
     # Fall-through means "escalate", never a verdict.
     assert res is None
     actions = [s["action"] for s in steps if s["tier"] == "witness"]
-    assert actions[0] == "retry-halved"
+    # Packed-lane shedding is the first rung (tests/test_wgl_packed.py
+    # pins the full order); the block-halving retry still runs before
+    # the tier surrenders.
+    assert actions[0] == "packed-fallback"
+    assert "retry-halved" in actions
     assert actions[-1] == "fall-through"
 
 

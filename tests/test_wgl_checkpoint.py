@@ -37,7 +37,18 @@ def ckpts(tmp_path):
 
 
 def ckpt_path_for(tmp_path, packed, W):
-    key = _ckpt_key(packed, PM, 8, W, PM.state_width, 1024, 32, 512)
+    # The key covers the search shape, so the block knobs must match
+    # whatever the profile-chooser resolves for this history.
+    from jepsen_tpu.ops.wgl_witness import _bucket
+    from jepsen_tpu.plan.costmodel import choose_witness_block_knobs
+
+    kn, _ = choose_witness_block_knobs(packed.n, int(packed.n_ok))
+    n_blocks = -(-int(packed.n_ok) // kn["bars_per_block"])
+    nb = kn["blocks_per_call"]
+    if n_blocks < nb:  # the engine's short-history call-width trim
+        nb = _bucket(n_blocks, lo=4)
+    key = _ckpt_key(packed, PM, 8, W, PM.state_width,
+                    kn["bars_per_block"], nb, 512)
     return key, tmp_path / f"wgl-witness-{key[:16]}.ckpt.npz"
 
 
