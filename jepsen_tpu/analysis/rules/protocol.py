@@ -120,6 +120,9 @@ DECLARED_NAMESPACES = {
     "forensics": "anomaly dossiers (forensics.py)",
     "slo": "SLO alert engine (telemetry/slo.py)",
     "monitor": "standing continuous verification (monitor/)",
+    "monitor.live": "live-target mode: suite-backed client pool, "
+                    "in-run fault windows, daemon supervision "
+                    "(monitor/live.py)",
     "alert": "alert router sink deliveries (monitor/alerts.py)",
 }
 

@@ -399,6 +399,29 @@ def single_test_cmd(
                     help="fraction of ops completing indeterminate")
     mo.add_argument("--platform", default=None, choices=["cpu", "tpu"],
                     help="pin the JAX backend")
+    mo.add_argument("--suite", default=None,
+                    choices=["kvdb", "logd", "electd", "txnd", "repkv"],
+                    help="live-target mode: drive this suite's real "
+                    "daemons with a client pool instead of the "
+                    "in-process workload")
+    mo.add_argument("--node", action="append", default=[],
+                    metavar="NAME", dest="nodes",
+                    help="cluster node for --suite (repeatable; "
+                    "default: the suite's own node list)")
+    mo.add_argument("--live-faults", default=None, metavar="FAMS",
+                    help="comma-separated fault families for the live "
+                    "nemesis driver (e.g. kill,pause,partition; "
+                    "'none' disables; default: suite-safe set)")
+    mo.add_argument("--search-dir", default=None, metavar="DIR",
+                    help="coverage-search checkpoint dir (search.json; "
+                    "default <store-dir>/live/search)")
+    mo.add_argument("--window-gap", type=float, default=0.75,
+                    metavar="S",
+                    help="quiet seconds between fault windows "
+                    "(default 0.75)")
+    mo.add_argument("--no-supervise", action="store_true",
+                    help="don't restart daemons that die outside a "
+                    "fault window")
     mo.set_defaults(_run=_run_monitor)
 
     return parser
@@ -682,6 +705,15 @@ def _run_monitor(opts) -> int:
         inject_slo_s=opts.inject_slo,
         endpoint=opts.endpoint,
         serve_port=opts.serve_port,
+        suite=opts.suite,
+        nodes=tuple(opts.nodes),
+        live_faults=tuple(
+            f.strip() for f in (opts.live_faults or "").split(",")
+            if f.strip()
+        ),
+        search_dir=opts.search_dir,
+        window_gap_s=opts.window_gap,
+        supervise=not opts.no_supervise,
     )
     stop = threading.Event()
     try:

@@ -309,6 +309,15 @@ def anomaly_signature(key: Any, result: dict,
     return hashlib.sha256(payload.encode()).hexdigest()[:12]
 
 
+def window_fingerprint(sig: Any) -> str:
+    """A short stable hash of a live fault window's coverage signature
+    (nemesis.search.signature's feature frozenset): the label the
+    monitor's fault-timeline panel and window dossiers carry, so two
+    windows with the same observable outcome share one name."""
+    payload = json.dumps(sorted(str(f) for f in sig or ()))
+    return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
 # ---------------------------------------------------------------------------
 # Nemesis correlation: fault windows vs violating op intervals
 # ---------------------------------------------------------------------------

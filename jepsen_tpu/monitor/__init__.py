@@ -5,6 +5,10 @@ generator, rolling-window online checking with constant memory
 (rolling.py), a durable time-series observatory
 (telemetry/timeseries.py), SLO evaluation with alert routing
 (alerts.py), and the standing loop that ties them together (loop.py).
+Live-target mode (`--suite`, monitor/live.py) swaps the synthetic
+source for a suite-backed client pool with an evolving in-run fault
+schedule and supervised recovery; it is imported lazily so the base
+monitor stays free of suite dependencies.
 """
 
 from .alerts import AlertRouter

@@ -217,6 +217,27 @@ class AlertRouter:
             sent += 1
         return sent
 
+    def flush(self, reason: str = "shutdown",
+              now: Optional[float] = None) -> int:
+        """Final delivery on graceful shutdown: every rule still firing
+        gets one closing ``"rec": "shutdown"`` event so the pager knows
+        the watcher (not the incident) went away.  Sinks that already
+        saw a clear deliver nothing."""
+        if now is None:
+            now = time.time()
+        sent = 0
+        for rule, st in self._state.items():
+            if not st["firing"] or st["last_delivery"] is None:
+                continue
+            self._deliver(self._event(
+                {"rec": "shutdown", "rule": rule, "t": now},
+                reason=reason,
+            ))
+            sent += 1
+        if sent:
+            telemetry.count("alert.flushed", sent)
+        return sent
+
     def status(self) -> dict:
         return {
             "sinks": list(self.sinks),

@@ -2,10 +2,11 @@
 
 Composition layer over the subsystems PRs 6-13 built (ROADMAP item 5):
 
-  * a paced op source — a linearizable-by-construction keyed register
-    workload (utils/histgen.py's pending-dict idiom, driven
-    incrementally) fed at a target op rate, the in-process stand-in
-    for a live cluster's client stream;
+  * a paced op source — either the in-process linearizable-by-
+    construction keyed register workload (utils/histgen.py's
+    pending-dict idiom, driven incrementally), or, with `--suite`, a
+    pool of real suite clients against real daemons plus a live
+    nemesis driver evolving in-run fault schedules (monitor/live.py);
   * a `RollingChecker` (monitor/rolling.py) holding memory constant
     via stable-prefix discards;
   * a `SeriesStore` + `Sampler` (telemetry/timeseries.py) persisting
@@ -90,6 +91,14 @@ class MonitorConfig:
     tee_window_ops: int = 4096
     serve_port: Optional[int] = None
     extra_rules: tuple = field(default_factory=tuple)
+    # live (suite-backed) mode — monitor/live.py
+    suite: Optional[str] = None      # kvdb|logd|electd|txnd|repkv
+    nodes: tuple = ()                # override the suite's node list
+    live_faults: tuple = ()          # fault families ("none" disables)
+    search_dir: Optional[str] = None  # coverage-search checkpoint dir
+    window_gap_s: float = 0.75       # quiet gap between fault windows
+    live_seed_duration_s: float = 2.0
+    supervise: bool = True           # restart daemons dead out-of-window
 
 
 class _OpSource:
@@ -264,6 +273,8 @@ def run_monitor(cfg: MonitorConfig,
     flight.set_dir(cfg.store_dir)
     profile.set_store(cfg.store_dir)
     rules = list(slo.DEFAULT_RULES) + list(slo.MONITOR_RULES)
+    if cfg.suite:
+        rules += list(slo.LIVE_MONITOR_RULES)
     rules += list(cfg.extra_rules)
     if cfg.inject_slo_s > 0:
         rules.append(slo.Rule(
@@ -289,8 +300,44 @@ def run_monitor(cfg: MonitorConfig,
         retain_blocks=cfg.retain_blocks,
         discard=cfg.discard,
     )
-    source = _OpSource(cfg.keys, cfg.procs_per_key, cfg.seed,
-                       cfg.info_rate)
+    # Graceful shutdown (live satellite, but useful everywhere): turn
+    # SIGTERM/SIGINT into a stop-flag so the finally block drains
+    # in-flight ops, heals open fault windows, flushes the series
+    # store and alert router, and ticks a final verdict.
+    if stop is None:
+        stop = threading.Event()
+    prev_handlers: dict = {}
+    if threading.current_thread() is threading.main_thread():
+        import signal as _signal
+
+        def _graceful(signum: int, frame: Any) -> None:
+            log.info("monitor: signal %d, draining gracefully", signum)
+            telemetry.count("monitor.graceful-shutdowns")
+            stop.set()
+
+        for _sig in (_signal.SIGTERM, _signal.SIGINT):
+            prev_handlers[_sig] = _signal.signal(_sig, _graceful)
+
+    live = None
+    if cfg.suite:
+        from . import live as live_mod
+
+        live = live_mod.LiveContext(cfg)
+        try:
+            source: Any = live.start(checker.status)
+        except BaseException:
+            import contextlib
+            import signal as _signal
+
+            with contextlib.suppress(Exception):
+                live.finalize()
+            for _sig, h in prev_handlers.items():
+                _signal.signal(_sig, h)
+            store.close()
+            raise
+    else:
+        source = _OpSource(cfg.keys, cfg.procs_per_key, cfg.seed,
+                           cfg.info_rate)
     tee = (_Tee(cfg.endpoint, cfg.keys, f"monitor-{os.getpid()}")
            if cfg.endpoint else None)
     server = None
@@ -398,7 +445,12 @@ def run_monitor(cfg: MonitorConfig,
             # (PackedBuilder.append_many) instead of per-op feeds.
             by_key: dict = {}
             for _ in range(burst):
-                key, op = source.next_event()
+                ev = source.next_event()
+                if ev is None:
+                    # Live pool produced nothing (wounded cluster);
+                    # the blocking get already paced us.
+                    break
+                key, op = ev
                 by_key.setdefault(key, []).append(op)
                 if tee is not None:
                     tee.feed(key, op)
@@ -408,19 +460,42 @@ def run_monitor(cfg: MonitorConfig,
             t_feed = time.monotonic()
             for key, kops in by_key.items():
                 checker.feed_many(key, kops, t_feed)
-            # Pace: one completed op ~= two events.
+            # Pace: one completed op ~= two events.  Live mode paces at
+            # the source (real clients, per-worker intervals), so only
+            # the synthetic source sleeps here.
             target = t0 + events / (2.0 * cfg.rate)
             now = time.monotonic()
             if now >= next_sample:
                 cadence(now)
                 next_sample += cfg.cadence_s
-            if now < target:
+            if live is None and now < target:
                 time.sleep(min(target - now, 0.25))
     finally:
+        if prev_handlers:
+            import signal as _signal
+
+            for _sig, h in prev_handlers.items():
+                _signal.signal(_sig, h)
+        if live is not None:
+            # Graceful drain: stop the driver (healing any open fault
+            # window), stop the supervisor, and feed the in-flight ops
+            # the client pool still holds.
+            leftovers = live.shutdown()
+            if leftovers:
+                by_key = {}
+                for key, op in leftovers:
+                    by_key.setdefault(key, []).append(op)
+                    events += 1
+                    if op.type != "invoke":
+                        completed += 1
+                t_feed = time.monotonic()
+                for key, kops in by_key.items():
+                    checker.feed_many(key, kops, t_feed)
         now = time.monotonic()
         checker.pump(now)
         cadence(now)
         verdicts = checker.finish()
+        router.flush()
         status = checker.status()
         summary = {
             "ops": completed,
@@ -442,6 +517,12 @@ def run_monitor(cfg: MonitorConfig,
             "alerts": router.status(),
             "slo": slo.status(),
         }
+        if live is not None:
+            try:
+                summary["live"] = live.finalize()
+            except Exception as e:  # noqa: BLE001 — summary must land
+                log.warning("live finalize failed: %r", e)
+                summary["live"] = {"error": f"{type(e).__name__}: {e}"}
         try:
             _atomic_json(os.path.join(cfg.store_dir, SUMMARY_FILE),
                          summary)

@@ -265,6 +265,19 @@ class RollingChecker:
                 worst = max(worst, now - pts[0][1])
         return worst
 
+    def epochs(self) -> dict:
+        """Per-key epoch bookkeeping: {key: {"epoch", "unknown",
+        "last-reason"}} — what the live nemesis driver correlates a
+        fault window against (did THIS window kill a frontier?)."""
+        return {
+            key: {
+                "epoch": ks.epoch,
+                "unknown": ks.unknown_epochs,
+                "last-reason": ks.last_reason,
+            }
+            for key, ks in self._keys.items()
+        }
+
     def status(self) -> dict:
         keys = self._keys
         return {

@@ -270,6 +270,13 @@ def ledger_path(test_dir: str) -> str:
     return os.path.join(test_dir, LEDGER_FILE)
 
 
+def read_outstanding(path: str) -> list[dict]:
+    """Unhealed intents at `path`, newest first — the one-call probe
+    the monitor's resume path (and its smoke) uses to decide whether a
+    crash left fault debt behind."""
+    return outstanding_entries(read_records(path))
+
+
 # ---------------------------------------------------------------------------
 # Test-map helpers: every nemesis call site goes through these, so a
 # test without a bound ledger (unit tests, library use) pays one dict

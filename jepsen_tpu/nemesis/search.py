@@ -601,6 +601,48 @@ def crossover(a: Schedule, b: Schedule, n_nodes: int, min_nodes: int,
     return enforce_floor(out, n_nodes, min_nodes, rng)
 
 
+def evolve(
+    frontier: Sequence[Schedule],
+    families: Sequence[str],
+    n_nodes: int,
+    min_nodes: int,
+    rng: random.Random,
+    *,
+    window: int = 0,
+    seed_duration: float = 0.4,
+) -> Schedule:
+    """The standing monitor's per-window schedule chooser: the search
+    loop of `run_search` unrolled to one step, so a live run can evolve
+    between windows instead of between subprocess iterations.
+
+    The first ``len(families)`` windows are the deterministic
+    per-family seeds (with ``seed_duration`` substituted — a live run
+    wants windows long enough that an op stream actually overlaps the
+    fault), after which parents come from the novelty frontier:
+    crossover when two parents exist (30%), otherwise mutation of a
+    random frontier member, falling back to a mutated fresh seed when
+    the frontier is empty (nothing novel yet)."""
+    families = list(families)
+    if not families:
+        raise ValueError("evolve needs at least one fault family")
+    if window < len(families):
+        s = seed_schedule(families[window], seed=rng.randrange(1 << 32))
+        if seed_duration != 0.4:
+            s = dataclasses.replace(s, events=tuple(
+                dataclasses.replace(e, duration=round(seed_duration, 3))
+                for e in s.events
+            ))
+        return enforce_floor(s, n_nodes, min_nodes, rng)
+    pool = list(frontier)
+    if len(pool) >= 2 and rng.random() < 0.3:
+        a, b = rng.sample(pool, 2)
+        return crossover(a, b, n_nodes, min_nodes, rng)
+    parent = (rng.choice(pool) if pool
+              else seed_schedule(rng.choice(families),
+                                 seed=rng.randrange(1 << 32)))
+    return mutate(parent, families, n_nodes, min_nodes, rng)
+
+
 # ---------------------------------------------------------------------------
 # Coverage
 # ---------------------------------------------------------------------------

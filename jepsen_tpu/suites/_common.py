@@ -53,3 +53,33 @@ def register_workload_gen(
         return mix(gens)
 
     return factory
+
+
+def live_register_mix(
+    rng: random.Random,
+    *,
+    with_cas: bool = True,
+    lo: int = 0,
+    hi: int = 5,
+) -> Callable[[], tuple]:
+    """() -> (f, value) for the monitor's standing register workload.
+
+    Unlike `register_workload_gen`, the value space is a small *bounded*
+    range [lo, hi): a standing run is open-ended, and the rolling
+    checker's packed-model interner grows with every distinct value it
+    sees — unique monotonically increasing writes would leak memory
+    over a week.  The verdict cost is acceptable here because the
+    monitor checks online against a live implementation (a stale read
+    still has to linearize against the pending writes), mirroring the
+    in-process `_OpSource`'s rng.randrange(5) value space."""
+
+    def next_op() -> tuple:
+        f = rng.choice(("read", "write", "cas") if with_cas
+                       else ("read", "write"))
+        if f == "read":
+            return "read", None
+        if f == "write":
+            return "write", rng.randrange(lo, hi)
+        return "cas", (rng.randrange(lo, hi), rng.randrange(lo, hi))
+
+    return next_op

@@ -421,6 +421,42 @@ def electd_test(opts: dict) -> dict:
     }
 
 
+def live_suite() -> dict:
+    """Adapter for `jepsen monitor --suite electd` (monitor/live.py).
+    Quorum + durable mode: ABD majority reads/writes over a fsync'd
+    WAL are linearizable by construction, so the standing verdict
+    should stay proven across partitions and kills — the monitor is
+    watching for regressions, not demonstrating the known split-brain.
+    ABD has no CAS, and values must stay >= 1 (the client reports an
+    empty register as the sentinel 0; a written 0 would alias it)."""
+
+    def test(opts: dict) -> dict:
+        store_root = os.path.abspath(opts.get("store-dir") or "store")
+        return jcli.localize_test({
+            "name": "electd-live",
+            "nodes": list(opts.get("nodes") or ["n1", "n2", "n3"])[:5],
+            "db": ElectdDB(),
+            "net": ElectdNet(),
+            "electd-quorum": True,
+            "electd-durable": True,
+            "electd-dir": os.path.join(store_root, "electd-data"),
+            "electd-base-port": cutil.hashed_base_port(store_root,
+                                                       BASE_PORT),
+            "store-dir": store_root,
+        })
+
+    return {
+        "name": "electd",
+        "test": test,
+        "client": lambda test, key: ElectdClient(key=f"mon{key}"),
+        "node": lambda test, key: test["nodes"][key % len(test["nodes"])],
+        "port": node_port,
+        "model": lambda: cas_register(0),
+        "with_cas": False,
+        "values": (1, 6),
+    }
+
+
 def _extra_opts(p) -> None:
     p.add_argument("--faults", action="append", default=None,
                    choices=["partition", "kill"])

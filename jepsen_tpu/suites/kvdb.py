@@ -443,6 +443,36 @@ def kvdb_test(opts: dict) -> dict:
     return test
 
 
+def live_suite() -> dict:
+    """Adapter for `jepsen monitor --suite kvdb` (monitor/live.py):
+    the minimal live-target test map (db + nodes + port topology, no
+    batch generator/checker — the monitor owns both) plus client/model
+    factories.  kvdb is unreplicated, so one node; each monitor key is
+    its own register (``mon<k>``) on that instance."""
+
+    def test(opts: dict) -> dict:
+        store_root = os.path.abspath(opts.get("store-dir") or "store")
+        return jcli.localize_test({
+            "name": "kvdb-live",
+            "db": KvdbDB(),
+            "nodes": ["n1"],
+            "kvdb-dir": os.path.join(store_root, "kvdb-data"),
+            "kvdb-base-port": cutil.hashed_base_port(store_root,
+                                                     BASE_PORT),
+            "store-dir": store_root,
+        })
+
+    return {
+        "name": "kvdb",
+        "test": test,
+        "client": lambda test, key: KvdbClient(register=f"mon{key}"),
+        "node": lambda test, key: test["nodes"][key % len(test["nodes"])],
+        "port": node_port,
+        "model": cas_register,
+        "with_cas": True,
+    }
+
+
 def _extra_opts(p) -> None:
     p.add_argument("--workload", default="register",
                    choices=["register", "set", "counter", "ids"])

@@ -20,6 +20,7 @@ new, speaking logd's line protocol with Kafka consumer semantics
 
 from __future__ import annotations
 
+import logging
 import os
 import socket
 from typing import Any
@@ -34,6 +35,8 @@ from ..generator.core import time_limit
 from ..history import FAIL, INFO, OK
 from ..workloads import kafka as kafka_wl
 from ..workloads import queue as queue_wl
+
+log = logging.getLogger(__name__)
 
 LOGD_SRC = _demo.source("logd")
 BASE_PORT = 7520
@@ -249,6 +252,111 @@ class LogdQueueClient(LogdClient):
             return op.complete(OK, value=int(resp.split()[1]))
         except (socket.timeout, TimeoutError) as e:
             return op.complete(INFO, error=f"timeout: {e}")
+
+
+class LogdRegisterClient(jc.Client):
+    """Register face over the broker for the standing monitor: write =
+    SEND (append; the register's value is the last record appended),
+    read = drain POLLs from this client's cursor to the log end at
+    invoke time.  Appends are atomic and reads observe the tail as of
+    the drain, so against a healthy single broker the face is
+    linearizable; write-behind loss (an unsynced kill) surfaces as the
+    real anomaly it is."""
+
+    DRAIN_CAP = 64
+
+    def __init__(self, key: str = "m0"):
+        self.key = key
+        self.sock = None
+        self.f = None
+        self.pos = 0
+        self.last: Any = None
+
+    def open(self, test, node):
+        c = type(self)(self.key)
+        c.sock = socket.create_connection(
+            ("127.0.0.1", node_port(test)), timeout=2.0
+        )
+        c.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        c.f = c.sock.makefile("rw", encoding="utf-8", newline="\n")
+        return c
+
+    _round_trip = LogdClient._round_trip
+
+    def invoke(self, test, op):
+        try:
+            if op.f == "write":
+                resp = self._round_trip(f"SEND {self.key} {op.value}")
+                if not resp.startswith("OFF "):
+                    return op.complete(INFO, error=resp)
+                return op.complete(OK)
+            if op.f != "read":
+                raise ValueError(f"unknown f {op.f!r} (no CAS on a log)")
+            # Drain to the log end: each POLL returns at most 32
+            # records, so loop until a poll comes back short/empty —
+            # stopping early would serve a stale tail and falsely
+            # convict the broker.
+            for _ in range(self.DRAIN_CAP):
+                resp = self._round_trip(f"POLL {self.key} {self.pos} 32")
+                parts = resp.split()
+                if parts[0] != "MSGS":
+                    return op.complete(INFO, error=resp)
+                new_pos = int(parts[1])
+                pairs = parts[2:]
+                if pairs:
+                    _off, v = pairs[-1].split(":", 1)
+                    self.last = int(v)
+                drained = new_pos == self.pos and not pairs
+                self.pos = new_pos
+                if drained or len(pairs) < 32:
+                    return op.complete(OK, value=self.last)
+            return op.complete(INFO, error="drain cap exceeded")
+        except (socket.timeout, TimeoutError) as e:
+            return op.complete(INFO, error=f"timeout: {e}")
+
+    def close(self, test):
+        try:
+            if self.sock is not None:
+                self.sock.close()
+        except OSError as e:
+            log.debug("logd register client close failed: %r", e)
+
+
+def live_suite() -> dict:
+    """Adapter for `jepsen monitor --suite logd` (monitor/live.py).
+    Sync WAL mode — the suite's control configuration, so kills lose
+    nothing by design and the standing verdict watches for
+    regressions.  Reads/writes only: a log has no CAS."""
+
+    def test(opts: dict) -> dict:
+        store_root = os.path.abspath(opts.get("store-dir") or "store")
+        return jcli.localize_test({
+            "name": "logd-live",
+            "nodes": ["n1"],
+            "db": LogdDB(),
+            "logd-sync": True,
+            "logd-flush-ms": 75,
+            "logd-dir": os.path.join(store_root, "logd-data"),
+            "logd-port": cutil.hashed_base_port(store_root, BASE_PORT,
+                                                stride=3),
+            "store-dir": store_root,
+        })
+
+    return {
+        "name": "logd",
+        "test": test,
+        "client": lambda test, key: LogdRegisterClient(key=f"mon{key}"),
+        "node": lambda test, key: test["nodes"][key % len(test["nodes"])],
+        "port": lambda test, node: node_port(test),
+        "model": _register_model,
+        "with_cas": False,
+    }
+
+
+def _register_model():
+    from ..models import cas_register
+
+    return cas_register()
 
 
 def logd_test(opts: dict) -> dict:

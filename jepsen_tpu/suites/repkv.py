@@ -621,6 +621,38 @@ def repkv_test(opts: dict) -> dict:
     return test
 
 
+def live_suite() -> dict:
+    """Adapter for `jepsen monitor --suite repkv` (monitor/live.py).
+    Safe-reads + sync replication — the suite's linearizable control
+    configuration — so the standing verdict watches for regressions
+    instead of re-demonstrating the known stale-read anomaly."""
+
+    def test(opts: dict) -> dict:
+        store_root = os.path.abspath(opts.get("store-dir") or "store")
+        return jcli.localize_test({
+            "name": "repkv-live",
+            "nodes": list(opts.get("nodes") or ["n1", "n2", "n3"])[:5],
+            "db": RepkvDB(),
+            "net": RepkvNet(),
+            "repkv-sync": True,
+            "repkv-safe-reads": True,
+            "repkv-dir": os.path.join(store_root, "repkv-data"),
+            "repkv-base-port": cutil.hashed_base_port(store_root,
+                                                      BASE_PORT),
+            "store-dir": store_root,
+        })
+
+    return {
+        "name": "repkv",
+        "test": test,
+        "client": lambda test, key: RepkvClient(key=f"mon{key}"),
+        "node": lambda test, key: test["nodes"][key % len(test["nodes"])],
+        "port": node_port,
+        "model": cas_register,
+        "with_cas": True,
+    }
+
+
 def _extra_opts(p) -> None:
     p.add_argument("--faults", action="append", default=None,
                    choices=["partition", "kill", "pause", "membership",

@@ -284,6 +284,71 @@ class TxndBankClient(TxndClient):
         return op.complete(OK, value=balances)
 
 
+class TxndRegisterClient(TxndClient):
+    """Register face for the standing monitor: one-mop transactions on
+    a single key.  A single-statement txn's snapshot is taken at
+    begin, so reads observe the latest committed value at invoke time
+    — linearizable for one register even under plain SI."""
+
+    def __init__(self, key: str = "m0"):
+        super().__init__()
+        self.key = key
+
+    def open(self, test: dict, node: Any) -> "TxndRegisterClient":
+        c = super().open(test, node)
+        c.key = self.key
+        return c
+
+    def invoke(self, test: dict, op: Op) -> Op:
+        if op.f == "read":
+            resp = self._roundtrip(f"TXN r {self.key}", op)
+            if isinstance(resp, Op):
+                return resp
+            reads = resp.split()[1:]
+            raw = reads[0] if reads else "NIL"
+            return op.complete(OK, value=self._parse_read(raw))
+        if op.f != "write":
+            raise ValueError(f"unknown f {op.f!r} (no CAS verb on txnd)")
+        resp = self._roundtrip(f"TXN w {self.key} {op.value}", op)
+        if isinstance(resp, Op):
+            return resp
+        return op.complete(OK)
+
+
+def live_suite() -> dict:
+    """Adapter for `jepsen monitor --suite txnd` (monitor/live.py).
+    Serializable mode (the suite's control group), single node; no
+    kill faults — txnd is deliberately memoryless across SIGKILL, so
+    the live driver should stick to pause windows."""
+
+    def test(opts: dict) -> dict:
+        store_root = os.path.abspath(opts.get("store-dir") or "store")
+        return jcli.localize_test({
+            "name": "txnd-live",
+            "nodes": ["n1"],
+            "db": TxndDB(),
+            "txnd-serializable": True,
+            "txnd-think-us": 0,
+            "txnd-dir": os.path.join(store_root, "txnd-data"),
+            "txnd-base-port": cutil.hashed_base_port(store_root,
+                                                     BASE_PORT),
+            "store-dir": store_root,
+        })
+
+    from ..models import cas_register
+
+    return {
+        "name": "txnd",
+        "test": test,
+        "client": lambda test, key: TxndRegisterClient(key=f"m{key}"),
+        "node": lambda test, key: test["nodes"][key % len(test["nodes"])],
+        "port": node_port,
+        "model": cas_register,
+        "with_cas": False,
+        "families": ("pause",),
+    }
+
+
 def txnd_test(opts: dict) -> dict:
     """Test-map assembly (zookeeper.clj:112-137 shape)."""
     nodes = (opts.get("nodes") or ["n1"])[:1]  # single-node system

@@ -129,6 +129,21 @@ MONITOR_RULES: tuple[Rule, ...] = (
          "checkerd.overload.shed", 1.0, for_count=3),
 )
 
+#: Rules the live (suite-backed) monitor adds when a real cluster is
+#: under watch.  Daemon restarts outside fault windows at a sustained
+#: rate mean the target is crash-looping on its own; client
+#: reconnect-storms mean the op stream is mostly backoff; a fault
+#: window left outstanding for consecutive cadences means a heal
+#: failed and residue is accumulating on a live machine.
+LIVE_MONITOR_RULES: tuple[Rule, ...] = (
+    Rule("live-daemon-restart-rate", "counter-rate-above",
+         "monitor.live.daemon-restarts", 0.2, for_count=2),
+    Rule("live-reconnect-rate", "counter-rate-above",
+         "monitor.live.client-reconnects", 5.0, for_count=3),
+    Rule("live-unhealed-window", "gauge-above",
+         "monitor.live.outstanding", 0.5, for_count=3),
+)
+
 
 class SLOEngine:
     """Evaluates a rule set against registry snapshots and journals

@@ -849,10 +849,46 @@ class Handler(http.server.BaseHTTPRequestHandler):
             + "</p><div id='charts'></div>"
             + _MONITOR_JS
             + summ_html
+            + self._monitor_faults(root)
             + self._monitor_roofline(root)
             + _slo_panel()
         )
         self._send(200, _page("monitor observatory", body))
+
+    def _monitor_faults(self, root: str) -> str:
+        """Fault-timeline panel for a live (`--suite`) monitor:
+        live-status.json's recent windows as a table — family mix,
+        outcome fingerprint, novelty, epoch restarts, outstanding
+        intent — plus the coverage-search totals."""
+        path = os.path.join(root, "live-status.json")
+        try:
+            with open(path) as f:
+                st = json.load(f)
+        except (OSError, ValueError):
+            return ""
+        rows = "".join(
+            f"<tr><td>{w.get('window')}</td>"
+            f"<td>{html.escape(','.join(w.get('families') or []))}</td>"
+            f"<td><code>{html.escape(str(w.get('fingerprint')))}"
+            "</code></td>"
+            f"<td>{len(w.get('novel') or [])}</td>"
+            f"<td>{w.get('epoch-restarts')}</td>"
+            f"<td>{w.get('outstanding')}</td>"
+            f"<td>{html.escape(str(w.get('error') or ''))}</td></tr>"
+            for w in (st.get("recent") or [])
+        )
+        return (
+            "<h2>live fault windows</h2>"
+            f"<p>{st.get('windows')} windows, "
+            f"{st.get('novel-windows')} novel, "
+            f"{st.get('coverage')} coverage features, "
+            f"frontier {st.get('frontier')} "
+            f"(families: {html.escape(','.join(st.get('families') or []))})"
+            "</p><table><tr><th>#</th><th>families</th>"
+            "<th>fingerprint</th><th>novel</th><th>epochs</th>"
+            "<th>outstanding</th><th>error</th></tr>"
+            f"{rows}</table>"
+        )
 
     def _monitor_roofline(self, root: str) -> str:
         """Roofline panel for /monitor: summarizes the profiles.jsonl

@@ -1,0 +1,399 @@
+"""Live-target monitor tests (monitor/live.py): source parity with the
+in-process `_OpSource` shapes, quarantine fast-fail, the nemesis
+driver's coverage growth + atomic search.json checkpoints, epoch-restart
+correlation in window records, resume restoring the search frontier,
+graceful signal shutdown, and the crash-between-inject-and-heal repair
+sweep — all against in-process fakes (the real-daemon path is
+tools/live_monitor_smoke.py's job)."""
+
+import json
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import core, telemetry
+from jepsen_tpu.control import health
+from jepsen_tpu.history import FAIL, INVOKE, Op
+from jepsen_tpu.models.registers import cas_register
+from jepsen_tpu.monitor import MonitorConfig, RollingChecker, run_monitor
+from jepsen_tpu.monitor import live
+from jepsen_tpu.nemesis import ledger, search
+
+
+@pytest.fixture
+def telem():
+    old = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.enable(old)
+
+
+# -- in-process fakes -----------------------------------------------------
+
+
+class FakeRegister:
+    """One linearizable register shared by every client of a key —
+    applied under a lock, so the emitted history really is
+    linearizable and the checker must say True."""
+
+    def __init__(self):
+        self.value = None
+        self.lock = threading.Lock()
+
+
+class FakeClient:
+    """Suite-client shaped: open returns a bound copy, invoke applies
+    the op to the shared register."""
+
+    def __init__(self, reg):
+        self.reg = reg
+
+    def open(self, test, node):
+        return self
+
+    def invoke(self, test, op):
+        with self.reg.lock:
+            if op.f == "read":
+                return op.complete("ok", value=self.reg.value)
+            if op.f == "write":
+                self.reg.value = op.value
+                return op.complete("ok")
+            old, new = op.value
+            if self.reg.value == old:
+                self.reg.value = new
+                return op.complete("ok")
+            return op.complete("fail")
+
+    def close(self, test):
+        pass
+
+
+def _fake_adapter(keys):
+    regs = [FakeRegister() for _ in range(keys)]
+    return {
+        "name": "fake",
+        "client": lambda test, key: FakeClient(regs[key]),
+        "node": lambda test, key: "n1",
+        "port": lambda test, node: 1,
+        "model": cas_register,
+        "with_cas": True,
+    }
+
+
+def _collect(src, n, deadline_s=10.0):
+    out = []
+    deadline = time.monotonic() + deadline_s
+    while len(out) < n and time.monotonic() < deadline:
+        ev = src.next_event(timeout=0.2)
+        if ev is not None:
+            out.append(ev)
+    return out
+
+
+# -- LiveSource parity ----------------------------------------------------
+
+
+def test_live_source_opsource_parity(telem):
+    """Events come out in the `_OpSource` shape: Op instances, invoke
+    before completion per process, process = key*procs+p, strictly
+    monotonic global index — and the emitted history linearizes."""
+    keys, procs = 2, 2
+    test = {"nodes": ["n1"]}
+    src = live.LiveSource(test, _fake_adapter(keys), keys=keys,
+                          procs_per_key=procs, rate=2000.0, seed=7)
+    src.start()
+    events = _collect(src, 400)
+    events += src.drain()
+    assert len(events) >= 400
+
+    last_index = 0
+    open_by_proc = {}
+    by_key = {}
+    for key, op in events:
+        assert isinstance(op, Op)
+        assert 0 <= key < keys
+        assert op.index > last_index
+        last_index = op.index
+        assert 0 <= op.process < keys * procs
+        assert op.process // procs == key
+        assert op.f in ("read", "write", "cas")
+        if op.type == INVOKE:
+            assert op.process not in open_by_proc
+            open_by_proc[op.process] = op
+        else:
+            assert op.type in ("ok", "fail", "info")
+            inv = open_by_proc.pop(op.process)
+            assert inv.f == op.f
+        by_key.setdefault(key, []).append(op)
+
+    checker = RollingChecker(cas_register().packed(), discard=True)
+    t = time.monotonic()
+    for key, kops in by_key.items():
+        checker.feed_many(key, kops, t)
+    verdicts = checker.finish()
+    assert verdicts and all(v is True for v in verdicts.values())
+
+
+def test_live_source_quarantine_fast_fail(telem):
+    """A quarantined node is never dialed: ops against it fail fast
+    with error=node-quarantined and the counter ticks."""
+
+    class NeverDial:
+        def open(self, test, node):
+            raise AssertionError("dialed a quarantined node")
+
+    test = {"nodes": ["n1"], "health-probe": lambda t, n: False}
+    hm = health.HealthMonitor(test)
+    test["node-health"] = hm
+    hm.quarantine("n1", "test")
+    adapter = dict(_fake_adapter(1),
+                   client=lambda t, key: NeverDial())
+    src = live.LiveSource(test, adapter, keys=1, procs_per_key=1,
+                          rate=500.0, seed=7)
+    try:
+        src.start()
+        events = _collect(src, 6)
+        events += src.drain()
+    finally:
+        hm.stop()
+    comps = [op for _, op in events if op.type != INVOKE]
+    assert comps, "no completions emitted"
+    assert all(op.type == FAIL for op in comps)
+    assert all(op.ext.get("error") == "node-quarantined" for op in comps)
+    assert telemetry.counter_value(
+        "monitor.live.fastfail-quarantined") > 0
+
+
+# -- LiveNemesisDriver ----------------------------------------------------
+
+
+class FakeNemesis:
+    """Counts invocations per f and journals ledger intent for the
+    wound ops, so window signatures differ per family the way real
+    nemesis packages make them differ."""
+
+    WOUNDS = ("kill", "pause", "partition", "start-partition")
+
+    def __init__(self, test):
+        self.test = test
+
+    def setup(self, test):
+        return self
+
+    def invoke(self, test, op):
+        telemetry.count(f"nemesis.fake-{op.f}")
+        if op.f in self.WOUNDS:
+            eid = ledger.intent(
+                test, op.f, nodes=["n1"],
+                compensator={"type": "none"}, tag=f"fake-{op.f}",
+            )
+            self._open = eid
+        elif getattr(self, "_open", None) is not None:
+            ledger.healed(test, entry_id=self._open)
+            self._open = None
+        return op
+
+    def teardown(self, test):
+        pass
+
+
+def _fake_compile(test):
+    def compile_schedule(sched, opts=None, *, nodes):
+        timeline = []
+        for i, ev in enumerate(sorted(sched.events, key=lambda e: e.t)):
+            t = 0.01 * (i + 1)
+            timeline.append((t, {"type": "info", "f": ev.family,
+                                 "value": ["n1"]}))
+            heal_f = {"kill": "start", "pause": "resume",
+                      "partition": "stop-partition"}[ev.family]
+            timeline.append((t + 0.01, {"type": "info", "f": heal_f,
+                                        "value": None}))
+        return {"nemesis": FakeNemesis(test), "generator": None,
+                "timeline": timeline, "horizon": 0.05}
+    return compile_schedule
+
+
+def _driver(tmp_path, test, statuses=None, families=("kill", "pause",
+                                                     "partition")):
+    it = iter(statuses or [])
+
+    def status():
+        try:
+            return next(it)
+        except StopIteration:
+            return {"epoch-restarts": 0}
+
+    return live.LiveNemesisDriver(
+        test, families=families, search_dir=str(tmp_path / "search"),
+        store_dir=str(tmp_path), seed=11, checker_status=status,
+        gap_s=0.01, seed_duration_s=0.05,
+    )
+
+
+def test_driver_coverage_grows_and_checkpoints(tmp_path, telem,
+                                               monkeypatch):
+    """The first per-family seed windows each land novel coverage
+    (strict growth across >= 3 windows), every window checkpoints a
+    valid search.json atomically (no .tmp residue), and the frontier
+    holds the novel genomes."""
+    monkeypatch.setattr(search, "compile_schedule",
+                        _fake_compile({}))
+    led = ledger.FaultLedger(ledger.ledger_path(str(tmp_path)))
+    test = {"nodes": ["n1"], "fault-ledger": led}
+    drv = _driver(tmp_path, test)
+    sizes = []
+    for _ in range(3):
+        drv._window()
+        sizes.append(len(drv.coverage))
+        state_path = tmp_path / "search" / search.STATE_FILE
+        assert state_path.is_file()
+        assert not (tmp_path / "search" / (
+            search.STATE_FILE + ".tmp")).exists()
+        state = json.loads(state_path.read_text())
+        assert state["windows"] == drv.windows
+    led.close()
+    assert sizes[0] < sizes[1] < sizes[2], sizes
+    assert drv.windows == 3
+    assert drv.frontier, "novel seed windows must enter the frontier"
+    # The per-window dossier and live-status.json landed too.
+    assert (tmp_path / "live-status.json").is_file()
+    status = json.loads((tmp_path / "live-status.json").read_text())
+    assert status["windows"] == 3 and status["coverage"] == sizes[-1]
+    # Ledger discipline: every fake wound was journaled and healed.
+    assert not led.outstanding()
+    assert telemetry.counter_value("monitor.live.windows") == 3
+    assert telemetry.counter_value("monitor.live.heals") > 0
+
+
+def test_driver_epoch_restart_correlation(tmp_path, telem, monkeypatch):
+    """A window that forces epoch restarts records the delta and calls
+    its verdict unknown (valid None), not invalid."""
+    monkeypatch.setattr(search, "compile_schedule", _fake_compile({}))
+    test = {"nodes": ["n1"]}
+    drv = _driver(tmp_path, test,
+                  statuses=[{"epoch-restarts": 1},
+                            {"epoch-restarts": 3}],
+                  families=("kill",))
+    drv._window()
+    (rec,) = drv.recent
+    assert rec["epoch-restarts"] == 2
+    sig = set()
+    for w in drv.coverage.features:
+        sig.add(w)
+    assert "v:test:None" in sig
+
+
+def test_driver_resume_restores_search_state(tmp_path, telem,
+                                             monkeypatch):
+    """A new driver over the same search dir resumes the coverage map,
+    window counter, and frontier from search.json."""
+    monkeypatch.setattr(search, "compile_schedule", _fake_compile({}))
+    test = {"nodes": ["n1"]}
+    drv = _driver(tmp_path, test)
+    for _ in range(3):
+        drv._window()
+    drv2 = _driver(tmp_path, test)
+    assert drv2.windows == 3
+    assert drv2.coverage.features == drv.coverage.features
+    assert len(drv2.frontier) == len(drv.frontier)
+    assert telemetry.counter_value("monitor.live.resumes") == 1
+    # And it keeps evolving from there, not from the seeds.
+    drv2._window()
+    assert drv2.windows == 4
+
+
+def test_driver_heals_on_stop_mid_window(tmp_path, telem, monkeypatch):
+    """The stop flag mid-window still runs the per-family final heals
+    (the `finally:` guarantee) — no outstanding intent survives."""
+    compile_fn = _fake_compile({})
+
+    def slow_compile(sched, opts=None, *, nodes):
+        pkg = compile_fn(sched, opts, nodes=nodes)
+        pkg["horizon"] = 30.0  # would quiesce forever without stop
+        return pkg
+
+    monkeypatch.setattr(search, "compile_schedule", slow_compile)
+    led = ledger.FaultLedger(ledger.ledger_path(str(tmp_path)))
+    test = {"nodes": ["n1"], "fault-ledger": led}
+    drv = _driver(tmp_path, test, families=("kill",))
+    drv.start()
+    deadline = time.monotonic() + 5.0
+    while (telemetry.counter_value("monitor.live.faults-injected") < 1
+           and time.monotonic() < deadline):
+        time.sleep(0.01)
+    drv.stop_and_join(timeout=10.0)
+    assert not drv.is_alive()
+    assert telemetry.counter_value("monitor.live.heals") >= 1
+    led.close()
+
+
+# -- crash-between-inject-and-heal repair sweep ---------------------------
+
+
+class FakeDB:
+    """Records start calls — the db-start compensator's target."""
+
+    def __init__(self):
+        self.started = []
+
+    def start(self, test, sess, node):
+        self.started.append(node)
+
+
+def test_sigkill_between_inject_and_heal_swept_by_repair(tmp_path):
+    """Satellite 3: a monitor killed between inject and heal leaves an
+    outstanding db-kill intent; the resume path's `core.repair` sweep
+    replays the db-start compensator and leaves zero residue."""
+    live_dir = tmp_path / "live"
+    live_dir.mkdir()
+    path = ledger.ledger_path(str(live_dir))
+    led = ledger.FaultLedger(path)
+    led.intent("process", nodes=["n1"],
+               compensator={"type": "db-start", "nodes": ["n1"]},
+               tag="db-kill")
+    # SIGKILL: no healed record, no close handshake — just reopen.
+    del led
+    assert len(ledger.read_outstanding(path)) == 1
+
+    db = FakeDB()
+    test = {"nodes": ["n1"], "ssh": {"dummy?": True}, "db": db}
+    report = core.repair(str(live_dir), test)
+    assert report["clean"], report
+    assert db.started == ["n1"]
+    assert not ledger.read_outstanding(path)
+    # Idempotent: a second sweep is a no-op.
+    report2 = core.repair(str(live_dir), dict(test))
+    assert report2["clean"] and not report2["healed"]
+
+
+# -- graceful signal shutdown ---------------------------------------------
+
+
+def test_monitor_sigterm_graceful_drain(tmp_path, telem):
+    """SIGTERM mid-run flips the stop flag: the loop drains, ticks a
+    final verdict, flushes, and persists the summary (satellite 1;
+    synthetic source — the live path is the smoke's job)."""
+    cfg = MonitorConfig(store_dir=str(tmp_path), rate=2000.0,
+                        duration_s=30.0, keys=2, procs_per_key=2,
+                        cadence_s=0.2)
+    timer = threading.Timer(
+        0.5, lambda: os.kill(os.getpid(), signal.SIGTERM))
+    timer.start()
+    t0 = time.monotonic()
+    try:
+        summary = run_monitor(cfg)
+    finally:
+        timer.cancel()
+    assert time.monotonic() - t0 < 15.0, "signal did not stop the loop"
+    assert summary["ops"] > 0
+    assert (tmp_path / "monitor-summary.json").is_file()
+    assert telemetry.counter_value("monitor.graceful-shutdowns") == 1
+    # The handler was restored: a second SIGTERM must not be swallowed
+    # by a stale monitor handler.
+    assert signal.getsignal(signal.SIGTERM) in (
+        signal.SIG_DFL, signal.default_int_handler)

@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""CI smoke for `jepsen monitor --suite` (tier1.yml step).
+
+One scenario, end to end against real kvdb daemons:
+
+  1. A live monitor subprocess drives the kvdb suite with an evolving
+     in-run fault schedule (kill + pause families).  It must complete
+     at least one fault window (live-status.json) with novel coverage.
+  2. The smoke polls the fault ledger and lands a SIGKILL in the
+     inject→heal gap, so the dying monitor strands outstanding intent
+     — the crash the repair sweep exists for.
+  3. A second monitor on the SAME store dir must sweep the residue
+     (`core.repair` replays the db-start compensator), resume the
+     search frontier from search.json, keep appending to the same
+     series files, and exit cleanly with zero outstanding intent and a
+     clean residue probe.
+
+Checks: >= 2 fault families injected AND healed (ledger records),
+coverage continuity (resumed map is a superset, window counter
+advances, >= 1 novel window), series continuity across the kill, zero
+residue at exit.  Exit 0 + "PASS" on success, exit 1 with a reason.
+CPU-only: the workflow runs it under JAX_PLATFORMS=cpu.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu.nemesis import ledger  # noqa: E402
+from jepsen_tpu.telemetry.timeseries import read_disk_series  # noqa: E402
+
+SERIES = "monitor.ops-per-s"
+
+
+class Failure(Exception):
+    pass
+
+
+def start_monitor(store: str, duration: float) -> subprocess.Popen:
+    return subprocess.Popen([
+        sys.executable, "-m", "jepsen_tpu.suites.kvdb", "monitor",
+        "--suite", "kvdb", "--store-dir", store,
+        "--search-dir", os.path.join(store, "search"),
+        "--live-faults", "kill,pause",
+        "--rate", "50", "--duration", str(duration),
+        "--keys", "2", "--procs-per-key", "2", "--cadence", "1",
+    ])
+
+
+def stop(proc: subprocess.Popen) -> None:
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait(timeout=10)
+
+
+def read_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def wait_first_window(store: str, proc: subprocess.Popen,
+                      deadline_s: float = 120.0) -> dict:
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise Failure(f"live monitor exited early "
+                          f"rc={proc.returncode}")
+        st = read_json(os.path.join(store, "live-status.json"))
+        if st.get("windows", 0) >= 1:
+            return st
+        time.sleep(0.2)
+    raise Failure("no fault window completed before the deadline")
+
+
+def kill_between_inject_and_heal(store: str, proc: subprocess.Popen,
+                                 deadline_s: float = 60.0) -> list:
+    """SIGKILL the monitor while the ledger holds outstanding intent —
+    i.e. a wound is open and its heal hasn't landed."""
+    path = ledger.ledger_path(os.path.join(store, "live"))
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            raise Failure(f"live monitor exited early "
+                          f"rc={proc.returncode}")
+        out = ledger.read_outstanding(path)
+        if out:
+            os.kill(proc.pid, signal.SIGKILL)
+            proc.wait(timeout=10)
+            return out
+    raise Failure("never caught the ledger with outstanding intent")
+
+
+def check_families_injected_and_healed(store: str) -> set:
+    """>= 2 fault families must have journaled intent AND a healed
+    record (by the run itself, the repair sweep, or teardown)."""
+    path = ledger.ledger_path(os.path.join(store, "live"))
+    records = ledger.read_records(path)
+    healed_ids = {r["id"] for r in records if r.get("rec") == "healed"}
+    healed_tags = set()
+    for r in records:
+        if r.get("rec") == "intent" and r["id"] in healed_ids:
+            healed_tags.add(r.get("tag"))
+    fams = {t for t in healed_tags if t in ("db-kill", "db-pause")}
+    if len(fams) < 2:
+        raise Failure(f"need >=2 families injected+healed, ledger "
+                      f"shows {sorted(healed_tags)}")
+    return fams
+
+
+def run() -> int:
+    tmp = tempfile.mkdtemp(prefix="live-monitor-smoke-")
+    store = os.path.join(tmp, "store")
+    proc = start_monitor(store, duration=300.0)
+    try:
+        st0 = wait_first_window(store, proc)
+        c0, w0 = st0["coverage"], st0["windows"]
+        if st0.get("novel-windows", 0) < 1:
+            raise Failure(f"first window landed no novel coverage: {st0}")
+        pre_pts = read_disk_series(store, SERIES)
+        stranded = kill_between_inject_and_heal(store, proc)
+    finally:
+        stop(proc)
+    t_kill = time.time()
+    print(f"  killed mid-window with outstanding "
+          f"{[(e.get('fault'), e.get('tag')) for e in stranded]}; "
+          f"{w0}+ windows, coverage {c0}")
+
+    proc = start_monitor(store, duration=20.0)
+    try:
+        rc = proc.wait(timeout=180)
+    finally:
+        stop(proc)
+    if rc not in (0, 2):
+        raise Failure(f"resumed monitor exited rc={rc}")
+
+    summary = read_json(os.path.join(store, "monitor-summary.json"))
+    live = summary.get("live") or {}
+    repair = live.get("repair-on-start") or {}
+    if not repair.get("healed"):
+        raise Failure(f"resume did not sweep the stranded intent: "
+                      f"{repair}")
+    residue = live.get("residue") or {}
+    if residue.get("clean") is not True:
+        raise Failure(f"residue probe not clean at exit: {residue}")
+    if live.get("outstanding-at-exit") != 0:
+        raise Failure(f"outstanding intent at exit: {live}")
+
+    fams = check_families_injected_and_healed(store)
+
+    sj = read_json(os.path.join(store, "search", "search.json"))
+    if sj.get("coverage") is None or len(sj["coverage"]) < c0:
+        raise Failure(f"coverage map shrank across resume: "
+                      f"{len(sj.get('coverage') or [])} < {c0}")
+    if sj.get("windows", 0) <= w0:
+        raise Failure(f"search did not advance past window {w0}: {sj}")
+    if sj.get("novel-windows", 0) < 1:
+        raise Failure(f"no novel coverage fingerprint: {sj}")
+
+    merged = read_disk_series(store, SERIES)
+    before = [t for t, _ in merged if t <= t_kill]
+    after = [t for t, _ in merged if t > t_kill]
+    if len(before) < len(pre_pts) or not after:
+        raise Failure(f"series not continuous across the kill: "
+                      f"{len(before)} pre + {len(after)} post")
+
+    print(f"  resume: repair healed {repair['healed']}, residue clean, "
+          f"families {sorted(fams)} injected+healed, search advanced "
+          f"{w0} -> {sj['windows']} windows "
+          f"(coverage {c0} -> {len(sj['coverage'])}, "
+          f"{sj['novel-windows']} novel), series {len(before)} pre + "
+          f"{len(after)} post samples")
+    print("PASS: live monitor injects+heals across real daemons, a "
+          "SIGKILL between inject and heal is swept on resume with "
+          "zero residue, and both the verdict stream and the search "
+          "frontier continue")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(run())
+    except Failure as e:
+        print(f"FAIL: {e}")
+        sys.exit(1)
