@@ -33,6 +33,8 @@ Flags (env):
                           20000000; "0" disables the scale point)
   JEPSEN_BENCH_MIXED_KEYS third-metric mixed-shape key count (default
                           200; "0" disables the mixed point)
+  JEPSEN_BENCH_FLEET_TENANTS  fleet-point tenant ceiling (default 16;
+                          "0" disables the fleet point)
 
 Capture trustworthiness: every measurement line carries "loadavg"
 (os.getloadavg at capture), "spread_ratio" (max/min over the measured
@@ -811,6 +813,124 @@ def run_mixed() -> int:
         return 1
 
 
+def run_fleet_scale() -> int:
+    """Fleet scale-point child (JEPSEN_BENCH_FLEET_CHILD=1): the
+    multi-tenant axis (ISSUE 20) gets a trajectory like
+    scale_ops_to_verdict has.  Ramps the number of concurrent monitor
+    tenants — each a real `jepsen monitor` child process with its own
+    rolling checker, series store, and pacing loop, exactly what a
+    FleetSupervisor child is minus the suite daemons — doubling 1, 2,
+    4, ... until a round breaks the verdict-lag SLO or the budget
+    runs out.  A round of N tenants is SUSTAINED when every tenant's
+    sampled `monitor.verdict-lag-s` series keeps its SLO burn under
+    5%: at most 5% of samples above the lag threshold AND a p95 under
+    it (one slow tick is absorbed; a shifted distribution is not).
+    Emits one JSON line,
+
+      {"metric": "fleet_tenants_sustained", "tenants": N,
+       "p95_verdict_lag_s": worst sustained p95, "rounds": [...]}
+
+    embedded under "fleet" in the main line by the parent."""
+    budget = float(os.environ.get("JEPSEN_BENCH_FLEET_BUDGET", "150"))
+    ceiling = int(os.environ.get("JEPSEN_BENCH_FLEET_TENANTS", "16"))
+    rate = float(os.environ.get("JEPSEN_BENCH_FLEET_RATE", "500"))
+    round_s = float(os.environ.get("JEPSEN_BENCH_FLEET_ROUND_S", "10"))
+    lag_slo = float(os.environ.get("JEPSEN_BENCH_FLEET_LAG_SLO", "5.0"))
+    burn_limit = 0.05
+    import shutil
+    import subprocess
+    import tempfile
+
+    from jepsen_tpu.telemetry.timeseries import read_disk_series
+
+    def round_of(n: int, tmp: str) -> dict:
+        dirs = [os.path.join(tmp, f"t{i}") for i in range(n)]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "jepsen_tpu.suites.kvdb",
+                 "monitor", "--store-dir", d, "--rate", str(rate),
+                 "--duration", str(round_s), "--keys", "2",
+                 "--cadence", "0.5"],
+                env=env, stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+            for d in dirs
+        ]
+        # Import + run + drain; a wedged tenant is an SLO miss, not a
+        # bench hang.
+        deadline = time.monotonic() + round_s + 90.0
+        rcs = []
+        for pr in procs:
+            try:
+                rcs.append(pr.wait(
+                    timeout=max(1.0, deadline - time.monotonic())))
+            except subprocess.TimeoutExpired:
+                pr.kill()
+                pr.wait()
+                rcs.append(-9)
+        worst_p95, worst_burn, samples = 0.0, 0.0, 0
+        for d in dirs:
+            pts = [v for _, v in
+                   read_disk_series(d, "monitor.verdict-lag-s")]
+            if len(pts) < 3:
+                return {"tenants": n, "sustained": False,
+                        "reason": f"tenant produced {len(pts)} lag "
+                                  f"samples (rcs={rcs})"}
+            pts.sort()
+            p95 = pts[int(0.95 * (len(pts) - 1))]
+            burn = sum(1 for v in pts if v > lag_slo) / len(pts)
+            worst_p95 = max(worst_p95, p95)
+            worst_burn = max(worst_burn, burn)
+            samples += len(pts)
+        ok = worst_burn < burn_limit and worst_p95 <= lag_slo
+        return {"tenants": n, "sustained": ok,
+                "p95_verdict_lag_s": round(worst_p95, 3),
+                "burn": round(worst_burn, 4), "samples": samples}
+
+    t0 = time.monotonic()
+    rounds, best = [], None
+    try:
+        n = 1
+        while n <= ceiling:
+            if time.monotonic() - t0 > budget:
+                rounds.append({"tenants": n,
+                               "skipped": "budget exhausted"})
+                break
+            tmp = tempfile.mkdtemp(prefix="bench-fleet-")
+            try:
+                r = round_of(n, tmp)
+            finally:
+                shutil.rmtree(tmp, ignore_errors=True)
+            rounds.append(r)
+            print(f"# fleet round: {r}", file=sys.stderr)
+            if not r.get("sustained"):
+                break
+            best = r
+            n *= 2
+        rec = {
+            "metric": "fleet_tenants_sustained",
+            "tenants": best["tenants"] if best else 0,
+            "p95_verdict_lag_s": (best or {}).get("p95_verdict_lag_s"),
+            "lag_slo_s": lag_slo,
+            "burn_limit": burn_limit,
+            "rate_per_tenant": rate,
+            "round_s": round_s,
+            "rounds": rounds,
+        }
+        print(json.dumps(rec))
+        return 0 if best else 1
+    except Exception as e:  # noqa: BLE001 — the JSON line must print
+        import traceback
+
+        traceback.print_exc(file=sys.stderr)
+        print(json.dumps({
+            "metric": "fleet_tenants_sustained", "tenants": 0,
+            "error": f"{type(e).__name__}: {e}", "rounds": rounds,
+        }))
+        return 1
+
+
 def record_scale_last_good(rec: dict) -> None:
     if rec.get("platform") != "tpu" or not rec.get("max_ops_at_300s"):
         return
@@ -920,6 +1040,8 @@ def main() -> int:
         return run_scale_online()
     if os.environ.get("JEPSEN_BENCH_MIXED_CHILD"):
         return run_mixed()
+    if os.environ.get("JEPSEN_BENCH_FLEET_CHILD"):
+        return run_fleet_scale()
     if os.environ.get("JEPSEN_BENCH_NO_WATCHDOG"):
         return run_bench()
     t_start = time.monotonic()
@@ -985,6 +1107,10 @@ def main() -> int:
             except Exception as e:  # noqa: BLE001
                 print(f"# online scale point failed: {e!r}",
                       file=sys.stderr)
+            try:
+                out = _with_fleet_point(out, env, t_start, wall_cap)
+            except Exception as e:  # noqa: BLE001
+                print(f"# fleet point failed: {e!r}", file=sys.stderr)
         sys.stdout.write(out)
         return proc.returncode
     except subprocess.TimeoutExpired as e:
@@ -1269,6 +1395,53 @@ def _with_scale_online_point(out: str, env: dict, t_start: float,
         except subprocess.TimeoutExpired:
             main_rec["scale_online"] = {
                 "skipped": "online scale child hit the wall deadline"
+            }
+    lines[main_i] = json.dumps(main_rec)
+    return "\n".join(lines) + "\n"
+
+
+def _with_fleet_point(out: str, env: dict, t_start: float,
+                     wall_cap: float) -> str:
+    """Runs the fleet scale child (multi-tenant sustained-capacity
+    metric, ISSUE 20) inside what's left of the wall cap and embeds
+    its record under "fleet" in the main JSON line.  Same hostage rule
+    as the other side metrics: any failure leaves the main line
+    untouched."""
+    import subprocess
+
+    if os.environ.get("JEPSEN_BENCH_FLEET_TENANTS", "") == "0":
+        return out
+    lines = out.splitlines()
+    main_i, main_rec = _last_json_line(out)
+    if main_rec is None or main_rec.get("value", 0) <= 0:
+        return out
+    wall_left = wall_cap - (time.monotonic() - t_start)
+    if wall_left < 90.0:
+        main_rec["fleet"] = {"skipped": "wall budget exhausted"}
+    else:
+        env2 = dict(
+            env,
+            JEPSEN_BENCH_FLEET_CHILD="1",
+            JEPSEN_BENCH_FLEET_BUDGET=str(
+                min(150.0, max(60.0, wall_left - 40.0))
+            ),
+        )
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                timeout=wall_left - 10.0, env=env2, capture_output=True,
+            )
+            sys.stderr.write(proc.stderr.decode(errors="replace"))
+            _, rec = _last_json_line(
+                proc.stdout.decode(errors="replace")
+            )
+            if rec is None:
+                rec = {"skipped": f"fleet child rc={proc.returncode}, "
+                                  "no JSON"}
+            main_rec["fleet"] = rec
+        except subprocess.TimeoutExpired:
+            main_rec["fleet"] = {
+                "skipped": "fleet child hit the wall deadline"
             }
     lines[main_i] = json.dumps(main_rec)
     return "\n".join(lines) + "\n"

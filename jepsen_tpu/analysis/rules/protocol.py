@@ -123,6 +123,13 @@ DECLARED_NAMESPACES = {
     "monitor.live": "live-target mode: suite-backed client pool, "
                     "in-run fault windows, daemon supervision "
                     "(monitor/live.py)",
+    "monitor.shed": "tee shed handling: deadline-aware backoff and "
+                    "retry on F_SHED instead of in-process fallback "
+                    "(monitor/loop.py)",
+    "fleet": "multi-tenant fleet supervisor: tenant lifecycle, "
+             "crash-loop parking, drains (monitor/fleet.py)",
+    "fleet.retention": "per-tenant disk-budgeted dossier/series GC "
+                       "(monitor/retention.py)",
     "alert": "alert router sink deliveries (monitor/alerts.py)",
 }
 

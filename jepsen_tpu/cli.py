@@ -380,6 +380,17 @@ def single_test_cmd(
     mo.add_argument("--endpoint", default=None, metavar="ADDR",
                     help="checkerd/router address to tee op windows to "
                     "for independent post-hoc verdicts")
+    mo.add_argument("--tenant", default=None, metavar="NAME",
+                    help="tenant identity on the checkerd tee (DRR "
+                    "fair-queue + shed accounting) and per-tenant "
+                    "SLO rules")
+    mo.add_argument("--tee-deadline", type=float, default=120.0,
+                    metavar="S",
+                    help="per-window verdict deadline on the tee; "
+                    "sheds back off and retry within it (default 120)")
+    mo.add_argument("--tee-window", type=int, default=4096,
+                    metavar="OPS",
+                    help="op events per teed window (default 4096)")
     mo.add_argument("--serve-port", type=int, default=None, metavar="P",
                     help="embed the web dashboard (/monitor) on this port")
     mo.add_argument("--no-discard", action="store_true",
@@ -423,6 +434,102 @@ def single_test_cmd(
                     help="don't restart daemons that die outside a "
                     "fault window")
     mo.set_defaults(_run=_run_monitor)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="supervised multi-tenant standing-verification fleet: "
+        "N tenants' live monitors against one checkerd federation, "
+        "with crash-safe registry, per-tenant isolation, quotas, "
+        "SLOs, and disk retention",
+    )
+    flsub = fl.add_subparsers(dest="fleet_cmd", required=True)
+
+    def _fleet_common(p: argparse.ArgumentParser) -> None:
+        p.add_argument("--dir", default="store/fleet", dest="fleet_dir",
+                       help="fleet root (fleet.json registry, "
+                       "fleet-status.json, tenants/<name>/store)")
+
+    fr = flsub.add_parser("run", help="run the supervisor")
+    _fleet_common(fr)
+    fr.add_argument("--endpoint", default=None, metavar="ADDR",
+                    help="fleet-wide checkerd/router tee address "
+                    "(per-tenant --endpoint overrides)")
+    fr.add_argument("--tick", type=float, default=1.0, metavar="S",
+                    help="reconcile cadence (default 1)")
+    fr.add_argument("--park-after", type=int, default=3, metavar="K",
+                    help="crash-loops before a tenant is parked "
+                    "(default 3)")
+    fr.add_argument("--min-uptime", type=float, default=5.0,
+                    metavar="S",
+                    help="a child dying sooner counts as a crash-loop "
+                    "(default 5)")
+    fr.add_argument("--drain-timeout", type=float, default=20.0,
+                    metavar="S",
+                    help="SIGTERM drain grace before SIGKILL "
+                    "(default 20)")
+    fr.add_argument("--retention-interval", type=float, default=30.0,
+                    metavar="S",
+                    help="seconds between retention sweeps "
+                    "(default 30)")
+    fr.set_defaults(_run=_run_fleet)
+
+    fa = flsub.add_parser("add", help="register a tenant")
+    _fleet_common(fa)
+    fa.add_argument("--tenant", required=True, metavar="NAME")
+    fa.add_argument("--suite", default="kvdb",
+                    choices=["kvdb", "logd", "electd", "txnd", "repkv"])
+    fa.add_argument("--node", action="append", default=[],
+                    metavar="NAME", dest="nodes",
+                    help="cluster node owned by this tenant "
+                    "(repeatable; must not overlap another tenant's)")
+    fa.add_argument("--rate", type=float, default=50.0)
+    fa.add_argument("--duration", type=float, default=3600.0,
+                    metavar="S",
+                    help="epoch length; clean exits restart (default "
+                    "3600)")
+    fa.add_argument("--keys", type=int, default=2)
+    fa.add_argument("--procs-per-key", type=int, default=2)
+    fa.add_argument("--cadence", type=float, default=1.0, metavar="S")
+    fa.add_argument("--live-faults", default=None, metavar="FAMS")
+    fa.add_argument("--sink", action="append", default=[],
+                    metavar="SPEC")
+    fa.add_argument("--endpoint", default=None, metavar="ADDR",
+                    help="tenant-specific tee address")
+    fa.add_argument("--weight", type=float, default=1.0,
+                    help="DRR fair-queue weight (daemon-side "
+                    "--tenant-weight should match)")
+    fa.add_argument("--deadline", type=float, default=120.0,
+                    metavar="S", help="tee verdict deadline")
+    fa.add_argument("--tee-window", type=int, default=4096,
+                    metavar="OPS",
+                    help="op events per teed window (default 4096)")
+    fa.add_argument("--retain-dossiers", type=int, default=64,
+                    metavar="N",
+                    help="max dossiers kept per sweep (default 64)")
+    fa.add_argument("--retain-days", type=float, default=14.0,
+                    metavar="D",
+                    help="age ceiling for dossiers and rotated series "
+                    "(default 14)")
+    fa.add_argument("--retain-bytes", type=int, default=None,
+                    metavar="B",
+                    help="total dossier+series disk budget")
+    fa.set_defaults(_run=_run_fleet)
+
+    for verb, h in (("remove", "unregister a tenant"),
+                    ("drain", "gracefully stop a tenant (stays "
+                     "registered)"),
+                    ("resume", "restart a drained or parked tenant"),
+                    ("restart", "rolling restart through the SIGTERM "
+                     "drain path")):
+        fv = flsub.add_parser(verb, help=h)
+        _fleet_common(fv)
+        fv.add_argument("--tenant", required=True, metavar="NAME")
+        fv.set_defaults(_run=_run_fleet)
+
+    fs = flsub.add_parser("status", help="print registry + supervisor "
+                          "status")
+    _fleet_common(fs)
+    fs.set_defaults(_run=_run_fleet)
 
     return parser
 
@@ -704,6 +811,9 @@ def _run_monitor(opts) -> int:
         sinks=tuple(opts.sink),
         inject_slo_s=opts.inject_slo,
         endpoint=opts.endpoint,
+        tenant=opts.tenant,
+        tee_deadline_s=opts.tee_deadline,
+        tee_window_ops=opts.tee_window,
         serve_port=opts.serve_port,
         suite=opts.suite,
         nodes=tuple(opts.nodes),
@@ -731,6 +841,89 @@ def _run_monitor(opts) -> int:
         f"series in {opts.store_dir}"
     )
     return EXIT_VALID if summary["unknown_keys"] == 0 else EXIT_UNKNOWN
+
+
+def _run_fleet(opts: argparse.Namespace) -> int:
+    """`jepsen fleet <verb>` — registry mutations are tiny CLI calls
+    (safe against a running supervisor via the registry lock); `run`
+    is the supervisor itself."""
+    import signal
+    import threading
+
+    from .monitor.fleet import (FleetRegistry, FleetSupervisor,
+                                TenantSpec, read_status)
+
+    root = os.path.abspath(opts.fleet_dir)
+    cmd = opts.fleet_cmd
+    if cmd == "run":
+        sup = FleetSupervisor(
+            root, endpoint=opts.endpoint, tick_s=opts.tick,
+            park_after=opts.park_after, min_uptime_s=opts.min_uptime,
+            drain_timeout_s=opts.drain_timeout,
+            retention_interval_s=opts.retention_interval,
+        )
+        stop = threading.Event()
+        for sig in (signal.SIGINT, signal.SIGTERM):
+            signal.signal(sig, lambda *_: stop.set())
+        print(f"==> fleet supervisor on {root} "
+              f"(endpoint {opts.endpoint or 'in-process'})")
+        return sup.run(stop)
+
+    reg = FleetRegistry(root)
+    if cmd == "add":
+        spec = TenantSpec(
+            name=opts.tenant, suite=opts.suite,
+            nodes=tuple(opts.nodes), rate=opts.rate,
+            duration_s=opts.duration, keys=opts.keys,
+            procs_per_key=opts.procs_per_key, cadence_s=opts.cadence,
+            live_faults=tuple(
+                f.strip() for f in (opts.live_faults or "").split(",")
+                if f.strip()),
+            sinks=tuple(opts.sink), endpoint=opts.endpoint,
+            weight=opts.weight, deadline_s=opts.deadline,
+            tee_window_ops=opts.tee_window,
+            retain_dossiers=opts.retain_dossiers,
+            retain_days=opts.retain_days,
+            retain_bytes=opts.retain_bytes,
+        )
+        try:
+            reg.add(spec)
+        except ValueError as e:
+            print(f"fleet add: {e}")
+            return EXIT_USAGE
+        print(f"==> tenant {opts.tenant} registered "
+              f"(suite {opts.suite}, weight {opts.weight})")
+        return EXIT_VALID
+    if cmd == "remove":
+        reg.remove(opts.tenant)
+        print(f"==> tenant {opts.tenant} removed")
+        return EXIT_VALID
+    if cmd in ("drain", "resume", "restart"):
+        try:
+            if cmd == "drain":
+                reg.set_state(opts.tenant, "drained")
+            elif cmd == "resume":
+                reg.set_state(opts.tenant, "running")
+            else:
+                reg.bump_generation(opts.tenant)
+        except ValueError as e:
+            print(f"fleet {cmd}: {e}")
+            return EXIT_USAGE
+        print(f"==> tenant {opts.tenant} {cmd} requested")
+        return EXIT_VALID
+    # status
+    tenants = reg.load()
+    st = read_status(root)
+    live = st.get("tenants") or {}
+    print(f"fleet {root}: {len(tenants)} tenant(s)")
+    for name, spec in sorted(tenants.items()):
+        row = live.get(name) or {}
+        print(f"  {name:16s} {spec.state:8s} suite={spec.suite} "
+              f"gen={spec.generation} alive={row.get('alive')} "
+              f"restarts={row.get('restarts', 0)} "
+              f"crash-loops={row.get('crash-loops', 0)} "
+              f"disk={row.get('disk-bytes', 0)}")
+    return EXIT_VALID
 
 
 def run(parser: argparse.ArgumentParser, argv: Optional[Sequence[str]] = None) -> int:

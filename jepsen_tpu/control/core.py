@@ -124,6 +124,16 @@ class Remote:
     copy bound to a conn spec; bound remotes execute actions and move
     files."""
 
+    #: Capability probe for machine-global fault families.  A remote
+    #: that executes on a machine *shared with the control host* (the
+    #: default: LocalRemote, DummyRemote) isolates nothing — clock
+    #: skew or packet-level interference there wounds the harness
+    #: itself, so nemesis callers must skip those families.  Remotes
+    #: that reach a genuinely separate failure domain declare what
+    #: they isolate: ``"net"`` (packet faults stay on the target) and
+    #: ``"clock"`` (time faults stay on the target).
+    isolation: frozenset = frozenset()
+
     def connect(self, spec: ConnSpec) -> "Remote":
         raise NotImplementedError
 

@@ -279,6 +279,10 @@ class NetnsRemote(Remote):
     copies (shared mount namespace — the docker-remote trade-off,
     control/docker.clj:30-92, applied to netns)."""
 
+    # Packet faults land inside the node's private netns and cannot
+    # wound the control host; the clock stays machine-global.
+    isolation = frozenset({"net"})
+
     def __init__(self, cluster: NetnsCluster):
         self.cluster = cluster
         self.spec: Optional[ConnSpec] = None

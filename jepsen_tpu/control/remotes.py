@@ -115,6 +115,10 @@ class SshCliRemote(Remote):
     sshj library + an scp subprocess; control/scp.clj:29-57).  Gated:
     raises RemoteError at connect time if ssh isn't installed."""
 
+    # A real host over ssh is its own failure domain: packet and
+    # clock faults stay on the target machine.
+    isolation = frozenset({"net", "clock"})
+
     def __init__(self):
         self.spec: Optional[ConnSpec] = None
 
@@ -231,6 +235,11 @@ class DockerRemote(Remote):
     """docker exec / docker cp transport (control/docker.clj:30-92); the
     node name is the container name."""
 
+    # A container has its own netns, so packet faults are contained;
+    # the clock is the host's — skewing it would wound the control
+    # host too, so "clock" is deliberately absent.
+    isolation = frozenset({"net"})
+
     def __init__(self):
         self.spec: Optional[ConnSpec] = None
 
@@ -285,6 +294,10 @@ class K8sRemote(Remote):
     """kubectl exec / kubectl cp transport (control/k8s.clj:14-60); the
     node name is the pod name.  Optional kubectl context/namespace are
     fixed at construction — ConnSpec carries only the pod."""
+
+    # A pod runs on a separate cluster node: both packet and clock
+    # faults stay on the target's machine, not the control host.
+    isolation = frozenset({"net", "clock"})
 
     def __init__(self, context: Optional[str] = None,
                  namespace: Optional[str] = None):
@@ -368,6 +381,12 @@ class RetryRemote(Remote):
         self.spec: Optional[ConnSpec] = None
         self.bound: Optional[Remote] = None
         self._lock = threading.Lock()
+
+    @property
+    def isolation(self) -> frozenset:
+        # Retry is transparent: the failure domain is the wrapped
+        # transport's.
+        return self.inner.isolation
 
     def connect(self, spec: ConnSpec) -> "RetryRemote":
         r = RetryRemote(self.inner)

@@ -725,12 +725,22 @@ class LiveContext:
             return fams
         if allowed:
             return tuple(allowed)
-        # Locally-safe defaults: node-down families only (packet and
-        # clock wound the whole machine under a LocalRemote), plus
-        # partition where there is more than one node to part.
+        # Capability-probed defaults: node-down families are always
+        # safe; partition needs more than one node to part; packet
+        # and clock faults are machine-global unless the transport
+        # declares it isolates them (Remote.isolation) — a LocalRemote
+        # tenant skips them, an ssh/k8s/netns-backed cluster gets the
+        # full family set.
+        fams = ["kill", "pause"]
         if len(test.get("nodes") or []) > 1:
-            return ("partition", "kill", "pause")
-        return ("kill", "pause")
+            fams.insert(0, "partition")
+        isolation = getattr(test.get("remote"), "isolation",
+                            frozenset())
+        if "net" in isolation:
+            fams.append("packet")
+        if "clock" in isolation:
+            fams.append("clock")
+        return tuple(fams)
 
     # -- shutdown -------------------------------------------------------
 

@@ -89,6 +89,8 @@ class MonitorConfig:
     # integration
     endpoint: Optional[str] = None   # checkerd/router tee address
     tee_window_ops: int = 4096
+    tenant: Optional[str] = None     # DRR identity on the tee SUBMIT
+    tee_deadline_s: float = 120.0    # per-window verdict deadline
     serve_port: Optional[int] = None
     extra_rules: tuple = field(default_factory=tuple)
     # live (suite-backed) mode — monitor/live.py
@@ -168,14 +170,27 @@ class _Tee:
     """Best-effort checkerd tee: windows of op dicts are submitted to
     a daemon/router for an independent post-hoc verdict.  A bounded
     queue + worker thread; a slow or dead daemon drops windows
-    (counted), never stalls the monitor."""
+    (counted), never stalls the monitor.
 
-    def __init__(self, endpoint: str, keys: int, run_id: str):
+    Overload handling: an `F_SHED` from the daemon's admission path is
+    *not* a daemon failure — treating it as one (the old behaviour)
+    permanently degraded the tee to in-process checking, silently
+    un-sharing the fleet.  Sheds now back off for the server-provided
+    `retry-after-s` (bounded by MAX_SHED_WAIT_S) and retry while the
+    window's deadline budget can still cover another attempt, counted
+    under `monitor.shed.*`; only a truly unmeetable deadline drops the
+    window."""
+
+    def __init__(self, endpoint: str, keys: int, run_id: str,
+                 tenant: Optional[str] = None,
+                 deadline_s: float = 120.0):
         from ..checkerd.protocol import model_to_spec
 
         self.endpoint = endpoint
         self.keys = keys
         self.run_id = run_id
+        self.tenant = tenant
+        self.deadline_s = deadline_s
         self.spec = model_to_spec(cas_register()) or {}
         self.q: queue.Queue = queue.Queue(maxsize=4)
         self.windows: list[list[dict]] = [[] for _ in range(keys)]
@@ -202,20 +217,51 @@ class _Tee:
         self.windows = [[] for _ in range(self.keys)]
         self.pending_events = 0
 
-    def _work(self) -> None:
+    def _submit_once(self, run: str, windows: list,
+                     budget_s: float) -> dict:
         from ..checkerd.client import CheckerdClient
+
+        with CheckerdClient(self.endpoint) as c:
+            ticket = c.submit_ops(run, self.spec, windows,
+                                  tenant=self.tenant,
+                                  deadline_s=self.deadline_s)
+            return c.wait(ticket, deadline_s=budget_s)
+
+    def _work(self) -> None:
+        from ..checkerd.client import MAX_SHED_WAIT_S, ShedByServer
 
         while True:
             run, windows = self.q.get()
+            deadline = time.monotonic() + self.deadline_s
             try:
-                with CheckerdClient(self.endpoint) as c:
-                    ticket = c.submit_ops(run, self.spec, windows)
-                    res = c.wait(ticket, deadline_s=120.0)
-                valid = (res.get("result") or {}).get("valid")
-                telemetry.count(
-                    "monitor.tee-valid" if valid is True
-                    else "monitor.tee-nonvalid"
-                )
+                while True:
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        telemetry.count("monitor.shed.deadline-unmet")
+                        log.warning("monitor tee %s: window %s shed "
+                                    "past its %.0fs deadline, dropped",
+                                    self.endpoint, run, self.deadline_s)
+                        break
+                    try:
+                        res = self._submit_once(run, windows, remaining)
+                    except ShedByServer as e:
+                        # Overload, not failure: honour the server's
+                        # retry-after (bounded) and try again while
+                        # the deadline budget allows.
+                        wait = min(max(e.retry_after_s, 0.05),
+                                   MAX_SHED_WAIT_S,
+                                   deadline - time.monotonic())
+                        if wait <= 0:
+                            continue  # deadline check drops it
+                        telemetry.count("monitor.shed.backoffs")
+                        time.sleep(wait)
+                        continue
+                    valid = (res.get("result") or {}).get("valid")
+                    telemetry.count(
+                        "monitor.tee-valid" if valid is True
+                        else "monitor.tee-nonvalid"
+                    )
+                    break
             except Exception as e:  # noqa: BLE001 — tee is best-effort
                 telemetry.count("monitor.tee-errors")
                 log.warning("monitor tee %s failed: %r",
@@ -275,6 +321,8 @@ def run_monitor(cfg: MonitorConfig,
     rules = list(slo.DEFAULT_RULES) + list(slo.MONITOR_RULES)
     if cfg.suite:
         rules += list(slo.LIVE_MONITOR_RULES)
+    if cfg.tenant:
+        rules += list(slo.TENANT_RULES)
     rules += list(cfg.extra_rules)
     if cfg.inject_slo_s > 0:
         rules.append(slo.Rule(
@@ -338,7 +386,8 @@ def run_monitor(cfg: MonitorConfig,
     else:
         source = _OpSource(cfg.keys, cfg.procs_per_key, cfg.seed,
                            cfg.info_rate)
-    tee = (_Tee(cfg.endpoint, cfg.keys, f"monitor-{os.getpid()}")
+    tee = (_Tee(cfg.endpoint, cfg.keys, f"monitor-{os.getpid()}",
+                tenant=cfg.tenant, deadline_s=cfg.tee_deadline_s)
            if cfg.endpoint else None)
     server = None
     if cfg.serve_port is not None:

@@ -144,6 +144,24 @@ LIVE_MONITOR_RULES: tuple[Rule, ...] = (
          "monitor.live.outstanding", 0.5, for_count=3),
 )
 
+#: Per-tenant rules a fleet member (`jepsen monitor --tenant`) adds:
+#: each tenant's monitor evaluates these against its *own* counters
+#: into its *own* slo.jsonl, so one tenant's shed storm or epoch
+#: churn alerts that tenant's sinks without paging the fleet.  A
+#: sustained shed-backoff rate means the tenant's DRR share can't
+#: cover its offered load (weight or deadline needs attention); a
+#: deadline-unmet means verification work was actually dropped;
+#: epoch restarts at a sustained rate mean the rolling checker keeps
+#: losing its prefix-discard invariant.
+TENANT_RULES: tuple[Rule, ...] = (
+    Rule("tenant-shed-backoff-rate", "counter-rate-above",
+         "monitor.shed.backoffs", 2.0, for_count=3),
+    Rule("tenant-shed-deadline-unmet", "counter-above",
+         "monitor.shed.deadline-unmet", 0.0),
+    Rule("tenant-epoch-restart-rate", "counter-rate-above",
+         "monitor.epoch-restarts", 0.1, for_count=3),
+)
+
 
 class SLOEngine:
     """Evaluates a rule set against registry snapshots and journals

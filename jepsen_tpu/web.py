@@ -155,6 +155,89 @@ _MONITOR_JS = """
 </script>
 """
 
+_FLEET_JS = """
+<script>
+(function () {
+  'use strict';
+  function fmtBytes(b) {
+    if (b > 1048576) { return (b / 1048576).toFixed(1) + 'M'; }
+    if (b > 1024) { return (b / 1024).toFixed(1) + 'K'; }
+    return String(b);
+  }
+  function drawSpark(canvas, pts) {
+    var ctx = canvas.getContext('2d');
+    var W = canvas.width, H = canvas.height;
+    ctx.clearRect(0, 0, W, H);
+    if (!pts.length) { return; }
+    var vs = pts.map(function (p) { return p[1]; });
+    var mn = Math.min.apply(null, vs), mx = Math.max.apply(null, vs);
+    var span = (mx - mn) || 1;
+    ctx.strokeStyle = '#069';
+    ctx.beginPath();
+    pts.forEach(function (p, i) {
+      var x = i / Math.max(1, pts.length - 1) * (W - 2) + 1;
+      var y = H - 2 - (p[1] - mn) / span * (H - 4);
+      if (i === 0) { ctx.moveTo(x, y); } else { ctx.lineTo(x, y); }
+    });
+    ctx.stroke();
+  }
+  var sparks = {};   // tenant -> points
+  var streams = {};  // tenant -> EventSource
+  function ensureStream(name, dir) {
+    if (streams[name]) { return; }
+    var es = new EventSource(
+      '/api/series/stream?dir=' + encodeURIComponent(dir));
+    es.onmessage = function (ev) {
+      var p = JSON.parse(ev.data);
+      var v = (p.s || {})['monitor.ops-per-s'];
+      if (v === undefined) { return; }
+      var pts = sparks[name] = sparks[name] || [];
+      pts.push([p.t, v]);
+      if (pts.length > 60) { pts.shift(); }
+      var row = document.getElementById('t-' + name);
+      if (row) { drawSpark(row.querySelector('.spark'), pts); }
+    };
+    streams[name] = es;
+  }
+  function refresh() {
+    fetch('/api/fleet')
+      .then(function (r) { return r.json(); })
+      .then(function (d) {
+        Object.keys(d.tenants || {}).forEach(function (name) {
+          var t = d.tenants[name];
+          var row = document.getElementById('t-' + name);
+          if (!row) { return; }
+          var sup = t.supervisor || {};
+          var state = (t.spec || {}).state || '?';
+          if (sup.alive === false && state === 'running') {
+            state += ' (down)';
+          }
+          row.querySelector('.state').textContent = state;
+          var firing = t['slo-firing'] || [];
+          var slo = row.querySelector('.slo');
+          slo.textContent = firing.length ? firing.join(', ') : 'ok';
+          slo.style.color = firing.length ? '#b00' : '#080';
+          row.querySelector('.restarts').textContent =
+            String(sup.restarts || 0);
+          row.querySelector('.shed').textContent =
+            firing.indexOf('tenant-shed-backoff-rate') >= 0 ?
+            'backing off' : 'ok';
+          row.querySelector('.disk').textContent =
+            fmtBytes(t['disk-bytes'] || 0);
+          if (!sparks[name] && (t.spark || []).length) {
+            sparks[name] = t.spark.slice(-60);
+            drawSpark(row.querySelector('.spark'), sparks[name]);
+          }
+          ensureStream(name, t.dir);
+        });
+      });
+  }
+  refresh();
+  setInterval(refresh, 5000);
+})();
+</script>
+"""
+
 #: {run_dir: (jtpu mtime, validity)} so the index doesn't re-scan every
 #: test file on every page load (web.clj:51-66 caches its rows too).
 _validity_cache: dict[str, tuple[float, str]] = {}
@@ -316,6 +399,8 @@ class Handler(http.server.BaseHTTPRequestHandler):
                 self._series_stream()
             elif path.rstrip("/") == "/api/series":
                 self._series_api()
+            elif path.rstrip("/") == "/api/fleet":
+                self._fleet_api()
             else:
                 self._send(404, _page("404", "<p>not found</p>"))
         except (BrokenPipeError, ConnectionResetError):
@@ -796,10 +881,86 @@ class Handler(http.server.BaseHTTPRequestHandler):
         finally:
             tail.close()
 
+    def _fleet_root(self) -> Optional[str]:
+        """Directory holding a fleet registry (fleet.json): the store
+        dir itself or a contained ?dir= subdir."""
+        root = os.path.realpath(self.store_dir)
+        sub = (self._query.get("dir") or [""])[0].strip("/")
+        if sub:
+            cand = os.path.realpath(os.path.join(root, sub))
+            if not (cand == root or cand.startswith(root + os.sep)):
+                return None
+            root = cand
+        from .monitor.fleet import FLEET_FILE
+        if os.path.isfile(os.path.join(root, FLEET_FILE)):
+            return root
+        return None
+
+    def _fleet_api(self) -> None:
+        """JSON fleet overview: registry + supervisor status + one
+        summary/SLO/sparkline row per tenant, read straight from each
+        tenant's own store dir (crash-safe files only, so this works
+        with the supervisor dead)."""
+        from .monitor import fleet as mfleet
+        from .monitor.retention import disk_bytes
+        from .telemetry import slo as tslo
+        from .telemetry import timeseries
+
+        root = self._fleet_root()
+        if root is None:
+            self._send(404, b'{"error": "no fleet registry found"}',
+                       "application/json")
+            return
+        registry = mfleet.FleetRegistry(root).load()
+        status = mfleet.read_status(root)
+        sup_rows = status.get("tenants") or {}
+        tenants = {}
+        for name, spec in sorted(registry.items()):
+            tstore = mfleet.tenant_store_dir(root, name)
+            row: dict = {
+                "spec": spec.to_json(),
+                "supervisor": sup_rows.get(name) or {},
+                "dir": os.path.relpath(
+                    tstore, os.path.realpath(self.store_dir)),
+            }
+            for fname, key in (("monitor-summary.json", "summary"),
+                               ("live-status.json", "live")):
+                try:
+                    with open(os.path.join(tstore, fname)) as f:
+                        row[key] = json.load(f)
+                except (OSError, ValueError):
+                    row[key] = {}
+            last: dict = {}
+            for rec in tslo.read(tslo.slo_path(tstore)):
+                last[rec.get("rule")] = rec
+            row["slo-firing"] = sorted(
+                r for r, rec in last.items()
+                if rec.get("rec") == "firing")
+            row["disk-bytes"] = (disk_bytes(tstore)
+                                 if os.path.isdir(tstore) else 0)
+            try:
+                row["spark"] = timeseries.read_disk_series(
+                    tstore, "monitor.ops-per-s", limit=60)
+            except OSError:
+                row["spark"] = []
+            tenants[name] = row
+        body = {"t": status.get("t"), "root": root,
+                "endpoint": status.get("endpoint"),
+                "tenants": tenants}
+        self._send(200, json.dumps(body).encode(), "application/json")
+
     def _monitor(self) -> None:
         """Live observatory for a `jepsen monitor` run: one sparkline
         per stored series (pinned ones first), bootstrapped from
-        /api/series and updated over the SSE stream."""
+        /api/series and updated over the SSE stream.  When the store
+        dir is a fleet root (fleet.json) and no ?dir= selects a
+        tenant, renders the fleet-scale view instead: one row per
+        tenant, linking into each tenant's own dashboard."""
+        if not (self._query.get("dir") or [""])[0].strip("/"):
+            froot = self._fleet_root()
+            if froot is not None:
+                self._fleet_view(froot)
+                return
         root = self._series_root()
         if root is None:
             # No series yet — the roofline panel still renders off any
@@ -854,6 +1015,38 @@ class Handler(http.server.BaseHTTPRequestHandler):
             + _slo_panel()
         )
         self._send(200, _page("monitor observatory", body))
+
+    def _fleet_view(self, froot: str) -> None:
+        """Fleet-scale /monitor: one row per tenant (state, verdict
+        sparkline, SLO state, restarts, shed backoffs, disk bytes),
+        bootstrapped from /api/fleet (polled) with the sparkline kept
+        live over each tenant's own SSE series stream."""
+        from .monitor.fleet import FleetRegistry
+
+        names = sorted(FleetRegistry(froot).load())
+        rows = "".join(
+            f"<tr id='t-{html.escape(n)}'>"
+            f"<td><a href='/monitor?dir=tenants/"
+            f"{urllib.parse.quote(n)}/store'>{html.escape(n)}</a></td>"
+            "<td class='state'>–</td>"
+            "<td><canvas class='spark' width='180' height='28'>"
+            "</canvas></td>"
+            "<td class='slo'>–</td><td class='restarts'>–</td>"
+            "<td class='shed'>–</td><td class='disk'>–</td></tr>"
+            for n in names
+        )
+        body = (
+            f"<p>fleet root: <code>{html.escape(froot)}</code> · "
+            f"{len(names)} tenant(s) · "
+            "<a href='/api/fleet'>fleet API</a> · "
+            "<a href='/metrics'>metrics</a></p>"
+            "<table><tr><th>tenant</th><th>state</th>"
+            "<th>ops/s</th><th>SLO</th><th>restarts</th>"
+            "<th>shed</th><th>disk</th></tr>"
+            f"{rows}</table>"
+            + _FLEET_JS
+        )
+        self._send(200, _page("fleet observatory", body))
 
     def _monitor_faults(self, root: str) -> str:
         """Fault-timeline panel for a live (`--suite`) monitor:

@@ -1,0 +1,539 @@
+"""Multi-tenant fleet tests (monitor/fleet.py + monitor/retention.py):
+crash-safe registry semantics (journal-then-snapshot, torn tails,
+replay past a stale snapshot), supervision isolation (one tenant's
+crash-loop parks only that tenant while siblings keep running),
+cross-tenant nemesis rejection, rolling restart via generation bump,
+the tee's shed-backoff path, the capability-probed fault families,
+and the retention sweeper's invariants — all against fake child
+processes (the real-daemon path is tools/fleet_smoke.py's job)."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from jepsen_tpu import telemetry
+from jepsen_tpu.monitor.fleet import (FleetRegistry, FleetSupervisor,
+                                      TenantSpec, read_status,
+                                      tenant_store_dir)
+from jepsen_tpu.monitor.retention import (RetentionPolicy, disk_bytes,
+                                          sweep)
+
+
+@pytest.fixture
+def telem():
+    old = telemetry.enabled()
+    telemetry.enable(True)
+    telemetry.reset()
+    yield
+    telemetry.reset()
+    telemetry.enable(old)
+
+
+# -- fake children --------------------------------------------------------
+
+
+def crashing_child(spec, store, endpoint):
+    return subprocess.Popen([sys.executable, "-c",
+                             "import sys; sys.exit(3)"])
+
+
+def steady_child(spec, store, endpoint):
+    """A long-lived child that appends a heartbeat line ~20x/s — the
+    continuity signal the isolation tests assert on."""
+    hb = os.path.join(store, "heartbeat.txt")
+    return subprocess.Popen([sys.executable, "-c", (
+        "import sys, time\n"
+        "while True:\n"
+        f"    f = open({hb!r}, 'a'); f.write('x\\n'); f.close()\n"
+        "    time.sleep(0.05)\n"
+    )])
+
+
+def heartbeats(root, name):
+    hb = os.path.join(tenant_store_dir(root, name), "heartbeat.txt")
+    try:
+        with open(hb) as f:
+            return sum(1 for _ in f)
+    except OSError:
+        return 0
+
+
+def make_supervisor(root, spawn, **kw):
+    kw.setdefault("tick_s", 0.05)
+    kw.setdefault("park_after", 2)
+    kw.setdefault("min_uptime_s", 0.5)
+    kw.setdefault("breaker_base_s", 0.05)
+    kw.setdefault("breaker_max_s", 0.2)
+    kw.setdefault("drain_timeout_s", 5.0)
+    kw.setdefault("retention_interval_s", 3600.0)
+    return FleetSupervisor(root, spawn=spawn, **kw)
+
+
+def run_supervisor(sup):
+    stop = threading.Event()
+    th = threading.Thread(target=sup.run, args=(stop,), daemon=True)
+    th.start()
+    return stop, th
+
+
+def wait_for(pred, timeout_s=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+# -- registry -------------------------------------------------------------
+
+
+def test_registry_roundtrip_and_mutations(tmp_path):
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a", suite="kvdb", nodes=("n1",),
+                       weight=2.0))
+    reg.add(TenantSpec(name="b", suite="logd"))
+    assert sorted(reg.load()) == ["a", "b"]
+    assert reg.load()["a"].weight == 2.0
+
+    reg.set_state("a", "drained")
+    assert reg.load()["a"].state == "drained"
+    reg.bump_generation("b")
+    reg.bump_generation("b")
+    assert reg.load()["b"].generation == 2
+    reg.remove("a")
+    assert sorted(reg.load()) == ["b"]
+
+    # A fresh instance (new process) reads the same state.
+    assert sorted(FleetRegistry(root).load()) == ["b"]
+    with pytest.raises(ValueError):
+        reg.set_state("missing", "drained")
+    with pytest.raises(ValueError):
+        reg.add(TenantSpec(name="b"))  # duplicate
+
+
+def test_registry_rejects_cross_tenant_nodes(tmp_path):
+    reg = FleetRegistry(str(tmp_path))
+    reg.add(TenantSpec(name="a", nodes=("n1", "n2")))
+    with pytest.raises(ValueError, match="cross-tenant"):
+        reg.add(TenantSpec(name="b", nodes=("n2", "n3")))
+    # Disjoint node sets are fine; so are node-less local tenants.
+    reg.add(TenantSpec(name="c", nodes=("n4",)))
+    reg.add(TenantSpec(name="d"))
+    assert sorted(reg.load()) == ["a", "c", "d"]
+
+
+def test_registry_survives_torn_journal_tail(tmp_path):
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a"))
+    reg.add(TenantSpec(name="b"))
+    # A SIGKILL mid-append leaves a torn final line; everything intact
+    # before it must still load.
+    with open(reg.journal, "a") as f:
+        f.write('{"seq": 99, "op": "remove", "ten')
+    tenants = FleetRegistry(root).load()
+    assert sorted(tenants) == ["a", "b"]
+    # And the next mutation recovers: it re-reads, appends seq 3, and
+    # rewrites the snapshot.
+    reg.add(TenantSpec(name="c"))
+    assert sorted(FleetRegistry(root).load()) == ["a", "b", "c"]
+
+
+def test_registry_replays_journal_past_stale_snapshot(tmp_path):
+    """SIGKILL between journal append and snapshot rewrite: the
+    snapshot is one mutation behind, and load() must replay the
+    journal record past the snapshot's seq."""
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a"))
+    with open(reg.path) as f:
+        stale = f.read()
+    reg.add(TenantSpec(name="b"))
+    # Restore the pre-mutation snapshot, as if the crash landed after
+    # the journal fsync but before the atomic snapshot replace.
+    with open(reg.path, "w") as f:
+        f.write(stale)
+    assert sorted(FleetRegistry(root).load()) == ["a", "b"]
+
+
+def test_registry_missing_snapshot_rebuilt_from_journal(tmp_path):
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a"))
+    reg.set_state("a", "drained")
+    os.unlink(reg.path)
+    tenants = FleetRegistry(root).load()
+    assert tenants["a"].state == "drained"
+
+
+# -- supervision ----------------------------------------------------------
+
+
+def test_crash_loop_parks_only_that_tenant(tmp_path, telem):
+    """The headline isolation property: tenant "bad" crash-loops into
+    parked while tenant "good"'s heartbeat stream keeps growing — the
+    sibling is never stopped, restarted, or stalled."""
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="good"))
+    reg.add(TenantSpec(name="bad"))
+
+    def spawn(spec, store, endpoint):
+        if spec.name == "bad":
+            return crashing_child(spec, store, endpoint)
+        return steady_child(spec, store, endpoint)
+
+    sup = make_supervisor(root, spawn)
+    stop, th = run_supervisor(sup)
+    try:
+        wait_for(lambda: reg.load()["bad"].state == "parked",
+                 msg="bad parked")
+        good = sup.children["good"]
+        pid = good.proc.pid
+        hb0 = heartbeats(root, "good")
+        wait_for(lambda: heartbeats(root, "good") > hb0,
+                 msg="good heartbeat continuity")
+        assert good.alive() and good.proc.pid == pid
+        assert good.restarts == 0
+        assert reg.load()["good"].state == "running"
+        # Parking wrote a dossier into the bad tenant's own store.
+        ddir = os.path.join(tenant_store_dir(root, "bad"),
+                            "forensics", "monitor")
+        assert any(f.startswith("fleet-parked-")
+                   for f in os.listdir(ddir))
+        # The parked child is not respawned.
+        launches = sup.children["bad"].crash_loops
+        time.sleep(0.5)
+        assert sup.children["bad"].crash_loops == launches
+        assert not sup.children["bad"].alive()
+    finally:
+        stop.set()
+        th.join(timeout=15)
+    assert not th.is_alive()
+
+
+def test_supervisor_kill_leaves_fleet_resumable(tmp_path):
+    """SIGKILL of the supervisor (simulated: thread abandoned without
+    drain) leaves fleet.json readable and a fresh supervisor adopts
+    every tenant: per-tenant state is rebuilt from the registry, and
+    each tenant's store dir — and with it its fault ledger, the thing
+    core.repair sweeps on that tenant's next start — is untouched."""
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a"))
+    reg.add(TenantSpec(name="b"))
+    sup = make_supervisor(root, steady_child)
+    stop, th = run_supervisor(sup)
+    wait_for(lambda: all(
+        n in sup.children and sup.children[n].alive()
+        for n in ("a", "b")), msg="both tenants up")
+    # Simulate the SIGKILL: kill the children directly and drop the
+    # supervisor on the floor (no drain, no final status write).
+    pids = {n: sup.children[n].proc for n in ("a", "b")}
+    stop.set()
+    th.join(timeout=15)
+    for proc in pids.values():
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    # fleet.json is readable and complete.
+    tenants = FleetRegistry(root).load()
+    assert sorted(tenants) == ["a", "b"]
+    assert all(s.state == "running" for s in tenants.values())
+
+    # A second supervisor resumes both tenants in place.
+    sup2 = make_supervisor(root, steady_child)
+    stop2, th2 = run_supervisor(sup2)
+    try:
+        wait_for(lambda: all(
+            n in sup2.children and sup2.children[n].alive()
+            for n in ("a", "b")), msg="both tenants resumed")
+        st = read_status(root)
+        assert sorted(st.get("tenants") or {}) == ["a", "b"]
+        for n in ("a", "b"):
+            hb0 = heartbeats(root, n)
+            wait_for(lambda n=n, hb0=hb0: heartbeats(root, n) > hb0,
+                     msg=f"{n} heartbeat after resume")
+    finally:
+        stop2.set()
+        th2.join(timeout=15)
+
+
+def test_rolling_restart_drains_then_relaunches(tmp_path):
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a"))
+    sup = make_supervisor(root, steady_child)
+    stop, th = run_supervisor(sup)
+    try:
+        wait_for(lambda: "a" in sup.children
+                 and sup.children["a"].alive(), msg="tenant up")
+        pid0 = sup.children["a"].proc.pid
+        reg.bump_generation("a")
+        wait_for(lambda: (sup.children["a"].alive()
+                          and sup.children["a"].proc.pid != pid0
+                          and sup.children["a"].generation == 1),
+                 msg="new generation running")
+    finally:
+        stop.set()
+        th.join(timeout=15)
+
+
+def test_drain_and_resume(tmp_path):
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a"))
+    sup = make_supervisor(root, steady_child)
+    stop, th = run_supervisor(sup)
+    try:
+        wait_for(lambda: "a" in sup.children
+                 and sup.children["a"].alive(), msg="tenant up")
+        reg.set_state("a", "drained")
+        wait_for(lambda: not sup.children["a"].alive(),
+                 msg="tenant drained")
+        reg.set_state("a", "running")
+        wait_for(lambda: sup.children["a"].alive(),
+                 msg="tenant resumed")
+    finally:
+        stop.set()
+        th.join(timeout=15)
+
+
+# -- retention ------------------------------------------------------------
+
+
+def _mk_dossier(store, name, age_s, size=64, now=None):
+    d = os.path.join(store, "forensics", "monitor")
+    os.makedirs(d, exist_ok=True)
+    p = os.path.join(d, name)
+    with open(p, "w") as f:
+        f.write(json.dumps({"pad": "x" * size}))
+    t = (now or time.time()) - age_s
+    os.utime(p, (t, t))
+    return p
+
+
+def _mk_series(store, name, age_s, size=256, now=None):
+    os.makedirs(store, exist_ok=True)
+    p = os.path.join(store, name)
+    with open(p, "wb") as f:
+        f.write(b"\x00" * size)
+    t = (now or time.time()) - age_s
+    os.utime(p, (t, t))
+    return p
+
+
+def test_retention_deletes_oldest_first_never_newest(tmp_path, telem):
+    store = str(tmp_path)
+    now = time.time()
+    old = [_mk_dossier(store, f"d{i}.json", age_s=86400 * (9 - i),
+                       now=now) for i in range(8)]
+    newest = _mk_dossier(store, "newest.json", age_s=0, now=now)
+    rep = sweep(store, RetentionPolicy(retain_dossiers=4,
+                                       retain_days=365.0), now=now)
+    left = sorted(os.listdir(os.path.join(store, "forensics",
+                                          "monitor")))
+    # The 5 oldest went; the newest survived.
+    assert rep["dossiers-deleted"] == 5
+    assert os.path.basename(newest) in left
+    assert left == ["d5.json", "d6.json", "d7.json", "newest.json"]
+    assert [os.path.basename(p) for p in old[:5]] == \
+        sorted(rep["deleted"])
+
+
+def test_retention_age_ceiling_exempts_newest(tmp_path):
+    store = str(tmp_path)
+    now = time.time()
+    _mk_dossier(store, "ancient.json", age_s=86400 * 30, now=now)
+    rep = sweep(store, RetentionPolicy(retain_dossiers=10,
+                                       retain_days=7.0), now=now)
+    # The only (hence newest) dossier is exempt from the age ceiling.
+    assert rep["dossiers-deleted"] == 0
+    assert os.path.exists(os.path.join(store, "forensics", "monitor",
+                                       "ancient.json"))
+
+
+def test_retention_never_touches_open_series(tmp_path):
+    store = str(tmp_path)
+    now = time.time()
+    open_f = _mk_series(store, "series-t0.jtpu", age_s=86400 * 40,
+                        now=now)
+    rotated = _mk_series(store, "series-t0.jtpu.1", age_s=86400 * 40,
+                         now=now)
+    rep = sweep(store, RetentionPolicy(retain_days=7.0), now=now)
+    assert os.path.exists(open_f)       # open file untouched, however old
+    assert not os.path.exists(rotated)  # rotated generation GC'd
+    assert rep["series-deleted"] == 1
+
+
+def test_retention_byte_budget_and_idempotence(tmp_path):
+    store = str(tmp_path)
+    now = time.time()
+    for i in range(6):
+        _mk_dossier(store, f"d{i}.json", age_s=3600 * (6 - i),
+                    size=1000, now=now)
+    _mk_series(store, "series-t0.jtpu", age_s=0, size=500, now=now)
+    _mk_series(store, "series-t0.jtpu.1", age_s=7200, size=500,
+               now=now)
+    budget = 3000
+    rep1 = sweep(store, RetentionPolicy(retain_dossiers=100,
+                                        retain_days=365.0,
+                                        budget_bytes=budget), now=now)
+    assert rep1["bytes-freed"] > 0
+    assert disk_bytes(store) <= budget
+    # The open series file and the newest dossier both survive.
+    assert os.path.exists(os.path.join(store, "series-t0.jtpu"))
+    assert os.path.exists(os.path.join(store, "forensics", "monitor",
+                                       "d5.json"))
+    # Idempotent: a second sweep deletes nothing further.
+    rep2 = sweep(store, RetentionPolicy(retain_dossiers=100,
+                                        retain_days=365.0,
+                                        budget_bytes=budget), now=now)
+    assert rep2["deleted"] == []
+    assert rep2["bytes-freed"] == 0
+
+
+def test_supervisor_retention_pass_bounds_tenant_disk(tmp_path, telem):
+    root = str(tmp_path)
+    reg = FleetRegistry(root)
+    reg.add(TenantSpec(name="a", retain_dossiers=2, retain_days=365.0))
+    store = tenant_store_dir(root, "a")
+    now = time.time()
+    for i in range(5):
+        _mk_dossier(store, f"d{i}.json", age_s=3600 * (5 - i), now=now)
+    sup = make_supervisor(root, steady_child, retention_interval_s=0.0)
+    stop, th = run_supervisor(sup)
+    try:
+        wait_for(lambda: len(os.listdir(
+            os.path.join(store, "forensics", "monitor"))) == 2,
+            msg="retention sweep trimmed dossiers")
+    finally:
+        stop.set()
+        th.join(timeout=15)
+    assert telemetry.counter_value("fleet.retention.sweeps") >= 1
+
+
+# -- shed backoff (satellite 1) -------------------------------------------
+
+
+def test_tee_shed_backoff_retries_then_succeeds(telem):
+    """A shed reply is backoff-and-retry (counted), not a permanent
+    fallback: the window's verdict still lands remotely."""
+    from jepsen_tpu.checkerd.client import ShedByServer
+    from jepsen_tpu.monitor.loop import _Tee
+
+    tee = _Tee.__new__(_Tee)  # bare instance: no worker thread yet
+    tee.endpoint = "fake:0"
+    tee.tenant = "t1"
+    tee.deadline_s = 5.0
+    tee.q = __import__("queue").Queue()
+    calls = []
+
+    def fake_submit(run, windows, budget_s):
+        calls.append(budget_s)
+        if len(calls) < 3:
+            raise ShedByServer({"reason": "queue-full",
+                                "retry-after-s": 0.1})
+        return {"result": {"valid": True}}
+
+    tee._submit_once = fake_submit
+    tee.q.put(("w1", [[]]))
+    # Exercise the real worker loop against the fake submit.
+    th = threading.Thread(target=tee._work, daemon=True)
+    th.start()
+    wait_for(lambda: len(calls) >= 3, msg="retries after sheds")
+    wait_for(lambda: telemetry.counter_value("monitor.tee-valid") >= 1,
+             msg="verdict landed after backoff")
+    assert telemetry.counter_value("monitor.shed.backoffs") == 2
+    # Budgets shrink monotonically across retries.
+    assert calls == sorted(calls, reverse=True)
+
+
+def test_tee_shed_deadline_unmet_drops_window(telem):
+    from jepsen_tpu.checkerd.client import ShedByServer
+    from jepsen_tpu.monitor.loop import _Tee
+
+    tee = _Tee.__new__(_Tee)
+    tee.endpoint = "fake:0"
+    tee.tenant = "t1"
+    tee.deadline_s = 0.15
+    tee.q = __import__("queue").Queue()
+
+    def always_shed(run, windows, budget_s):
+        raise ShedByServer({"reason": "queue-full",
+                            "retry-after-s": 0.1})
+
+    tee._submit_once = always_shed
+    tee.q.put(("w1", [[]]))
+    th = threading.Thread(target=tee._work, daemon=True)
+    th.start()
+    wait_for(lambda: telemetry.counter_value(
+        "monitor.shed.deadline-unmet") >= 1, msg="deadline-unmet drop")
+    assert telemetry.counter_value("monitor.shed.backoffs") >= 1
+    assert telemetry.counter_value("monitor.tee-errors") == 0
+
+
+# -- capability probe (satellite 2) ---------------------------------------
+
+
+def test_families_follow_remote_isolation():
+    from jepsen_tpu.control.core import Remote
+    from jepsen_tpu.control.netns import NetnsRemote
+    from jepsen_tpu.control.remotes import (DockerRemote, DummyRemote,
+                                            K8sRemote, LocalRemote,
+                                            RetryRemote, SshCliRemote)
+    from jepsen_tpu.monitor.live import LiveContext
+    from jepsen_tpu.monitor.loop import MonitorConfig
+
+    assert Remote.isolation == frozenset()
+    assert LocalRemote().isolation == frozenset()
+    assert DummyRemote().isolation == frozenset()
+    assert SshCliRemote().isolation == {"net", "clock"}
+    assert K8sRemote().isolation == {"net", "clock"}
+    assert DockerRemote().isolation == {"net"}
+    assert NetnsRemote.isolation == {"net"}
+    assert RetryRemote(SshCliRemote()).isolation == {"net", "clock"}
+    assert RetryRemote(LocalRemote()).isolation == frozenset()
+
+    def families(remote, nodes):
+        ctx = LiveContext.__new__(LiveContext)
+        ctx.cfg = MonitorConfig(store_dir="/tmp/x")
+        ctx.adapter = {}
+        ctx.test = {"nodes": nodes, "remote": remote}
+        return ctx._families()
+
+    # Single-node local tenant: machine-global families skipped.
+    assert families(LocalRemote(), ["n1"]) == ("kill", "pause")
+    # Multi-node local: partition joins, packet/clock still skipped.
+    assert families(LocalRemote(), ["n1", "n2"]) == \
+        ("partition", "kill", "pause")
+    # A real cluster over ssh gets the full family set.
+    assert families(SshCliRemote(), ["n1", "n2"]) == \
+        ("partition", "kill", "pause", "packet", "clock")
+    # Containered nodes isolate the net but share the host clock.
+    assert families(DockerRemote(), ["n1", "n2"]) == \
+        ("partition", "kill", "pause", "packet")
+
+
+def test_families_explicit_request_still_wins(tmp_path):
+    from jepsen_tpu.control.remotes import LocalRemote
+    from jepsen_tpu.monitor.live import LiveContext
+    from jepsen_tpu.monitor.loop import MonitorConfig
+
+    ctx = LiveContext.__new__(LiveContext)
+    ctx.cfg = MonitorConfig(store_dir=str(tmp_path),
+                            live_faults=("kill",))
+    ctx.adapter = {}
+    ctx.test = {"nodes": ["n1"], "remote": LocalRemote()}
+    assert ctx._families() == ("kill",)
+    ctx.cfg = MonitorConfig(store_dir=str(tmp_path),
+                            live_faults=("none",))
+    assert ctx._families() == ()

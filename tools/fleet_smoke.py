@@ -1,0 +1,251 @@
+#!/usr/bin/env python
+"""CI smoke for `jepsen fleet` (tier1.yml step).
+
+End-to-end tenant isolation against a real router-fronted checkerd
+federation:
+
+  1. A 2-daemon + router fleet (per-tenant DRR weights) plus a
+     FleetSupervisor running 3 tenants — kvdb, logd, electd — each a
+     real live monitor child with its own store/search dir, fault
+     schedule (kill+pause), and checkerd tee carrying its tenant
+     identity.
+  2. Once every tenant has completed a fault window, the smoke
+     SIGKILLs ONE tenant's monitor (kvdb) and ONE checkerd daemon
+     mid-run.
+  3. Isolation must hold: the surviving tenants' verdict series and
+     fault-window counters keep advancing (zero lost samples — the
+     pre-kill points are still there and new ones land); the killed
+     tenant is auto-restarted by the supervisor and RESUMES its
+     coverage frontier (search.json windows/coverage superset); the
+     restarted tenant's store stays under its retention budget.
+  4. Observability: /api/fleet (served off the fleet root) lists all
+     3 tenants with supervisor state, and both the fleet /metrics
+     and a daemon /metrics scrape expose the fleet.*/overload
+     counter families (daemon side with per-tenant labels).
+
+Exit 0 + "PASS" on success, exit 1 with a reason.  CPU-only.
+"""
+
+import json
+import os
+import signal
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from jepsen_tpu import telemetry, web  # noqa: E402
+from jepsen_tpu.monitor.fleet import (FleetRegistry, FleetSupervisor,  # noqa: E402
+                                      TenantSpec, tenant_store_dir)
+from jepsen_tpu.monitor.retention import disk_bytes  # noqa: E402
+from jepsen_tpu.nemesis import selfchaos as sc  # noqa: E402
+from jepsen_tpu.telemetry.timeseries import read_disk_series  # noqa: E402
+
+TENANTS = ("kvdb", "logd", "electd")
+SERIES = "monitor.ops-per-s"
+RETAIN_BYTES = 32 * 1024 * 1024
+
+
+class Failure(Exception):
+    pass
+
+
+def read_json(path: str) -> dict:
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return {}
+
+
+def live_status(root: str, tenant: str) -> dict:
+    return read_json(os.path.join(tenant_store_dir(root, tenant),
+                                  "live-status.json"))
+
+
+def search_json(root: str, tenant: str) -> dict:
+    return read_json(os.path.join(tenant_store_dir(root, tenant),
+                                  "search", "search.json"))
+
+
+def wait_until(pred, deadline_s: float, what: str):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        v = pred()
+        if v:
+            return v
+        time.sleep(0.5)
+    raise Failure(f"timed out waiting for {what}")
+
+
+def run() -> int:
+    telemetry.enable()
+    tmp = tempfile.mkdtemp(prefix="fleet-smoke-")
+    root = os.path.join(tmp, "fleet")
+    chaos = sc.ChaosFleet(
+        2, os.path.join(tmp, "checkerd"),
+        tenant_weights={t: 1.0 for t in TENANTS}, metrics=True)
+    chaos.start()
+    print(f"# checkerd fleet: router {chaos.router_addr}, daemons "
+          f"{chaos.daemon_ports}")
+
+    reg = FleetRegistry(root)
+    for name in TENANTS:
+        reg.add(TenantSpec(
+            name=name, suite=name, rate=50.0, duration_s=600.0,
+            keys=2, procs_per_key=2, cadence_s=1.0,
+            live_faults=("kill", "pause"),
+            endpoint=chaos.router_addr, deadline_s=30.0,
+            tee_window_ops=256, retain_dossiers=8, retain_days=14.0,
+            retain_bytes=RETAIN_BYTES))
+    sup = FleetSupervisor(root, endpoint=chaos.router_addr,
+                          tick_s=0.5, park_after=5, min_uptime_s=3.0,
+                          drain_timeout_s=20.0,
+                          retention_interval_s=10.0)
+    stop = threading.Event()
+    sup_thread = threading.Thread(target=sup.run, args=(stop,),
+                                  daemon=True)
+    sup_thread.start()
+
+    httpd = web.make_server(root, "127.0.0.1", 0)
+    web_port = httpd.server_address[1]
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+
+    try:
+        # Phase 1: every tenant completes >= 1 fault window.
+        wait_until(
+            lambda: all(live_status(root, t).get("windows", 0) >= 1
+                        for t in TENANTS),
+            300.0, "first fault window on all 3 tenants")
+        pre = {t: {"windows": live_status(root, t).get("windows", 0),
+                   "coverage": live_status(root, t).get("coverage", 0),
+                   "series": len(read_disk_series(
+                       tenant_store_dir(root, t), SERIES))}
+               for t in TENANTS}
+        print(f"# all tenants windowed: "
+              f"{ {t: p['windows'] for t, p in pre.items()} }")
+
+        # Phase 2: SIGKILL one tenant's monitor and one daemon.
+        victim = "kvdb"
+        survivors = [t for t in TENANTS if t != victim]
+        vchild = sup.children[victim]
+        if not vchild.alive():
+            raise Failure(f"{victim} monitor not running pre-kill")
+        vpid = vchild.proc.pid
+        os.kill(vpid, signal.SIGKILL)
+        chaos.kill_daemon(0)
+        t_kill = time.time()
+        print(f"# killed {victim} monitor (pid {vpid}) and daemon 0")
+        time.sleep(2.0)
+        chaos.restart_daemon(0)
+
+        # Phase 3a: supervisor restarts the victim, which resumes its
+        # coverage frontier.
+        wait_until(lambda: (sup.children[victim].restarts >= 1
+                            and sup.children[victim].alive()),
+                   120.0, f"{victim} auto-restart")
+        wait_until(
+            lambda: (search_json(root, victim).get("windows", 0)
+                     > pre[victim]["windows"]
+                     and len(search_json(root, victim).get("coverage")
+                             or []) >= pre[victim]["coverage"]),
+            240.0, f"{victim} search frontier resume")
+
+        # Phase 3b: survivors never lost a verdict sample and keep
+        # producing them across both kills.
+        for t in survivors:
+            wait_until(
+                lambda t=t: live_status(root, t).get("windows", 0)
+                > pre[t]["windows"],
+                240.0, f"survivor {t} window progress")
+            pts = read_disk_series(tenant_store_dir(root, t), SERIES)
+            before = [p for p in pts if p[0] <= t_kill]
+            after = [p for p in pts if p[0] > t_kill]
+            if len(before) < pre[t]["series"]:
+                raise Failure(
+                    f"survivor {t} lost verdict samples: "
+                    f"{len(before)} < {pre[t]['series']} pre-kill")
+            if not after:
+                raise Failure(f"survivor {t} produced no samples "
+                              f"after the kills")
+            st = live_status(root, t)
+            print(f"# survivor {t}: windows {pre[t]['windows']} -> "
+                  f"{st.get('windows')}, series {len(before)} pre + "
+                  f"{len(after)} post")
+
+        # Phase 3c: retention keeps every tenant's disk bounded.
+        for t in TENANTS:
+            db = disk_bytes(tenant_store_dir(root, t))
+            if db > RETAIN_BYTES:
+                raise Failure(f"tenant {t} disk {db} bytes exceeds "
+                              f"retention budget {RETAIN_BYTES}")
+        if telemetry.counter_value("fleet.retention.sweeps") < 1:
+            raise Failure("no retention sweep ran")
+
+        # Phase 4: observability surfaces.
+        api = json.loads(urllib.request.urlopen(
+            f"http://127.0.0.1:{web_port}/api/fleet",
+            timeout=10).read().decode())
+        rows = api.get("tenants") or {}
+        if sorted(rows) != sorted(TENANTS):
+            raise Failure(f"/api/fleet tenants {sorted(rows)} != "
+                          f"{sorted(TENANTS)}")
+        vrow = rows[victim].get("supervisor") or {}
+        if not vrow.get("restarts"):
+            raise Failure(f"/api/fleet shows no restart for {victim}: "
+                          f"{vrow}")
+        metrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{web_port}/metrics",
+            timeout=10).read().decode()
+        for family in ("jepsen_fleet_tenant_starts_total",
+                       "jepsen_fleet_retention_sweeps_total"):
+            if family not in metrics:
+                raise Failure(f"{family} missing from fleet /metrics")
+        dmetrics = urllib.request.urlopen(
+            f"http://127.0.0.1:{chaos.metrics_ports[1]}/metrics",
+            timeout=10).read().decode()
+        if "jepsen_checkerd_queue_depth" not in dmetrics:
+            raise Failure("daemon /metrics missing checkerd families")
+        # Per-tenant shed fairness at fleet scale: a shed must never
+        # permanently silence a tenant (the satellite-1 property) —
+        # any tenant the daemons shed still kept its verdict stream.
+        tenant_lines = [ln for ln in dmetrics.splitlines()
+                        if "tenant=" in ln]
+        shed_tenants = {t for t in TENANTS
+                        for ln in tenant_lines
+                        if "shed" in ln and f'tenant="{t}"' in ln
+                        and not ln.rstrip().endswith(" 0.0")}
+        for t in shed_tenants & set(survivors):
+            if live_status(root, t).get("windows", 0) <= \
+                    pre[t]["windows"]:
+                raise Failure(f"tenant {t} was shed and then "
+                              f"stalled — shed handling degraded it")
+        print(f"# /api/fleet + /metrics ok; per-tenant metric lines: "
+              f"{len(tenant_lines)}, shed tenants: "
+              f"{sorted(shed_tenants)}")
+    finally:
+        stop.set()
+        sup_thread.join(timeout=60)
+        httpd.shutdown()
+        chaos.stop()
+
+    print("PASS: 3-tenant fleet survives SIGKILL of one tenant's "
+          "monitor and one daemon — survivors keep their verdict "
+          "streams intact, the killed tenant auto-restarts and "
+          "resumes its search frontier, disk stays under the "
+          "retention budget, and the fleet/daemon scrape surfaces "
+          "agree")
+    return 0
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(run())
+    except Failure as e:
+        print(f"FAIL: {e}")
+        sys.exit(1)
